@@ -1,0 +1,379 @@
+"""Device columnar data model — the ``GpuColumnVector`` analog.
+
+[REF: sql-plugin/../GpuColumnVector.java :: GpuColumnVector,
+ RapidsHostColumnVector] — but re-designed TPU-first instead of mirroring
+cuDF's pointer-based layout:
+
+* Every device column is a set of **fixed-shape** jax arrays padded to a
+  power-of-two row bucket, so each (op, schema, bucket) pair compiles once
+  and the XLA executable cache stays hot.  This is THE core TPU-idiom
+  decision (SURVEY.md §7): cuDF kernels handle dynamic sizes natively, XLA
+  wants static shapes.
+* Row liveness is a boolean ``sel`` mask on the batch (covers both padding
+  and not-yet-compacted filter results).  Data-dependent row counts never
+  escape into shapes; compaction happens at deliberate points (shuffle,
+  join build, host transfer) via a stable sort on the mask.
+* Strings/binary are padded byte matrices ``uint8[B, W]`` + ``lengths
+  int32[B]`` rather than cuDF's offset+chars layout — irregular layouts are
+  hostile to the MXU/VPU; a padded matrix vectorizes substring/compare/hash.
+* Decimals (precision <= 18) are scaled int64.
+* Null validity is a separate ``bool[B]`` mask (True = valid), independent
+  of ``sel``.
+
+Host representation is a ``pyarrow.Table`` — the host mirror / transfer
+format (the JCudf/host-column analog), and what the CPU-fallback operators
+consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.runtime.device import ensure_initialized
+
+
+def round_up_pow2(n: int, min_bucket: int = 1024) -> int:
+    """Row bucket for n rows: next power of two, floored at min_bucket."""
+    b = max(int(min_bucket), 1)
+    while b < n:
+        b <<= 1
+    return b
+
+
+@dataclasses.dataclass
+class DeviceColumn:
+    """One SQL column on device.
+
+    data:     jnp array [B] (fixed width types) or uint8 [B, W] (string/binary)
+    validity: jnp bool [B], True = valid; None = all valid
+    lengths:  jnp int32 [B] for string/binary; None otherwise
+    """
+
+    dtype: T.DataType
+    data: jax.Array
+    validity: Optional[jax.Array] = None
+    lengths: Optional[jax.Array] = None
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def is_string(self) -> bool:
+        return self.lengths is not None
+
+    def valid_mask(self) -> jax.Array:
+        if self.validity is None:
+            return jnp.ones((self.capacity,), dtype=jnp.bool_)
+        return self.validity
+
+    def with_validity(self, validity: Optional[jax.Array]) -> "DeviceColumn":
+        return DeviceColumn(self.dtype, self.data, validity, self.lengths)
+
+    def gather(self, idx: jax.Array) -> "DeviceColumn":
+        """Row gather (used by compaction, sort, join)."""
+        data = jnp.take(self.data, idx, axis=0)
+        validity = None if self.validity is None else jnp.take(self.validity, idx)
+        lengths = None if self.lengths is None else jnp.take(self.lengths, idx)
+        return DeviceColumn(self.dtype, data, validity, lengths)
+
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize
+        if self.validity is not None:
+            n += self.validity.size
+        if self.lengths is not None:
+            n += self.lengths.size * 4
+        return n
+
+
+def _col_flatten(c: DeviceColumn):
+    return (c.data, c.validity, c.lengths), c.dtype
+
+
+def _col_unflatten(dtype, children):
+    data, validity, lengths = children
+    return DeviceColumn(dtype, data, validity, lengths)
+
+
+jax.tree_util.register_pytree_node(DeviceColumn, _col_flatten, _col_unflatten)
+
+
+@dataclasses.dataclass
+class DeviceBatch:
+    """A columnar batch on device — the ``ColumnarBatch`` of this engine.
+
+    columns are positional; ``schema`` carries names/types (static metadata).
+    ``sel`` is the live-row mask: padding rows and filtered-out rows are
+    False.  All operators consume/produce ``sel`` instead of changing shapes.
+    """
+
+    schema: T.StructType
+    columns: Tuple[DeviceColumn, ...]
+    sel: jax.Array  # bool[B]
+
+    @property
+    def capacity(self) -> int:
+        return int(self.sel.shape[0])
+
+    def num_rows(self) -> jax.Array:
+        """Live row count (device scalar)."""
+        return jnp.sum(self.sel.astype(jnp.int32))
+
+    def num_rows_host(self) -> int:
+        return int(self.num_rows())
+
+    def column(self, i: int) -> DeviceColumn:
+        return self.columns[i]
+
+    def column_by_name(self, name: str) -> DeviceColumn:
+        return self.columns[self.schema.field_index(name)]
+
+    def with_columns(self, cols, schema=None) -> "DeviceBatch":
+        return DeviceBatch(schema or self.schema, tuple(cols), self.sel)
+
+    def with_sel(self, sel: jax.Array) -> "DeviceBatch":
+        return DeviceBatch(self.schema, self.columns, sel)
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns) + self.sel.size
+
+
+def _batch_flatten(b: DeviceBatch):
+    return (b.columns, b.sel), b.schema
+
+
+def _batch_unflatten(schema, children):
+    columns, sel = children
+    return DeviceBatch(schema, tuple(columns), sel)
+
+
+jax.tree_util.register_pytree_node(DeviceBatch, _batch_flatten, _batch_unflatten)
+
+
+# ---------------------------------------------------------------------------
+# Compaction: gather live rows to the front (stable).  The deliberate
+# dynamic→static boundary; called before shuffle/join-build/host transfer.
+# ---------------------------------------------------------------------------
+
+def compact(batch: DeviceBatch) -> DeviceBatch:
+    # Stable argsort on "dead" flag moves live rows to the front preserving
+    # order.  One lax.sort; vectorizes fine on TPU.
+    order = jnp.argsort((~batch.sel).astype(jnp.int8), stable=True)
+    cols = tuple(c.gather(order) for c in batch.columns)
+    count = jnp.sum(batch.sel.astype(jnp.int32))
+    sel = jnp.arange(batch.capacity, dtype=jnp.int32) < count
+    return DeviceBatch(batch.schema, cols, sel)
+
+
+# ---------------------------------------------------------------------------
+# Host (pyarrow) <-> device conversion — the Row/ColumnarToRow analog pair
+# [REF: GpuRowToColumnarExec.scala, GpuColumnarToRowExec.scala]
+# ---------------------------------------------------------------------------
+
+def _string_to_matrix(arr: pa.Array) -> Tuple[np.ndarray, np.ndarray]:
+    """Arrow string/binary array -> (uint8[B,W] matrix, int32 lengths)."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    if pa.types.is_large_string(arr.type) or pa.types.is_large_binary(arr.type):
+        arr = arr.cast(pa.string() if pa.types.is_large_string(arr.type) else pa.binary())
+    n = len(arr)
+    # offsets/data straight from arrow buffers; nulls handled via validity
+    buffers = arr.buffers()
+    offs = np.frombuffer(buffers[1], dtype=np.int32, count=n + 1, offset=arr.offset * 4)
+    data = np.frombuffer(buffers[2], dtype=np.uint8) if buffers[2] is not None else np.zeros(0, np.uint8)
+    lengths = (offs[1:] - offs[:-1]).astype(np.int32)
+    null_mask = np.asarray(arr.is_null())
+    lengths = np.where(null_mask, 0, lengths).astype(np.int32)
+    w = round_up_pow2(int(lengths.max()) if n else 1, 8)
+    mat = np.zeros((n, w), dtype=np.uint8)
+    total = int(lengths.sum())
+    if total:
+        starts = np.concatenate([[0], np.cumsum(lengths)[:-1]]).astype(np.int64)
+        row_idx = np.repeat(np.arange(n), lengths)
+        col_idx = np.arange(total) - np.repeat(starts, lengths)
+        src_pos = np.repeat(offs[:-1].astype(np.int64), lengths) + col_idx
+        mat[row_idx, col_idx] = data[src_pos]
+    return mat, lengths
+
+
+def _matrix_to_string(mat: np.ndarray, lengths: np.ndarray,
+                      validity: Optional[np.ndarray], binary: bool) -> pa.Array:
+    n, w = mat.shape
+    lengths = lengths.astype(np.int64)
+    col = np.arange(w)[None, :]
+    mask2d = col < lengths[:, None]
+    flat = mat[mask2d]
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    np.cumsum(lengths, out=offsets[1:])
+    typ = pa.binary() if binary else pa.string()
+    null_buf = None
+    if validity is not None and not validity.all():
+        null_buf = pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+    return pa.Array.from_buffers(
+        typ, n,
+        [null_buf, pa.py_buffer(offsets.tobytes()), pa.py_buffer(flat.tobytes())],
+        null_count=-1 if null_buf is not None else 0,
+    )
+
+
+def _decimal_to_int64(arr: pa.Array) -> np.ndarray:
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    buf = arr.buffers()[1]
+    raw = np.frombuffer(buf, dtype=np.int64, count=2 * len(arr),
+                        offset=arr.offset * 16)
+    low, high = raw[0::2].copy(), raw[1::2]
+    # precision<=18 fits in the low limb; high must be sign extension
+    if not np.array_equal(high, low >> 63):
+        raise OverflowError("decimal value exceeds 18 digits")
+    return low
+
+
+def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
+    ensure_initialized()
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    null_mask = np.asarray(arr.is_null())
+    validity_np = ~null_mask if null_mask.any() else None
+
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        mat, lengths = _string_to_matrix(arr)
+        return DeviceColumn(
+            dt, jnp.asarray(mat),
+            None if validity_np is None else jnp.asarray(validity_np),
+            jnp.asarray(lengths),
+        )
+    if isinstance(dt, T.DecimalType):
+        data = _decimal_to_int64(arr)
+        data = np.where(null_mask, 0, data)
+    else:
+        npdt = T.to_numpy_dtype(dt)
+        if isinstance(dt, T.DateType):
+            if not pa.types.is_date32(arr.type):
+                arr = arr.cast(pa.date32())
+            casted = arr.cast(pa.int32())
+        elif isinstance(dt, T.TimestampType):
+            # normalize any unit/tz to the device rep: micros since epoch UTC
+            if arr.type.unit != "us":
+                arr = arr.cast(pa.timestamp("us", tz=arr.type.tz))
+            casted = arr.cast(pa.int64())
+        elif isinstance(dt, T.BooleanType):
+            casted = arr.cast(pa.int8())
+        else:
+            casted = arr
+        data = np.asarray(casted.fill_null(0))
+        if isinstance(dt, T.BooleanType):
+            data = data.astype(np.bool_)
+        data = data.astype(npdt, copy=False)
+    return DeviceColumn(
+        dt, jnp.asarray(data),
+        None if validity_np is None else jnp.asarray(validity_np),
+    )
+
+
+def _pad_col(c: DeviceColumn, bucket: int) -> DeviceColumn:
+    n = c.capacity
+    if n == bucket:
+        return c
+    pad = bucket - n
+    if c.data.ndim == 2:
+        data = jnp.pad(c.data, ((0, pad), (0, 0)))
+    else:
+        data = jnp.pad(c.data, (0, pad))
+    validity = c.validity
+    if validity is not None:
+        validity = jnp.pad(validity, (0, pad))
+    lengths = c.lengths
+    if lengths is not None:
+        lengths = jnp.pad(lengths, (0, pad))
+    return DeviceColumn(c.dtype, data, validity, lengths)
+
+
+def host_to_device(table: pa.Table, bucket: Optional[int] = None,
+                   min_bucket: int = 1024) -> DeviceBatch:
+    """pyarrow.Table -> padded DeviceBatch."""
+    n = table.num_rows
+    b = bucket or round_up_pow2(max(n, 1), min_bucket)
+    fields = []
+    cols = []
+    for name, col in zip(table.column_names, table.columns):
+        dt = T.from_arrow(col.type)
+        dc = arrow_column_to_device(col, dt)
+        cols.append(_pad_col(dc, b))
+        fields.append(T.StructField(name, dt))
+    sel = jnp.arange(b, dtype=jnp.int32) < n
+    return DeviceBatch(T.StructType(tuple(fields)), tuple(cols), sel)
+
+
+def device_to_host(batch: DeviceBatch, already_compact: bool = False) -> pa.Table:
+    """DeviceBatch -> pyarrow.Table (compacts first)."""
+    if not already_compact:
+        batch = compact(batch)
+    n = batch.num_rows_host()
+    arrays = []
+    names = []
+    for f, c in zip(batch.schema.fields, batch.columns):
+        names.append(f.name)
+        validity = None
+        if c.validity is not None:
+            validity = np.asarray(c.validity)[:n]
+        if c.is_string:
+            mat = np.asarray(c.data)[:n]
+            lengths = np.asarray(c.lengths)[:n]
+            arrays.append(_matrix_to_string(
+                mat, lengths, validity, isinstance(f.dtype, T.BinaryType)))
+            continue
+        data = np.asarray(c.data)[:n]
+        if isinstance(f.dtype, T.DecimalType):
+            # build decimal128 buffers directly: 16-byte little-endian
+            # two's complement = (low=int64 unscaled, high=sign extension)
+            low = data.astype(np.int64)
+            raw = np.empty(2 * n, dtype=np.int64)
+            raw[0::2] = low
+            raw[1::2] = low >> 63
+            null_buf = None
+            if validity is not None and not validity.all():
+                null_buf = pa.py_buffer(
+                    np.packbits(validity, bitorder="little").tobytes())
+            arrays.append(pa.Array.from_buffers(
+                T.to_arrow(f.dtype), n,
+                [null_buf, pa.py_buffer(raw.tobytes())],
+                null_count=-1 if null_buf is not None else 0))
+            continue
+        if isinstance(f.dtype, T.DateType):
+            base = pa.array(data.astype(np.int32), type=pa.int32())
+            arr = base.cast(pa.date32())
+        elif isinstance(f.dtype, T.TimestampType):
+            base = pa.array(data.astype(np.int64), type=pa.int64())
+            arr = base.cast(pa.timestamp("us", tz="UTC"))
+        else:
+            arr = pa.array(data, type=T.to_arrow(f.dtype))
+        if validity is not None and not validity.all():
+            arr = pa.Array.from_buffers(
+                arr.type, n,
+                [pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())]
+                + list(arr.buffers()[1:]),
+                null_count=-1,
+            )
+        arrays.append(arr)
+    return pa.table(arrays, names=names)
+
+
+def empty_batch(schema: T.StructType, bucket: int = 1024) -> DeviceBatch:
+    ensure_initialized()
+    cols = []
+    for f in schema.fields:
+        if isinstance(f.dtype, (T.StringType, T.BinaryType)):
+            cols.append(DeviceColumn(
+                f.dtype, jnp.zeros((bucket, 8), jnp.uint8),
+                None, jnp.zeros((bucket,), jnp.int32)))
+        else:
+            npdt = T.to_numpy_dtype(f.dtype)
+            cols.append(DeviceColumn(f.dtype, jnp.zeros((bucket,), npdt)))
+    sel = jnp.zeros((bucket,), jnp.bool_)
+    return DeviceBatch(schema, tuple(cols), sel)

@@ -1,0 +1,312 @@
+"""Spark-compatible SQL type system.
+
+Mirrors Spark's ``org.apache.spark.sql.types`` lattice as consumed by the
+reference's TypeSig machinery [REF: sql-plugin/../TypeChecks.scala :: TypeSig].
+Physical mapping is TPU-first: every type maps to fixed-width device arrays
+(strings become padded uint8 byte matrices; decimals become scaled int64 —
+see ``columnar/column.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    """Base class for SQL data types."""
+
+    @property
+    def simple_name(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __str__(self) -> str:
+        return self.simple_name
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericType(DataType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegralType(NumericType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class FractionalType(NumericType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BooleanType(DataType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ByteType(IntegralType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ShortType(IntegralType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerType(IntegralType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class LongType(IntegralType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class FloatType(FractionalType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class DoubleType(FractionalType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class StringType(DataType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class BinaryType(DataType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class DateType(DataType):
+    """Days since unix epoch, int32 on device (matches Spark physical rep)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class TimestampType(DataType):
+    """Microseconds since unix epoch UTC, int64 on device."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(FractionalType):
+    """Exact decimal.  Device rep: scaled int64 for precision <= 18.
+
+    precision > 18 (DECIMAL128) is represented as two int64 limbs — tracked
+    but not yet enabled in TypeSig (mirrors the reference's staged decimal
+    support [REF: spark-rapids-jni :: decimal128 kernels]).
+    """
+
+    precision: int = 10
+    scale: int = 0
+
+    MAX_PRECISION = 38
+    MAX_LONG_DIGITS = 18
+
+    @property
+    def simple_name(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+
+@dataclasses.dataclass(frozen=True)
+class NullType(DataType):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    element_type: DataType = dataclasses.field(default_factory=NullType)
+    contains_null: bool = True
+
+    @property
+    def simple_name(self) -> str:
+        return f"array<{self.element_type.simple_name}>"
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class StructType(DataType):
+    fields: tuple = ()
+
+    @property
+    def simple_name(self) -> str:
+        inner = ",".join(f"{f.name}:{f.dtype.simple_name}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def field_names(self):
+        return [f.name for f in self.fields]
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+    def __len__(self):
+        return len(self.fields)
+
+    def add(self, name, dtype, nullable=True) -> "StructType":
+        return StructType(self.fields + (StructField(name, dtype, nullable),))
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(DataType):
+    key_type: DataType = dataclasses.field(default_factory=NullType)
+    value_type: DataType = dataclasses.field(default_factory=NullType)
+
+    @property
+    def simple_name(self) -> str:
+        return f"map<{self.key_type.simple_name},{self.value_type.simple_name}>"
+
+
+# Singletons (Spark style)
+BooleanT = BooleanType()
+ByteT = ByteType()
+ShortT = ShortType()
+IntegerT = IntegerType()
+LongT = LongType()
+FloatT = FloatType()
+DoubleT = DoubleType()
+StringT = StringType()
+BinaryT = BinaryType()
+DateT = DateType()
+TimestampT = TimestampType()
+NullT = NullType()
+
+
+_NUMPY_MAP = {
+    BooleanType: np.bool_,
+    ByteType: np.int8,
+    ShortType: np.int16,
+    IntegerType: np.int32,
+    LongType: np.int64,
+    FloatType: np.float32,
+    DoubleType: np.float64,
+    DateType: np.int32,
+    TimestampType: np.int64,
+}
+
+
+def to_numpy_dtype(dt: DataType):
+    """Physical numpy/device dtype for a SQL type's data buffer."""
+    if isinstance(dt, DecimalType):
+        if dt.precision <= DecimalType.MAX_LONG_DIGITS:
+            return np.int64
+        raise NotImplementedError("decimal128 device layout not yet enabled")
+    if isinstance(dt, (StringType, BinaryType)):
+        return np.uint8  # byte-matrix payload
+    t = _NUMPY_MAP.get(type(dt))
+    if t is None:
+        raise NotImplementedError(f"no physical dtype for {dt}")
+    return t
+
+
+def is_integral(dt: DataType) -> bool:
+    return isinstance(dt, IntegralType)
+
+
+def is_numeric(dt: DataType) -> bool:
+    return isinstance(dt, NumericType)
+
+
+def is_string(dt: DataType) -> bool:
+    return isinstance(dt, StringType)
+
+
+def is_orderable(dt: DataType) -> bool:
+    return isinstance(
+        dt,
+        (NumericType, BooleanType, StringType, DateType, TimestampType),
+    )
+
+
+def numeric_widest(a: DataType, b: DataType) -> DataType:
+    """Spark's findTightestCommonType for numeric binary ops (simplified)."""
+    order = [ByteType, ShortType, IntegerType, LongType, FloatType, DoubleType]
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        # Decimal promotion handled by the caller (operation-specific rules).
+        raise NotImplementedError
+    ia = order.index(type(a))
+    ib = order.index(type(b))
+    return (a, b)[ia < ib]
+
+
+def from_arrow(at) -> DataType:
+    """Map a pyarrow DataType to our SQL type."""
+    import pyarrow as pa
+
+    if pa.types.is_boolean(at):
+        return BooleanT
+    if pa.types.is_int8(at):
+        return ByteT
+    if pa.types.is_int16(at):
+        return ShortT
+    if pa.types.is_int32(at):
+        return IntegerT
+    if pa.types.is_int64(at):
+        return LongT
+    if pa.types.is_float32(at):
+        return FloatT
+    if pa.types.is_float64(at):
+        return DoubleT
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return StringT
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return BinaryT
+    if pa.types.is_date32(at):
+        return DateT
+    if pa.types.is_timestamp(at):
+        return TimestampT
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_struct(at):
+        return StructType(
+            tuple(StructField(f.name, from_arrow(f.type)) for f in at)
+        )
+    raise NotImplementedError(f"arrow type {at}")
+
+
+def to_arrow(dt: DataType):
+    import pyarrow as pa
+
+    m = {
+        BooleanType: pa.bool_(),
+        ByteType: pa.int8(),
+        ShortType: pa.int16(),
+        IntegerType: pa.int32(),
+        LongType: pa.int64(),
+        FloatType: pa.float32(),
+        DoubleType: pa.float64(),
+        StringType: pa.string(),
+        BinaryType: pa.binary(),
+        DateType: pa.date32(),
+    }
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow(dt.element_type))
+    if isinstance(dt, StructType):
+        return pa.struct([pa.field(f.name, to_arrow(f.dtype)) for f in dt.fields])
+    t = m.get(type(dt))
+    if t is None:
+        raise NotImplementedError(f"arrow mapping for {dt}")
+    return t

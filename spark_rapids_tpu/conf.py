@@ -1,0 +1,439 @@
+"""Typed config registry — the ``spark.rapids.*`` namespace.
+
+Mirrors the reference's single-file typed ConfEntry builder DSL
+[REF: sql-plugin/../RapidsConf.scala :: RapidsConf, ConfEntry, ConfBuilder]:
+entries are declared once with type/doc/default, validated at startup, and
+``docs/configs.md`` is generated from the registry so docs never drift.
+
+The config namespace is kept byte-compatible with the reference
+(``spark.rapids.sql.enabled`` etc.) so existing spark-rapids job configs
+carry over; TPU-specific knobs live under ``spark.rapids.tpu.*``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional
+
+_SIZE_RE = re.compile(r"^(\d+)([kKmMgGtT]?)[bB]?$")
+_SIZE_MULT = {"": 1, "k": 1 << 10, "m": 1 << 20, "g": 1 << 30, "t": 1 << 40}
+
+
+def parse_bytes(v) -> int:
+    if isinstance(v, int):
+        return v
+    m = _SIZE_RE.match(str(v).strip())
+    if not m:
+        raise ValueError(f"cannot parse byte size {v!r}")
+    return int(m.group(1)) * _SIZE_MULT[m.group(2).lower()]
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    s = str(v).strip().lower()
+    if s in ("true", "1", "yes"):
+        return True
+    if s in ("false", "0", "no"):
+        return False
+    raise ValueError(f"cannot parse boolean {v!r}")
+
+
+@dataclasses.dataclass
+class ConfEntry:
+    key: str
+    doc: str
+    default: Any
+    converter: Callable[[Any], Any]
+    category: str = "sql"
+    internal: bool = False
+    startup_only: bool = False
+    checker: Optional[Callable[[Any], bool]] = None
+    check_msg: str = ""
+
+    def convert(self, raw):
+        v = self.converter(raw)
+        if self.checker is not None and not self.checker(v):
+            hint = f" ({self.check_msg})" if self.check_msg else ""
+            raise ValueError(f"invalid value {v!r} for {self.key}{hint}")
+        return v
+
+
+class _Registry:
+    def __init__(self):
+        self.entries: Dict[str, ConfEntry] = {}
+
+    def register(self, e: ConfEntry):
+        if e.key in self.entries:
+            raise ValueError(f"duplicate conf key {e.key}")
+        self.entries[e.key] = e
+        return e
+
+
+REGISTRY = _Registry()
+
+
+class ConfBuilder:
+    """``conf(key).doc(...).boolean().create_with_default(x)`` builder DSL."""
+
+    def __init__(self, key: str):
+        self._key = key
+        self._doc = ""
+        self._category = "sql"
+        self._internal = False
+        self._startup = False
+        self._converter: Callable = str
+        self._checker = None
+        self._check_msg = ""
+
+    def doc(self, d: str) -> "ConfBuilder":
+        self._doc = d
+        return self
+
+    def category(self, c: str) -> "ConfBuilder":
+        self._category = c
+        return self
+
+    def internal(self) -> "ConfBuilder":
+        self._internal = True
+        return self
+
+    def startup_only(self) -> "ConfBuilder":
+        self._startup = True
+        return self
+
+    def boolean(self) -> "ConfBuilder":
+        self._converter = _parse_bool
+        return self
+
+    def integer(self) -> "ConfBuilder":
+        self._converter = int
+        return self
+
+    def double(self) -> "ConfBuilder":
+        self._converter = float
+        return self
+
+    def string(self) -> "ConfBuilder":
+        self._converter = str
+        return self
+
+    def bytes(self) -> "ConfBuilder":
+        self._converter = parse_bytes
+        return self
+
+    def check(self, fn, msg="") -> "ConfBuilder":
+        self._checker = fn
+        self._check_msg = msg
+        return self
+
+    def create_with_default(self, default) -> ConfEntry:
+        return REGISTRY.register(
+            ConfEntry(
+                key=self._key,
+                doc=self._doc,
+                default=default,
+                converter=self._converter,
+                category=self._category,
+                internal=self._internal,
+                startup_only=self._startup,
+                checker=self._checker,
+                check_msg=self._check_msg,
+            )
+        )
+
+
+def conf(key: str) -> ConfBuilder:
+    return ConfBuilder(key)
+
+
+# ---------------------------------------------------------------------------
+# Core entries (the reference's most load-bearing knobs, same keys)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = (
+    conf("spark.rapids.sql.enabled")
+    .doc("Enable columnar acceleration on TPU. When false every operator "
+         "runs on the CPU fallback path (the correctness oracle).")
+    .boolean()
+    .create_with_default(True)
+)
+
+EXPLAIN = (
+    conf("spark.rapids.sql.explain")
+    .doc("Explain mode for plan conversion: NONE, ALL, or NOT_ON_GPU "
+         "(log every operator that could not be accelerated and why).")
+    .string()
+    .check(lambda v: v.upper() in ("NONE", "ALL", "NOT_ON_GPU"),
+           "one of NONE, ALL, NOT_ON_GPU")
+    .create_with_default("NONE")
+)
+
+TEST_ENABLED = (
+    conf("spark.rapids.sql.test.enabled")
+    .doc("Test mode: raise instead of silently falling back to CPU for any "
+         "operator not in the allow-list (see test.allowedNonGpu).")
+    .category("test")
+    .boolean()
+    .create_with_default(False)
+)
+
+TEST_ALLOWED_NON_GPU = (
+    conf("spark.rapids.sql.test.allowedNonGpu")
+    .doc("Comma-separated operator class names permitted to fall back to "
+         "CPU when test.enabled is on.")
+    .category("test")
+    .string()
+    .create_with_default("")
+)
+
+BATCH_SIZE_BYTES = (
+    conf("spark.rapids.sql.batchSizeBytes")
+    .doc("Target device batch size; coalescing concatenates small batches "
+         "up to this size. TPU default is smaller than the reference's 1g "
+         "because padded static-shape buckets amplify footprint.")
+    .bytes()
+    .create_with_default(512 << 20)
+)
+
+BATCH_ROWS = (
+    conf("spark.rapids.tpu.batchRows")
+    .doc("Target device batch row count. Row counts are padded up to "
+         "power-of-two buckets so XLA executables cache per (op, schema, "
+         "bucket).")
+    .integer()
+    .create_with_default(1 << 20)
+)
+
+MIN_BUCKET_ROWS = (
+    conf("spark.rapids.tpu.minBucketRows")
+    .doc("Smallest static-shape row bucket.")
+    .internal()
+    .integer()
+    .create_with_default(1 << 10)
+)
+
+CONCURRENT_TASKS = (
+    conf("spark.rapids.sql.concurrentGpuTasks")
+    .doc("Number of tasks that may hold the device semaphore concurrently "
+         "[REF: GpuSemaphore.scala].")
+    .category("memory")
+    .integer()
+    .create_with_default(2)
+)
+
+MEMORY_FRACTION = (
+    conf("spark.rapids.memory.gpu.allocFraction")
+    .doc("Fraction of device HBM the budget arbiter may hand out before "
+         "synchronous spill kicks in.")
+    .category("memory")
+    .double()
+    .check(lambda v: 0.0 < v <= 1.0, "in (0, 1]")
+    .create_with_default(0.85)
+)
+
+POOL_SIZE = (
+    conf("spark.rapids.tpu.memory.poolSize")
+    .doc("Explicit device memory budget in bytes; 0 means derive from "
+         "allocFraction of detected HBM.")
+    .category("memory")
+    .bytes()
+    .create_with_default(0)
+)
+
+HOST_SPILL_STORAGE = (
+    conf("spark.rapids.memory.host.spillStorageSize")
+    .doc("Host memory limit for spilled device buffers before they go to "
+         "disk.")
+    .category("memory")
+    .bytes()
+    .create_with_default(4 << 30)
+)
+
+SPILL_PATH = (
+    conf("spark.rapids.tpu.spillPath")
+    .doc("Directory for disk-tier spill files.")
+    .category("memory")
+    .string()
+    .create_with_default("/tmp/tpuq-spill")
+)
+
+RETRY_MAX = (
+    conf("spark.rapids.tpu.retry.maxAttempts")
+    .doc("Max OOM retry attempts per closure before the task fails "
+         "[REF: RmmRapidsRetryIterator.scala :: withRetry].")
+    .category("memory")
+    .integer()
+    .create_with_default(8)
+)
+
+SHUFFLE_MODE = (
+    conf("spark.rapids.shuffle.mode")
+    .doc("Shuffle transport: MULTITHREADED (host-path serialization, works "
+         "everywhere), ICI (collective all_to_all across the slice — the "
+         "UCX analog), or CACHE_ONLY.")
+    .category("shuffle")
+    .string()
+    .check(lambda v: v.upper() in ("MULTITHREADED", "ICI", "CACHE_ONLY"),
+           "one of MULTITHREADED, ICI, CACHE_ONLY")
+    .create_with_default("MULTITHREADED")
+)
+
+SHUFFLE_THREADS = (
+    conf("spark.rapids.shuffle.multiThreaded.writer.threads")
+    .doc("Serializer thread pool size for MULTITHREADED shuffle.")
+    .category("shuffle")
+    .integer()
+    .create_with_default(4)
+)
+
+SHUFFLE_PARTITIONS = (
+    conf("spark.sql.shuffle.partitions")
+    .doc("Default shuffle partition count (Spark core key, honored here).")
+    .category("shuffle")
+    .integer()
+    .create_with_default(16)
+)
+
+METRICS_LEVEL = (
+    conf("spark.rapids.sql.metrics.level")
+    .doc("Metric verbosity: ESSENTIAL, MODERATE, DEBUG.")
+    .string()
+    .check(lambda v: v.upper() in ("ESSENTIAL", "MODERATE", "DEBUG"),
+           "one of ESSENTIAL, MODERATE, DEBUG")
+    .create_with_default("MODERATE")
+)
+
+INCOMPATIBLE_OPS = (
+    conf("spark.rapids.sql.incompatibleOps.enabled")
+    .doc("Enable operators whose results differ from Spark CPU in corner "
+         "cases (documented per op).")
+    .boolean()
+    .create_with_default(False)
+)
+
+HAS_NANS = (
+    conf("spark.rapids.sql.hasNans")
+    .doc("Assume float data may contain NaNs (affects agg/join/sort "
+         "eligibility for some ops).")
+    .boolean()
+    .create_with_default(True)
+)
+
+ANSI_ENABLED = (
+    conf("spark.sql.ansi.enabled")
+    .doc("ANSI mode: arithmetic overflow and invalid casts raise instead "
+         "of returning null (Spark core key, honored here).")
+    .boolean()
+    .create_with_default(False)
+)
+
+FAULT_INJECT = (
+    conf("spark.rapids.tpu.test.injectOomAtAlloc")
+    .doc("Force an OOM at the Nth device allocation (test hook, mirrors "
+         "RmmSpark.forceRetryOOM). -1 disables.")
+    .category("test")
+    .internal()
+    .integer()
+    .create_with_default(-1)
+)
+
+
+class RapidsConf:
+    """Immutable-ish view over a raw key->value dict, validated at init.
+
+    [REF: RapidsConf.scala :: RapidsConf]
+    """
+
+    def __init__(self, raw: Optional[Dict[str, Any]] = None):
+        self._raw = dict(raw or {})
+        self._values: Dict[str, Any] = {}
+        unknown = []
+        for k, v in self._raw.items():
+            e = REGISTRY.entries.get(k)
+            if e is None:
+                if k.startswith("spark.rapids.sql.expression.") or k.startswith(
+                    "spark.rapids.sql.exec."
+                ):
+                    # per-op kill switches are registered dynamically by the
+                    # overrides rule table; store raw
+                    self._values[k] = _parse_bool(v)
+                elif k.startswith("spark.rapids."):
+                    unknown.append(k)
+                else:
+                    self._values[k] = v
+            else:
+                self._values[k] = e.convert(v)
+        if unknown:
+            raise ValueError(f"unknown spark.rapids.* conf keys: {unknown}")
+
+    def get(self, entry: ConfEntry):
+        return self._values.get(entry.key, entry.default)
+
+    def get_raw(self, key: str, default=None):
+        return self._values.get(key, default)
+
+    def is_op_enabled(self, kind: str, name: str, default: bool = True) -> bool:
+        """Per-op kill switch, e.g. spark.rapids.sql.expression.Substring."""
+        return self._values.get(f"spark.rapids.sql.{kind}.{name}", default)
+
+    def with_overrides(self, extra: Dict[str, Any]) -> "RapidsConf":
+        raw = dict(self._raw)
+        raw.update(extra)
+        return RapidsConf(raw)
+
+    # convenience properties -------------------------------------------------
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+    @property
+    def allowed_non_gpu(self) -> List[str]:
+        s = str(self.get(TEST_ALLOWED_NON_GPU)).strip()
+        return [x.strip() for x in s.split(",") if x.strip()]
+
+    @property
+    def batch_rows(self) -> int:
+        return self.get(BATCH_ROWS)
+
+    @property
+    def min_bucket_rows(self) -> int:
+        return self.get(MIN_BUCKET_ROWS)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def ansi_enabled(self) -> bool:
+        return self.get(ANSI_ENABLED)
+
+
+def generate_configs_md() -> str:
+    """Auto-generate docs/configs.md from the registry.
+
+    [REF: RapidsConf.scala :: doc-gen main]
+    """
+    lines = [
+        "# Configuration",
+        "",
+        "Generated from `spark_rapids_tpu/conf.py` — do not edit by hand.",
+        "",
+        "| Key | Default | Category | Description |",
+        "|---|---|---|---|",
+    ]
+    for e in sorted(REGISTRY.entries.values(), key=lambda e: e.key):
+        if e.internal:
+            continue
+        lines.append(f"| `{e.key}` | `{e.default}` | {e.category} | {e.doc} |")
+    lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,50 @@
+"""Device runtime bootstrap — the ``GpuDeviceManager`` analog.
+
+[REF: sql-plugin/../GpuDeviceManager.scala :: initializeGpuAndMemory]
+Responsible for one-time engine initialization: exact-numerics mode (x64),
+device discovery, and (see ``runtime/memory.py``) the HBM budget arbiter.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_init_lock = threading.Lock()
+_initialized = False
+
+
+def ensure_initialized() -> None:
+    """One-time engine init.  Called by every engine entry point (session
+    creation, host<->device transfer), NOT at import, so importing the
+    package does not change process-global JAX semantics for host programs
+    that never run a query.
+
+    SQL engines need exact 64-bit integer/floating semantics (Spark
+    LongType/DoubleType, Decimal backed by int64), so x64 is enabled for the
+    process once the engine is actually used.  TPU emulates int64;
+    correctness over raw speed — hot kernels opt into 32-bit where safe.
+    """
+    global _initialized
+    if _initialized:
+        return
+    with _init_lock:
+        if _initialized:
+            return
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        _initialized = True
+
+
+def device_count() -> int:
+    ensure_initialized()
+    import jax
+
+    return jax.device_count()
+
+
+def local_device() -> "object":
+    ensure_initialized()
+    import jax
+
+    return jax.local_devices()[0]

@@ -1,0 +1,86 @@
+"""Equality assertions for the CPU-vs-TPU oracle harness.
+
+[REF: integration_tests/src/main/python/asserts.py ::
+ assert_gpu_and_cpu_are_equal_collect] — NaN compares equal to NaN and
+-0.0 equal to 0.0 is NOT applied (Spark collects distinguish them via
+java semantics; we follow: NaN == NaN for test equality, -0.0 != 0.0 only
+when bit-compare is requested).
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import pyarrow as pa
+
+
+def _values_equal(a, b, approx_float: bool, rel: float) -> bool:
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, float) and isinstance(b, float):
+        if math.isnan(a) and math.isnan(b):
+            return True
+        if approx_float:
+            if math.isinf(a) or math.isinf(b):
+                return a == b
+            return math.isclose(a, b, rel_tol=rel, abs_tol=rel)
+        return a == b
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(
+            _values_equal(x, y, approx_float, rel) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        return a.keys() == b.keys() and all(
+            _values_equal(a[k], b[k], approx_float, rel) for k in a)
+    return a == b
+
+
+def assert_columns_equal(expected: pa.ChunkedArray, actual: pa.ChunkedArray,
+                         name: str = "", approx_float: bool = False,
+                         rel: float = 1e-6):
+    ev = expected.to_pylist()
+    av = actual.to_pylist()
+    assert len(ev) == len(av), (
+        f"column {name}: row count {len(ev)} != {len(av)}")
+    for i, (e, a) in enumerate(zip(ev, av)):
+        assert _values_equal(e, a, approx_float, rel), (
+            f"column {name} row {i}: expected {e!r} got {a!r}")
+
+
+def assert_tables_equal(expected: pa.Table, actual: pa.Table,
+                        approx_float: bool = False, ignore_order: bool = False,
+                        rel: float = 1e-6):
+    assert expected.column_names == actual.column_names, (
+        f"schema mismatch: {expected.column_names} vs {actual.column_names}")
+    if ignore_order and expected.num_rows > 0:
+        expected = _sorted_for_compare(expected)
+        actual = _sorted_for_compare(actual)
+    for name in expected.column_names:
+        assert_columns_equal(expected.column(name), actual.column(name),
+                             name, approx_float, rel)
+
+
+def _sort_key(v):
+    if v is None:
+        return (0,)
+    if isinstance(v, float) and math.isnan(v):
+        return (2,)
+    if isinstance(v, (list, tuple)):
+        return (1, tuple(_sort_key(x) for x in v))
+    if isinstance(v, dict):
+        return (1, tuple(sorted((k, _sort_key(x)) for k, x in v.items())))
+    return (1, v)
+
+
+def _sorted_for_compare(tbl: pa.Table) -> pa.Table:
+    rows = list(zip(*[tbl.column(i).to_pylist() for i in range(tbl.num_columns)]))
+    try:
+        rows.sort(key=lambda r: tuple(_sort_key(v) for v in r))
+    except TypeError:
+        rows.sort(key=lambda r: tuple(str(v) for v in r))
+    if not rows:
+        return tbl
+    cols = list(zip(*rows))
+    return pa.table(
+        [pa.array(list(c), type=tbl.column(i).type) for i, c in enumerate(cols)],
+        names=tbl.column_names)

@@ -1,0 +1,229 @@
+"""Seeded, typed random data generation for tests and fuzzing.
+
+Python twin of the reference's test datagen
+[REF: integration_tests/src/main/python/data_gen.py :: IntegerGen, StringGen,
+ DecimalGen, ...] and the Scala datagen module [REF: datagen/].  Generators
+are deterministic from a seed, control null ratio, and inject the special
+values that break naive kernels (NaN, ±0.0, int min/max, epoch edges).
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import string as _string
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+
+class DataGen:
+    def __init__(self, dtype: T.DataType, nullable: bool = True,
+                 null_ratio: float = 0.08):
+        self.dtype = dtype
+        self.nullable = nullable
+        self.null_ratio = null_ratio if nullable else 0.0
+
+    def _null_mask(self, rng: np.random.Generator, n: int) -> np.ndarray:
+        if self.null_ratio <= 0:
+            return np.zeros(n, dtype=bool)
+        return rng.random(n) < self.null_ratio
+
+    def generate_values(self, rng: np.random.Generator, n: int):
+        raise NotImplementedError
+
+    def special_values(self) -> list:
+        return []
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = list(self.generate_values(rng, n))
+        nulls = self._null_mask(rng, n)
+        # inject special values into distinct non-null slots so every edge
+        # value is guaranteed present (nulls are decided first so they can't
+        # erase an injected special)
+        specials = self.special_values()
+        if specials and n > 0:
+            non_null = np.flatnonzero(~nulls)
+            if len(non_null) == 0:
+                non_null = np.arange(n)
+                nulls[:] = False
+            slots = rng.permutation(non_null)[: len(specials)]
+            for sv, pos in zip(specials, slots):
+                vals[int(pos)] = sv
+        out = [None if nulls[i] else vals[i] for i in range(n)]
+        return pa.array(out, type=T.to_arrow(self.dtype))
+
+
+class BooleanGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(T.BooleanT, **kw)
+
+    def generate_values(self, rng, n):
+        return rng.integers(0, 2, n).astype(bool).tolist()
+
+
+class _IntGen(DataGen):
+    BITS = 32
+
+    def __init__(self, dtype, min_val=None, max_val=None, **kw):
+        super().__init__(dtype, **kw)
+        lo = -(2 ** (self.BITS - 1))
+        hi = 2 ** (self.BITS - 1) - 1
+        self.min_val = lo if min_val is None else min_val
+        self.max_val = hi if max_val is None else max_val
+
+    def generate_values(self, rng, n):
+        return rng.integers(self.min_val, self.max_val, n,
+                            dtype=np.int64, endpoint=True).tolist()
+
+    def special_values(self):
+        return [self.min_val, self.max_val, 0]
+
+
+class ByteGen(_IntGen):
+    BITS = 8
+
+    def __init__(self, **kw):
+        super().__init__(T.ByteT, **kw)
+
+
+class ShortGen(_IntGen):
+    BITS = 16
+
+    def __init__(self, **kw):
+        super().__init__(T.ShortT, **kw)
+
+
+class IntegerGen(_IntGen):
+    BITS = 32
+
+    def __init__(self, **kw):
+        super().__init__(T.IntegerT, **kw)
+
+
+class LongGen(_IntGen):
+    BITS = 64
+
+    def __init__(self, **kw):
+        super().__init__(T.LongT, **kw)
+
+
+class FloatGen(DataGen):
+    def __init__(self, no_nans: bool = False, **kw):
+        super().__init__(T.FloatT, **kw)
+        self.no_nans = no_nans
+
+    def generate_values(self, rng, n):
+        v = (rng.standard_normal(n) * 1e6).astype(np.float32)
+        return v.tolist()
+
+    def special_values(self):
+        sv = [0.0, -0.0, float(np.finfo(np.float32).max),
+              float(np.finfo(np.float32).min), float("inf"), float("-inf")]
+        if not self.no_nans:
+            sv.append(float("nan"))
+        return sv
+
+
+class DoubleGen(DataGen):
+    def __init__(self, no_nans: bool = False, **kw):
+        super().__init__(T.DoubleT, **kw)
+        self.no_nans = no_nans
+
+    def generate_values(self, rng, n):
+        return (rng.standard_normal(n) * 1e12).tolist()
+
+    def special_values(self):
+        sv = [0.0, -0.0, 1.7976931348623157e308, -1.7976931348623157e308,
+              float("inf"), float("-inf")]
+        if not self.no_nans:
+            sv.append(float("nan"))
+        return sv
+
+
+class StringGen(DataGen):
+    def __init__(self, charset: str = _string.ascii_letters + _string.digits + " ",
+                 min_len: int = 0, max_len: int = 20, **kw):
+        super().__init__(T.StringT, **kw)
+        self.charset = charset
+        self.min_len = min_len
+        self.max_len = max_len
+
+    def generate_values(self, rng, n):
+        lens = rng.integers(self.min_len, self.max_len, n, endpoint=True)
+        chars = np.array(list(self.charset))
+        out = []
+        for ln in lens:
+            out.append("".join(chars[rng.integers(0, len(chars), ln)]))
+        return out
+
+    def special_values(self):
+        return ["", " ", "a" * self.max_len]
+
+
+class DecimalGen(DataGen):
+    def __init__(self, precision: int = 10, scale: int = 2, **kw):
+        super().__init__(T.DecimalType(precision, scale), **kw)
+
+    def generate_values(self, rng, n):
+        p = self.dtype.precision
+        hi = 10 ** p - 1
+        unscaled = rng.integers(-hi, hi, n, dtype=np.int64, endpoint=True)
+        s = self.dtype.scale
+        return [decimal.Decimal(int(u)).scaleb(-s) for u in unscaled]
+
+    def special_values(self):
+        p, s = self.dtype.precision, self.dtype.scale
+        hi = decimal.Decimal(10 ** p - 1).scaleb(-s)
+        return [hi, -hi, decimal.Decimal(0)]
+
+
+class DateGen(DataGen):
+    EPOCH = datetime.date(1970, 1, 1)
+
+    def __init__(self, start_days=-36500, end_days=36500, **kw):
+        super().__init__(T.DateT, **kw)
+        self.start_days, self.end_days = start_days, end_days
+
+    def generate_values(self, rng, n):
+        d = rng.integers(self.start_days, self.end_days, n)
+        return [self.EPOCH + datetime.timedelta(days=int(x)) for x in d]
+
+    def special_values(self):
+        return [self.EPOCH, datetime.date(1582, 10, 15), datetime.date(9999, 12, 31)]
+
+
+class TimestampGen(DataGen):
+    def __init__(self, **kw):
+        super().__init__(T.TimestampT, **kw)
+
+    def generate_values(self, rng, n):
+        us = rng.integers(-2_000_000_000_000_000, 4_000_000_000_000_000, n)
+        ep = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+        return [ep + datetime.timedelta(microseconds=int(x)) for x in us]
+
+    def special_values(self):
+        ep = datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+        return [ep]
+
+
+# canonical suites used across tests (mirrors data_gen.py's *_gens lists)
+numeric_gens: List[DataGen] = [
+    ByteGen(), ShortGen(), IntegerGen(), LongGen(), FloatGen(), DoubleGen(),
+]
+integral_gens: List[DataGen] = [ByteGen(), ShortGen(), IntegerGen(), LongGen()]
+basic_gens: List[DataGen] = numeric_gens + [
+    BooleanGen(), StringGen(), DateGen(), TimestampGen(), DecimalGen(10, 2),
+]
+
+
+def gen_table(gens: Sequence[DataGen], n: int, seed: int = 0,
+              names: Optional[Sequence[str]] = None) -> pa.Table:
+    """Generate a pyarrow table, one column per generator."""
+    rng = np.random.default_rng(seed)
+    names = list(names) if names else [f"c{i}" for i in range(len(gens))]
+    arrays = [g.generate(rng, n) for g in gens]
+    return pa.table(arrays, names=names)

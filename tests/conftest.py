@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU platform so sharding/collective code
+paths run deterministically without TPU hardware (SURVEY.md §4.3: the
+multi-process ICI shuffle tests the reference lacks).
+
+Note: this image's sitecustomize imports jax at interpreter startup with
+``JAX_PLATFORMS=axon`` (the TPU tunnel), so env vars set here are too late —
+we must flip the already-imported config instead.  Backends are not
+initialized until the first computation, so doing it in conftest is safe.
+"""
+
+import os
+
+# XLA_FLAGS is read when the CPU client is created (lazily), so this works
+# even though jax is already imported.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng_seed():
+    return 0
