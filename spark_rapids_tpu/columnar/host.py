@@ -1,0 +1,109 @@
+"""Host column representation for the CPU fallback/oracle path.
+
+The CPU analog of DeviceColumn: numpy data + validity mask.  CPU execs
+evaluate expressions over these (the reference's CPU path is vanilla Spark;
+here the CPU path is the from-spec numpy interpreter that doubles as the
+correctness oracle in tests).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+
+@dataclasses.dataclass
+class HostCol:
+    dtype: T.DataType
+    data: np.ndarray            # object array for strings on host
+    validity: Optional[np.ndarray] = None  # bool, True = valid; None = all
+
+    def valid_mask(self, n=None) -> np.ndarray:
+        if self.validity is None:
+            return np.ones(len(self.data) if n is None else n, dtype=bool)
+        return self.validity
+
+    def __len__(self):
+        return len(self.data)
+
+
+@dataclasses.dataclass
+class HostBatch:
+    schema: T.StructType
+    columns: List[HostCol]
+
+    @property
+    def num_rows(self):
+        return len(self.columns[0]) if self.columns else 0
+
+
+def from_arrow_table(tbl: pa.Table) -> HostBatch:
+    fields = []
+    cols = []
+    for name, col in zip(tbl.column_names, tbl.columns):
+        dt = T.from_arrow(col.type)
+        fields.append(T.StructField(name, dt))
+        cols.append(from_arrow_column(col, dt))
+    return HostBatch(T.StructType(tuple(fields)), cols)
+
+
+def from_arrow_column(col, dt: T.DataType) -> HostCol:
+    if isinstance(col, pa.ChunkedArray):
+        col = col.combine_chunks()
+    nulls = np.asarray(col.is_null())
+    validity = ~nulls if nulls.any() else None
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        data = np.array(
+            ["" if v is None else v for v in col.to_pylist()], dtype=object)
+    elif isinstance(dt, T.DecimalType):
+        from spark_rapids_tpu.columnar.column import _decimal_to_int64
+        data = np.where(nulls, 0, _decimal_to_int64(col))
+    elif isinstance(dt, T.DateType):
+        data = np.asarray(col.cast(pa.date32()).cast(pa.int32()).fill_null(0))
+    elif isinstance(dt, T.TimestampType):
+        c = col
+        if c.type.unit != "us":
+            c = c.cast(pa.timestamp("us", tz=c.type.tz))
+        data = np.asarray(c.cast(pa.int64()).fill_null(0))
+    elif isinstance(dt, T.BooleanType):
+        data = np.asarray(col.cast(pa.int8()).fill_null(0)).astype(bool)
+    else:
+        data = np.asarray(col.fill_null(0)).astype(T.to_numpy_dtype(dt))
+    return HostCol(dt, data, validity)
+
+
+def to_arrow_table(batch: HostBatch) -> pa.Table:
+    arrays = []
+    for f, c in zip(batch.schema.fields, batch.columns):
+        arrays.append(to_arrow_column(c))
+    return pa.table(arrays, names=[f.name for f in batch.schema.fields])
+
+
+def to_arrow_column(c: HostCol) -> pa.Array:
+    n = len(c.data)
+    mask = None
+    if c.validity is not None:
+        mask = ~c.validity
+    if isinstance(c.dtype, (T.StringType, T.BinaryType)):
+        vals = [None if (mask is not None and mask[i]) else c.data[i]
+                for i in range(n)]
+        return pa.array(vals, type=T.to_arrow(c.dtype))
+    if isinstance(c.dtype, T.DecimalType):
+        import decimal as _d
+        vals = [None if (mask is not None and mask[i])
+                else _d.Decimal(int(c.data[i])).scaleb(-c.dtype.scale)
+                for i in range(n)]
+        return pa.array(vals, type=T.to_arrow(c.dtype))
+    if isinstance(c.dtype, T.DateType):
+        arr = pa.array(c.data.astype(np.int32), type=pa.int32(),
+                       mask=mask).cast(pa.date32())
+        return arr
+    if isinstance(c.dtype, T.TimestampType):
+        return pa.array(c.data.astype(np.int64), type=pa.int64(),
+                        mask=mask).cast(pa.timestamp("us", tz="UTC"))
+    return pa.array(c.data, type=T.to_arrow(c.dtype), mask=mask)

@@ -1,0 +1,169 @@
+"""Datetime expressions.  [REF: sql-plugin/../datetimeExpressions.scala]
+
+Dates are int32 days since epoch; timestamps int64 micros since epoch UTC
+(see columnar/column.py).  Calendar decomposition uses the standard civil
+calendar algorithm (integer-only, branch-free via where) so it lowers to
+XLA cleanly — no table lookups or data-dependent control flow.
+
+Timezone-sensitive ops (from_utc_timestamp etc.) need the timezone
+transition LUT [SURVEY.md §2.2 N9]; until that lands they stay CPU-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.host import HostCol
+from spark_rapids_tpu.ops.expressions import (
+    Expression, merge_validity_d, merge_validity_h)
+
+MICROS_PER_DAY = 86_400_000_000
+
+
+def civil_from_days(z, xp):
+    """days-since-epoch -> (year, month, day), integer ops only."""
+    z = z.astype(xp.int64) + 719468
+    era = xp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + xp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y.astype(xp.int32), m.astype(xp.int32), d.astype(xp.int32)
+
+
+def days_from_civil(y, m, d, xp):
+    """(year, month, day) -> days since epoch."""
+    y = y.astype(xp.int64) - (m <= 2)
+    era = xp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = xp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(xp.int32)
+
+
+@dataclasses.dataclass
+class _DateField(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.IntegerType)
+
+    FIELD = 0  # 0=year 1=month 2=day
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _days(self, data, xp):
+        if isinstance(self.child.dtype, T.TimestampType):
+            # floor to days (micros may be negative)
+            return xp.where(data >= 0, data // MICROS_PER_DAY,
+                            -((-data + MICROS_PER_DAY - 1) // MICROS_PER_DAY))
+        return data
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        parts = civil_from_days(self._days(c.data, jnp), jnp)
+        return DeviceColumn(self.dtype, parts[self.FIELD], c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        parts = civil_from_days(self._days(c.data, np), np)
+        return HostCol(self.dtype, parts[self.FIELD], c.validity)
+
+
+class Year(_DateField):
+    FIELD = 0
+
+
+class Month(_DateField):
+    FIELD = 1
+
+
+class DayOfMonth(_DateField):
+    FIELD = 2
+
+
+@dataclasses.dataclass
+class DateAdd(Expression):
+    """date_add(start, days) -> date."""
+
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.DateType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        data = (l.data.astype(jnp.int64) + r.data.astype(jnp.int64)).astype(jnp.int32)
+        return DeviceColumn(self.dtype, data,
+                            merge_validity_d(l.validity, r.validity))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        data = (l.data.astype(np.int64) + r.data.astype(np.int64)).astype(np.int32)
+        return HostCol(self.dtype, data,
+                       merge_validity_h(l.validity, r.validity))
+
+
+@dataclasses.dataclass
+class DateSub(Expression):
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.DateType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        data = (l.data.astype(jnp.int64) - r.data.astype(jnp.int64)).astype(jnp.int32)
+        return DeviceColumn(self.dtype, data,
+                            merge_validity_d(l.validity, r.validity))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        data = (l.data.astype(np.int64) - r.data.astype(np.int64)).astype(np.int32)
+        return HostCol(self.dtype, data,
+                       merge_validity_h(l.validity, r.validity))
+
+
+@dataclasses.dataclass
+class DateDiff(Expression):
+    """datediff(end, start) -> int days."""
+
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.IntegerType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        return DeviceColumn(self.dtype, (l.data - r.data).astype(jnp.int32),
+                            merge_validity_d(l.validity, r.validity))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        return HostCol(self.dtype, (l.data - r.data).astype(np.int32),
+                       merge_validity_h(l.validity, r.validity))

@@ -1,0 +1,1028 @@
+"""Expression library — the Gpu expression analog, dual-lowered.
+
+[REF: sql-plugin/../rapids/arithmetic.scala, predicates.scala,
+ conditionalExpressions.scala, nullExpressions.scala, mathExpressions.scala,
+ GpuCast.scala]
+
+Every expression node lowers two ways:
+
+* ``eval_tpu(DeviceBatch) -> DeviceColumn`` — pure jax, so a whole
+  project/filter tree fuses into ONE jitted XLA program (the reference
+  launches one cuDF kernel per expression node; XLA fusion is the TPU-first
+  win here, [SURVEY.md §2.2 N7]).
+* ``eval_cpu(HostBatch) -> HostCol`` — the numpy CPU-fallback path, also
+  the correctness oracle in tests.
+
+Both implement **Spark semantics**: three-valued logic, null propagation,
+x/0 -> null (non-ANSI), java wrap-on-overflow for integral ops, NaN equal
+to NaN and greater than everything in comparisons, ``ln(x<=0) -> null``,
+``floor/ceil -> long``.  ANSI mode is not yet accelerated: the planner
+tags ANSI arithmetic as CPU-only (mirrors staged ANSI support in the
+reference).
+
+Expressions here are *bound*: children are typed and column references are
+positional ``BoundReference``s (name resolution happens in the plan layer,
+like Spark's analyzer) [REF: GpuBoundReference].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.columnar.host import HostBatch, HostCol
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def merge_validity_d(*vs: Optional[jax.Array]) -> Optional[jax.Array]:
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+def merge_validity_h(*vs: Optional[np.ndarray]) -> Optional[np.ndarray]:
+    out = None
+    for v in vs:
+        if v is None:
+            continue
+        out = v if out is None else (out & v)
+    return out
+
+
+def _is_float(dt: T.DataType) -> bool:
+    return isinstance(dt, (T.FloatType, T.DoubleType))
+
+
+class Expression:
+    """Base expression.  Subclasses are dataclasses with typed children."""
+
+    dtype: T.DataType
+
+    @property
+    def children(self) -> Sequence["Expression"]:
+        return ()
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def eval_tpu(self, batch: DeviceBatch) -> DeviceColumn:
+        raise NotImplementedError(f"{self.name}.eval_tpu")
+
+    def eval_cpu(self, batch: HostBatch) -> HostCol:
+        raise NotImplementedError(f"{self.name}.eval_cpu")
+
+    def __str__(self):
+        cs = ", ".join(str(c) for c in self.children)
+        return f"{self.name}({cs})"
+
+
+@dataclasses.dataclass
+class BoundReference(Expression):
+    index: int
+    dtype: T.DataType
+    nullable: bool = True
+
+    def eval_tpu(self, batch):
+        return batch.columns[self.index]
+
+    def eval_cpu(self, batch):
+        return batch.columns[self.index]
+
+    def __str__(self):
+        return f"input[{self.index}]"
+
+
+@dataclasses.dataclass
+class Literal(Expression):
+    value: Any
+    dtype: T.DataType
+
+    def eval_tpu(self, batch):
+        b = batch.capacity
+        if self.value is None:
+            npdt = (np.int32 if isinstance(self.dtype, T.NullType)
+                    else T.to_numpy_dtype(self.dtype))
+            data = jnp.zeros((b,), npdt)
+            return DeviceColumn(self.dtype, data,
+                                jnp.zeros((b,), jnp.bool_))
+        if isinstance(self.dtype, T.StringType):
+            bs = str(self.value).encode()
+            w = max(len(bs), 1)
+            mat = jnp.broadcast_to(
+                jnp.asarray(np.frombuffer(bs.ljust(w, b"\0"), np.uint8)),
+                (b, w))
+            return DeviceColumn(self.dtype, mat, None,
+                                jnp.full((b,), len(bs), jnp.int32))
+        v = self.value
+        if isinstance(self.dtype, T.DecimalType):
+            import decimal as _d
+            v = int(_d.Decimal(str(v)).scaleb(self.dtype.scale))
+        data = jnp.full((b,), v, T.to_numpy_dtype(self.dtype))
+        return DeviceColumn(self.dtype, data)
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        if self.value is None:
+            npdt = (np.int32 if isinstance(self.dtype, T.NullType)
+                    else (object if isinstance(self.dtype, T.StringType)
+                          else T.to_numpy_dtype(self.dtype)))
+            return HostCol(self.dtype, np.zeros(n, npdt), np.zeros(n, bool))
+        if isinstance(self.dtype, T.StringType):
+            return HostCol(self.dtype, np.array([self.value] * n, object))
+        v = self.value
+        if isinstance(self.dtype, T.DecimalType):
+            import decimal as _d
+            v = int(_d.Decimal(str(v)).scaleb(self.dtype.scale))
+        return HostCol(self.dtype, np.full(n, v, T.to_numpy_dtype(self.dtype)))
+
+    def __str__(self):
+        return repr(self.value)
+
+
+@dataclasses.dataclass
+class Alias(Expression):
+    child: Expression
+    alias_name: str
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        return self.child.eval_tpu(batch)
+
+    def eval_cpu(self, batch):
+        return self.child.eval_cpu(batch)
+
+    def __str__(self):
+        return f"{self.child} AS {self.alias_name}"
+
+
+# ---------------------------------------------------------------------------
+# arithmetic  [REF: arithmetic.scala :: GpuAdd, GpuSubtract, ...]
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _BinaryArith(Expression):
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def _op_d(self, a, b):
+        raise NotImplementedError
+
+    def _op_h(self, a, b):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        data = self._op_d(l.data, r.data)
+        return DeviceColumn(self.dtype, data,
+                            merge_validity_d(l.validity, r.validity))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            data = self._op_h(l.data, r.data)
+        return HostCol(self.dtype, data,
+                       merge_validity_h(l.validity, r.validity))
+
+
+class Add(_BinaryArith):
+    def _op_d(self, a, b):
+        return a + b
+
+    def _op_h(self, a, b):
+        return a + b
+
+
+class Subtract(_BinaryArith):
+    def _op_d(self, a, b):
+        return a - b
+
+    def _op_h(self, a, b):
+        return a - b
+
+
+class Multiply(_BinaryArith):
+    def _op_d(self, a, b):
+        return a * b
+
+    def _op_h(self, a, b):
+        return a * b
+
+
+@dataclasses.dataclass
+class Divide(Expression):
+    """Double (or decimal) division; x/0 -> null (non-ANSI Spark)."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype  # planner coerces both sides to double
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        zero = r.data == 0.0
+        data = l.data / jnp.where(zero, 1.0, r.data)
+        validity = merge_validity_d(l.validity, r.validity, ~zero)
+        return DeviceColumn(self.dtype, jnp.where(zero, 0.0, data), validity)
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        zero = r.data == 0.0
+        with np.errstate(all="ignore"):
+            data = np.where(zero, 0.0, l.data / np.where(zero, 1.0, r.data))
+        return HostCol(self.dtype, data,
+                       merge_validity_h(l.validity, r.validity, ~zero))
+
+
+@dataclasses.dataclass
+class IntegralDivide(Expression):
+    """``div``: long division truncating toward zero; x div 0 -> null."""
+
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.LongType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        zero = r.data == 0
+        den = jnp.where(zero, 1, r.data)
+        data = lax.div(l.data.astype(jnp.int64), den.astype(jnp.int64))
+        return DeviceColumn(self.dtype, jnp.where(zero, 0, data),
+                            merge_validity_d(l.validity, r.validity, ~zero))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        zero = r.data == 0
+        den = np.where(zero, 1, r.data).astype(np.int64)
+        num = l.data.astype(np.int64)
+        with np.errstate(all="ignore"):
+            q = np.abs(num) // np.abs(den)
+            data = np.where((num < 0) != (den < 0), -q, q)
+        return HostCol(self.dtype, np.where(zero, 0, data),
+                       merge_validity_h(l.validity, r.validity, ~zero))
+
+
+@dataclasses.dataclass
+class Remainder(Expression):
+    """``%``: sign follows dividend (java); x % 0 -> null."""
+
+    left: Expression
+    right: Expression
+
+    @property
+    def dtype(self):
+        return self.left.dtype
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        if _is_float(self.dtype):
+            data = lax.rem(l.data, r.data)
+            return DeviceColumn(self.dtype, data,
+                                merge_validity_d(l.validity, r.validity))
+        zero = r.data == 0
+        den = jnp.where(zero, 1, r.data)
+        data = lax.rem(l.data, den)
+        return DeviceColumn(self.dtype, jnp.where(zero, 0, data),
+                            merge_validity_d(l.validity, r.validity, ~zero))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            if _is_float(self.dtype):
+                return HostCol(self.dtype, np.fmod(l.data, r.data),
+                               merge_validity_h(l.validity, r.validity))
+            zero = r.data == 0
+            den = np.where(zero, 1, r.data)
+            data = np.fmod(l.data, den)
+        return HostCol(self.dtype, np.where(zero, 0, data),
+                       merge_validity_h(l.validity, r.validity, ~zero))
+
+
+@dataclasses.dataclass
+class UnaryMinus(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype, -c.data, c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, -c.data, c.validity)
+
+
+@dataclasses.dataclass
+class Abs(Expression):
+    child: Expression
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype, jnp.abs(c.data), c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, np.abs(c.data), c.validity)
+
+
+# ---------------------------------------------------------------------------
+# comparisons  [REF: predicates.scala] — Spark NaN semantics: NaN == NaN,
+# NaN greater than every other value.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _BinaryComparison(Expression):
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def _cmp(self, a, b, an, bn, xp):
+        raise NotImplementedError
+
+    def _eval(self, l, r, xp, validity):
+        if isinstance(self.left.dtype, T.StringType):
+            raise NotImplementedError("string comparison handled in strings.py")
+        if _is_float(self.left.dtype):
+            an, bn = xp.isnan(l), xp.isnan(r)
+        else:
+            zeros = xp.zeros(l.shape if hasattr(l, "shape") else len(l), bool)
+            an = bn = zeros
+        return self._cmp(l, r, an, bn, xp)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        data = self._eval(l.data, r.data, jnp, None)
+        return DeviceColumn(self.dtype, data,
+                            merge_validity_d(l.validity, r.validity))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            data = self._eval(l.data, r.data, np, None)
+        return HostCol(self.dtype, data,
+                       merge_validity_h(l.validity, r.validity))
+
+
+class EqualTo(_BinaryComparison):
+    def _cmp(self, a, b, an, bn, xp):
+        return xp.where(an & bn, True, a == b)
+
+
+class LessThan(_BinaryComparison):
+    # NaN is greater than everything: a < b is True when b is NaN and a isn't
+    def _cmp(self, a, b, an, bn, xp):
+        return xp.where(bn & ~an, True, xp.where(an, False, a < b))
+
+
+class LessThanOrEqual(_BinaryComparison):
+    def _cmp(self, a, b, an, bn, xp):
+        return xp.where(bn, True, xp.where(an, False, a <= b))
+
+
+class GreaterThan(_BinaryComparison):
+    def _cmp(self, a, b, an, bn, xp):
+        return xp.where(an & ~bn, True, xp.where(bn, False, a > b))
+
+
+class GreaterThanOrEqual(_BinaryComparison):
+    def _cmp(self, a, b, an, bn, xp):
+        return xp.where(an, True, xp.where(bn, False, a >= b))
+
+
+@dataclasses.dataclass
+class Not(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype, ~c.data, c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        return HostCol(self.dtype, ~c.data.astype(bool), c.validity)
+
+
+@dataclasses.dataclass
+class EqualNullSafe(Expression):
+    """``<=>``: never null; null <=> null is true."""
+
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        lv = l.valid_mask()
+        rv = r.valid_mask()
+        if _is_float(self.left.dtype):
+            eq = jnp.where(jnp.isnan(l.data) & jnp.isnan(r.data), True,
+                           l.data == r.data)
+        else:
+            eq = l.data == r.data
+        data = jnp.where(lv & rv, eq, ~lv & ~rv)
+        return DeviceColumn(self.dtype, data, None)
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        lv = l.valid_mask()
+        rv = r.valid_mask()
+        with np.errstate(all="ignore"):
+            if _is_float(self.left.dtype):
+                eq = np.where(np.isnan(l.data) & np.isnan(r.data), True,
+                              l.data == r.data)
+            else:
+                eq = l.data == r.data
+        return HostCol(self.dtype, np.where(lv & rv, eq, ~lv & ~rv), None)
+
+
+# ---------------------------------------------------------------------------
+# three-valued logic  [REF: predicates.scala :: GpuAnd, GpuOr]
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class And(Expression):
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        lv, rv = l.valid_mask(), r.valid_mask()
+        data = l.data & r.data
+        # null unless: both valid, or either side is a valid False
+        validity = (lv & rv) | (lv & ~l.data) | (rv & ~r.data)
+        return DeviceColumn(self.dtype, data & validity, validity)
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        lv, rv = l.valid_mask(), r.valid_mask()
+        ld, rd = l.data.astype(bool), r.data.astype(bool)
+        validity = (lv & rv) | (lv & ~ld) | (rv & ~rd)
+        return HostCol(self.dtype, ld & rd & validity, validity)
+
+
+@dataclasses.dataclass
+class Or(Expression):
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        lv, rv = l.valid_mask(), r.valid_mask()
+        data = (l.data & lv) | (r.data & rv)
+        validity = (lv & rv) | (lv & l.data) | (rv & r.data)
+        return DeviceColumn(self.dtype, data, validity)
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        lv, rv = l.valid_mask(), r.valid_mask()
+        ld, rd = l.data.astype(bool), r.data.astype(bool)
+        validity = (lv & rv) | (lv & ld) | (rv & rd)
+        return HostCol(self.dtype, (ld & lv) | (rd & rv), validity)
+
+
+# ---------------------------------------------------------------------------
+# null handling  [REF: nullExpressions.scala]
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class IsNull(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype, ~c.valid_mask(), None)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        return HostCol(self.dtype, ~c.valid_mask(), None)
+
+
+@dataclasses.dataclass
+class IsNotNull(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype, c.valid_mask(), None)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        return HostCol(self.dtype, c.valid_mask(), None)
+
+
+@dataclasses.dataclass
+class IsNaN(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype,
+                            jnp.isnan(c.data) & c.valid_mask(), None)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        return HostCol(self.dtype, np.isnan(c.data) & c.valid_mask(), None)
+
+
+@dataclasses.dataclass
+class Coalesce(Expression):
+    exprs: List[Expression]
+
+    @property
+    def dtype(self):
+        return self.exprs[0].dtype
+
+    @property
+    def children(self):
+        return tuple(self.exprs)
+
+    def eval_tpu(self, batch):
+        cols = [e.eval_tpu(batch) for e in self.exprs]
+        data = cols[-1].data
+        validity = cols[-1].valid_mask()
+        for c in reversed(cols[:-1]):
+            cv = c.valid_mask()
+            data = jnp.where(cv, c.data, data)
+            validity = cv | validity
+        return DeviceColumn(self.dtype, data, validity)
+
+    def eval_cpu(self, batch):
+        cols = [e.eval_cpu(batch) for e in self.exprs]
+        data = cols[-1].data.copy()
+        validity = cols[-1].valid_mask().copy()
+        for c in reversed(cols[:-1]):
+            cv = c.valid_mask()
+            data = np.where(cv, c.data, data)
+            validity = cv | validity
+        return HostCol(self.dtype, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# conditionals  [REF: conditionalExpressions.scala :: GpuIf, GpuCaseWhen]
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class If(Expression):
+    pred: Expression
+    true_value: Expression
+    false_value: Expression
+
+    @property
+    def dtype(self):
+        return self.true_value.dtype
+
+    @property
+    def children(self):
+        return (self.pred, self.true_value, self.false_value)
+
+    def eval_tpu(self, batch):
+        p = self.pred.eval_tpu(batch)
+        t = self.true_value.eval_tpu(batch)
+        f = self.false_value.eval_tpu(batch)
+        cond = p.data & p.valid_mask()  # null predicate -> false branch
+        data = jnp.where(cond, t.data, f.data)
+        validity = jnp.where(cond, t.valid_mask(), f.valid_mask())
+        return DeviceColumn(self.dtype, data, validity)
+
+    def eval_cpu(self, batch):
+        p = self.pred.eval_cpu(batch)
+        t = self.true_value.eval_cpu(batch)
+        f = self.false_value.eval_cpu(batch)
+        cond = p.data.astype(bool) & p.valid_mask()
+        data = np.where(cond, t.data, f.data)
+        validity = np.where(cond, t.valid_mask(), f.valid_mask())
+        return HostCol(self.dtype, data, validity)
+
+
+@dataclasses.dataclass
+class CaseWhen(Expression):
+    branches: List[Tuple[Expression, Expression]]
+    else_value: Optional[Expression] = None
+
+    @property
+    def dtype(self):
+        return self.branches[0][1].dtype
+
+    @property
+    def children(self):
+        cs = []
+        for p, v in self.branches:
+            cs += [p, v]
+        if self.else_value is not None:
+            cs.append(self.else_value)
+        return tuple(cs)
+
+    def eval_tpu(self, batch):
+        if self.else_value is not None:
+            e = self.else_value.eval_tpu(batch)
+            data, validity = e.data, e.valid_mask()
+        else:
+            first = self.branches[0][1].eval_tpu(batch)
+            data = jnp.zeros_like(first.data)
+            validity = jnp.zeros((batch.capacity,), jnp.bool_)
+        for pred, val in reversed(self.branches):
+            p = pred.eval_tpu(batch)
+            v = val.eval_tpu(batch)
+            cond = p.data & p.valid_mask()
+            data = jnp.where(cond, v.data, data)
+            validity = jnp.where(cond, v.valid_mask(), validity)
+        return DeviceColumn(self.dtype, data, validity)
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        if self.else_value is not None:
+            e = self.else_value.eval_cpu(batch)
+            data, validity = e.data.copy(), e.valid_mask().copy()
+        else:
+            first = self.branches[0][1].eval_cpu(batch)
+            data = np.zeros_like(first.data)
+            validity = np.zeros(n, bool)
+        for pred, val in reversed(self.branches):
+            p = pred.eval_cpu(batch)
+            v = val.eval_cpu(batch)
+            cond = p.data.astype(bool) & p.valid_mask()
+            data = np.where(cond, v.data, data)
+            validity = np.where(cond, v.valid_mask(), validity)
+        return HostCol(self.dtype, data, validity)
+
+
+# ---------------------------------------------------------------------------
+# math  [REF: mathExpressions.scala]
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _UnaryMath(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.DoubleType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _op_d(self, a):
+        raise NotImplementedError
+
+    def _op_h(self, a):
+        raise NotImplementedError
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype, self._op_d(c.data), c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, self._op_h(c.data), c.validity)
+
+
+class Sqrt(_UnaryMath):
+    def _op_d(self, a):
+        return jnp.sqrt(a)
+
+    def _op_h(self, a):
+        return np.sqrt(a)
+
+
+class Exp(_UnaryMath):
+    def _op_d(self, a):
+        return jnp.exp(a)
+
+    def _op_h(self, a):
+        return np.exp(a)
+
+
+@dataclasses.dataclass
+class Log(Expression):
+    """Spark ``ln``: null for x <= 0."""
+
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.DoubleType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        bad = c.data <= 0.0
+        data = jnp.log(jnp.where(bad, 1.0, c.data))
+        return DeviceColumn(self.dtype, data,
+                            merge_validity_d(c.validity, ~bad))
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        bad = c.data <= 0.0
+        with np.errstate(all="ignore"):
+            data = np.log(np.where(bad, 1.0, c.data))
+        return HostCol(self.dtype, data, merge_validity_h(c.validity, ~bad))
+
+
+@dataclasses.dataclass
+class Pow(Expression):
+    left: Expression
+    right: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.DoubleType)
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+    def eval_tpu(self, batch):
+        l = self.left.eval_tpu(batch)
+        r = self.right.eval_tpu(batch)
+        return DeviceColumn(self.dtype, jnp.power(l.data, r.data),
+                            merge_validity_d(l.validity, r.validity))
+
+    def eval_cpu(self, batch):
+        l = self.left.eval_cpu(batch)
+        r = self.right.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, np.power(l.data, r.data),
+                           merge_validity_h(l.validity, r.validity))
+
+
+@dataclasses.dataclass
+class Floor(Expression):
+    """Spark floor(double) -> long."""
+
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.LongType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype,
+                            jnp.floor(c.data).astype(jnp.int64), c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, np.floor(c.data).astype(np.int64),
+                           c.validity)
+
+
+@dataclasses.dataclass
+class Ceil(Expression):
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.LongType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        return DeviceColumn(self.dtype,
+                            jnp.ceil(c.data).astype(jnp.int64), c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, np.ceil(c.data).astype(np.int64),
+                           c.validity)
+
+
+@dataclasses.dataclass
+class Round(Expression):
+    """Spark ``round``: HALF_UP at the given scale (numpy rounds HALF_EVEN,
+    so both paths implement HALF_UP by hand)."""
+
+    child: Expression
+    scale: int = 0
+
+    @property
+    def dtype(self):
+        return self.child.dtype
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        if not _is_float(self.dtype):
+            return c
+        m = 10.0 ** self.scale
+        data = jnp.sign(c.data) * jnp.floor(jnp.abs(c.data) * m + 0.5) / m
+        data = jnp.where(jnp.isfinite(c.data), data, c.data)
+        return DeviceColumn(self.dtype, data, c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        if not _is_float(self.dtype):
+            return c
+        m = 10.0 ** self.scale
+        with np.errstate(all="ignore"):
+            data = np.sign(c.data) * np.floor(np.abs(c.data) * m + 0.5) / m
+            data = np.where(np.isfinite(c.data), data, c.data)
+        return HostCol(self.dtype, data, c.validity)
+
+
+# ---------------------------------------------------------------------------
+# cast  [REF: GpuCast.scala]
+# ---------------------------------------------------------------------------
+
+_INT_RANGES = {
+    T.ByteType: (-128, 127),
+    T.ShortType: (-(1 << 15), (1 << 15) - 1),
+    T.IntegerType: (-(1 << 31), (1 << 31) - 1),
+    T.LongType: (-(1 << 63), (1 << 63) - 1),
+}
+
+
+@dataclasses.dataclass
+class Cast(Expression):
+    child: Expression
+    dtype: T.DataType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _cast(self, data, xp):
+        src = self.child.dtype
+        dst = self.dtype
+        npdt = T.to_numpy_dtype(dst)
+        if isinstance(dst, T.BooleanType):
+            return data != 0
+        if isinstance(src, T.BooleanType):
+            return data.astype(npdt)
+        if _is_float(src) and T.is_integral(dst):
+            # java (T) cast: NaN -> 0, saturate at bounds, truncate toward 0
+            lo, hi = _INT_RANGES[type(dst)]
+            d = xp.where(xp.isnan(data), 0.0, data)
+            d = xp.clip(d, lo, hi)
+            return xp.trunc(d).astype(npdt)
+        if T.is_integral(src) and T.is_integral(dst):
+            return data.astype(npdt)  # java narrowing wraps
+        return data.astype(npdt)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        if isinstance(self.dtype, (T.StringType,)) or isinstance(
+                self.child.dtype, (T.StringType,)):
+            raise NotImplementedError("string casts on TPU (strings.py)")
+        return DeviceColumn(self.dtype, self._cast(c.data, jnp), c.validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        src, dst = self.child.dtype, self.dtype
+        if isinstance(src, T.StringType) or isinstance(dst, T.StringType):
+            return self._cast_string_cpu(c)
+        with np.errstate(all="ignore"):
+            return HostCol(self.dtype, self._cast(c.data, np), c.validity)
+
+    def _cast_string_cpu(self, c: HostCol) -> HostCol:
+        src, dst = self.child.dtype, self.dtype
+        n = len(c.data)
+        if isinstance(dst, T.StringType):
+            out = np.empty(n, object)
+            for i in range(n):
+                v = c.data[i]
+                if isinstance(src, T.BooleanType):
+                    out[i] = "true" if v else "false"
+                elif isinstance(src, (T.FloatType, T.DoubleType)):
+                    out[i] = repr(float(v))
+                else:
+                    out[i] = str(v)
+            return HostCol(dst, out, c.validity)
+        # string -> numeric: invalid -> null (non-ANSI)
+        data = np.zeros(n, T.to_numpy_dtype(dst))
+        validity = c.valid_mask().copy()
+        for i in range(n):
+            if not validity[i]:
+                continue
+            s = str(c.data[i]).strip()
+            try:
+                if T.is_integral(dst):
+                    data[i] = int(s)
+                else:
+                    data[i] = float(s)
+            except ValueError:
+                validity[i] = False
+        return HostCol(dst, data, validity)
+
+    def __str__(self):
+        return f"cast({self.child} as {self.dtype.simple_name})"

@@ -1,0 +1,243 @@
+"""Expression CPU-vs-TPU equality tests (the oracle pattern, SURVEY §4.1).
+
+Each test evaluates the same bound expression through both lowering paths
+over seeded data with nulls/special values and compares results exactly.
+"""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import column as C
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.ops import expressions as E
+from spark_rapids_tpu.ops import datetime_ops as D
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.asserts import assert_columns_equal
+
+
+def eval_both(expr, tbl: pa.Table):
+    """Evaluate expr via TPU path and CPU path; return (cpu, tpu) arrow."""
+    # CPU
+    hb = H.from_arrow_table(tbl)
+    hout = expr.eval_cpu(hb)
+    cpu = H.to_arrow_column(hout)
+    # TPU (device) — wrap result in a single-column batch, pull to host
+    db = C.host_to_device(tbl)
+    dout = expr.eval_tpu(db)
+    out_batch = C.DeviceBatch(
+        T.StructType((T.StructField("out", expr.dtype),)), (dout,), db.sel)
+    tpu = C.device_to_host(out_batch).column(0).combine_chunks()
+    return cpu, tpu
+
+
+def check(expr, tbl):
+    cpu, tpu = eval_both(expr, tbl)
+    assert_columns_equal(pa.chunked_array([cpu]), pa.chunked_array([tpu]),
+                         str(expr))
+
+
+def ref(tbl, i):
+    dt = T.from_arrow(tbl.column(i).type)
+    return E.BoundReference(i, dt)
+
+
+two_longs = [dg.LongGen(), dg.LongGen()]
+two_ints = [dg.IntegerGen(), dg.IntegerGen()]
+two_doubles = [dg.DoubleGen(), dg.DoubleGen()]
+
+
+@pytest.mark.parametrize("cls", [E.Add, E.Subtract, E.Multiply])
+@pytest.mark.parametrize("gens", [two_ints, two_longs, two_doubles],
+                         ids=["int", "long", "double"])
+def test_binary_arith(cls, gens):
+    tbl = dg.gen_table(gens, 500, seed=1)
+    check(cls(ref(tbl, 0), ref(tbl, 1)), tbl)
+
+
+def test_divide_by_zero_is_null():
+    tbl = pa.table({"a": pa.array([10.0, 5.0, None, 8.0]),
+                    "b": pa.array([2.0, 0.0, 1.0, None])})
+    cpu, tpu = eval_both(E.Divide(ref(tbl, 0), ref(tbl, 1)), tbl)
+    assert cpu.to_pylist() == [5.0, None, None, None]
+    assert tpu.to_pylist() == [5.0, None, None, None]
+
+
+def test_divide_fuzz():
+    tbl = dg.gen_table(two_doubles, 500, seed=2)
+    check(E.Divide(ref(tbl, 0), ref(tbl, 1)), tbl)
+
+
+def test_integral_divide_semantics():
+    tbl = pa.table({"a": pa.array([7, -7, 7, -7, 9], pa.int64()),
+                    "b": pa.array([2, 2, -2, -2, 0], pa.int64())})
+    cpu, tpu = eval_both(E.IntegralDivide(ref(tbl, 0), ref(tbl, 1)), tbl)
+    # java semantics: truncate toward zero; /0 -> null
+    assert cpu.to_pylist() == [3, -3, -3, 3, None]
+    assert tpu.to_pylist() == [3, -3, -3, 3, None]
+
+
+def test_remainder_sign_follows_dividend():
+    tbl = pa.table({"a": pa.array([7, -7, 7, -7, 3], pa.int64()),
+                    "b": pa.array([3, 3, -3, -3, 0], pa.int64())})
+    cpu, tpu = eval_both(E.Remainder(ref(tbl, 0), ref(tbl, 1)), tbl)
+    assert cpu.to_pylist() == [1, -1, 1, -1, None]
+    assert tpu.to_pylist() == [1, -1, 1, -1, None]
+
+
+@pytest.mark.parametrize("cls", [E.EqualTo, E.LessThan, E.LessThanOrEqual,
+                                 E.GreaterThan, E.GreaterThanOrEqual])
+@pytest.mark.parametrize("gens", [two_ints, two_doubles], ids=["int", "double"])
+def test_comparisons(cls, gens):
+    tbl = dg.gen_table(gens, 500, seed=3)
+    check(cls(ref(tbl, 0), ref(tbl, 1)), tbl)
+
+
+def test_nan_comparison_semantics():
+    nan = float("nan")
+    tbl = pa.table({"a": pa.array([nan, nan, 1.0, 2.0]),
+                    "b": pa.array([nan, 1.0, nan, 2.0])})
+    cpu, tpu = eval_both(E.EqualTo(ref(tbl, 0), ref(tbl, 1)), tbl)
+    # Spark: NaN = NaN is true
+    assert cpu.to_pylist() == [True, False, False, True]
+    assert tpu.to_pylist() == [True, False, False, True]
+    cpu, tpu = eval_both(E.GreaterThan(ref(tbl, 0), ref(tbl, 1)), tbl)
+    # NaN greater than everything
+    assert cpu.to_pylist() == [False, True, False, False]
+    assert tpu.to_pylist() == [False, True, False, False]
+
+
+def test_equal_null_safe():
+    tbl = pa.table({"a": pa.array([1, None, None, 2], pa.int64()),
+                    "b": pa.array([1, None, 3, None], pa.int64())})
+    cpu, tpu = eval_both(E.EqualNullSafe(ref(tbl, 0), ref(tbl, 1)), tbl)
+    assert cpu.to_pylist() == [True, True, False, False]
+    assert tpu.to_pylist() == [True, True, False, False]
+
+
+def test_three_valued_and_or():
+    tbl = pa.table({"a": pa.array([True, True, False, None, None, False]),
+                    "b": pa.array([True, None, None, False, None, False])})
+    a, b = ref(tbl, 0), ref(tbl, 1)
+    cpu, tpu = eval_both(E.And(a, b), tbl)
+    expected = [True, None, False, False, None, False]
+    assert cpu.to_pylist() == expected
+    assert tpu.to_pylist() == expected
+    cpu, tpu = eval_both(E.Or(a, b), tbl)
+    expected = [True, True, None, None, None, False]
+    assert cpu.to_pylist() == expected
+    assert tpu.to_pylist() == expected
+
+
+def test_null_predicates_and_coalesce():
+    tbl = pa.table({"a": pa.array([1, None, 3], pa.int64()),
+                    "b": pa.array([None, 20, None], pa.int64())})
+    a, b = ref(tbl, 0), ref(tbl, 1)
+    cpu, tpu = eval_both(E.IsNull(a), tbl)
+    assert cpu.to_pylist() == [False, True, False] == tpu.to_pylist()
+    cpu, tpu = eval_both(E.Coalesce([a, b]), tbl)
+    assert cpu.to_pylist() == [1, 20, 3] == tpu.to_pylist()
+    cpu, tpu = eval_both(
+        E.Coalesce([a, b, E.Literal(0, T.LongT)]), tbl)
+    assert cpu.to_pylist() == [1, 20, 3] == tpu.to_pylist()
+
+
+def test_if_and_case_when():
+    tbl = dg.gen_table(two_longs + [dg.BooleanGen()], 300, seed=4)
+    a, b, p = ref(tbl, 0), ref(tbl, 1), ref(tbl, 2)
+    check(E.If(p, a, b), tbl)
+    check(E.CaseWhen([(p, a), (E.IsNull(a), E.Literal(-1, T.LongT))], b), tbl)
+    check(E.CaseWhen([(p, a)]), tbl)  # no else -> null
+
+
+@pytest.mark.parametrize("cls", [E.Sqrt, E.Exp, E.Log])
+def test_unary_math(cls):
+    tbl = dg.gen_table([dg.DoubleGen()], 400, seed=5)
+    check(cls(ref(tbl, 0)), tbl)
+
+
+def test_log_nonpositive_is_null():
+    tbl = pa.table({"a": pa.array([1.0, 0.0, -5.0, float("e" in "x") and 2.718281828459045])})
+    cpu, tpu = eval_both(E.Log(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist()[0:3] == [0.0, None, None]
+    assert tpu.to_pylist()[0:3] == [0.0, None, None]
+
+
+def test_floor_ceil_return_long():
+    tbl = pa.table({"a": pa.array([1.5, -1.5, 2.0])})
+    cpu, tpu = eval_both(E.Floor(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist() == [1, -2, 2] == tpu.to_pylist()
+    cpu, tpu = eval_both(E.Ceil(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist() == [2, -1, 2] == tpu.to_pylist()
+
+
+def test_round_half_up():
+    tbl = pa.table({"a": pa.array([2.5, 3.5, -2.5, 1.25])})
+    cpu, tpu = eval_both(E.Round(ref(tbl, 0), 0), tbl)
+    # HALF_UP: 2.5 -> 3 (numpy would give 2)
+    assert cpu.to_pylist() == [3.0, 4.0, -3.0, 1.0] == tpu.to_pylist()
+
+
+def test_cast_double_to_int_java_semantics():
+    tbl = pa.table({"a": pa.array([1.9, -1.9, float("nan"), 1e20, -1e20])})
+    cpu, tpu = eval_both(E.Cast(ref(tbl, 0), T.IntegerT), tbl)
+    expected = [1, -1, 0, (1 << 31) - 1, -(1 << 31)]
+    assert cpu.to_pylist() == expected
+    assert tpu.to_pylist() == expected
+
+
+def test_cast_numeric_fuzz():
+    tbl = dg.gen_table([dg.IntegerGen()], 300, seed=6)
+    for dst in [T.LongT, T.DoubleT, T.ShortT, T.ByteT, T.FloatT]:
+        check(E.Cast(ref(tbl, 0), dst), tbl)
+
+
+def test_cast_string_to_int_cpu():
+    tbl = pa.table({"s": pa.array(["12", " 34 ", "abc", None, "-5"])})
+    hb = H.from_arrow_table(tbl)
+    out = E.Cast(E.BoundReference(0, T.StringT), T.IntegerT).eval_cpu(hb)
+    assert H.to_arrow_column(out).to_pylist() == [12, 34, None, None, -5]
+
+
+def test_date_fields():
+    tbl = dg.gen_table([dg.DateGen()], 500, seed=7)
+    for cls in [D.Year, D.Month, D.DayOfMonth]:
+        check(cls(ref(tbl, 0)), tbl)
+
+
+def test_date_fields_known_values():
+    tbl = pa.table({"d": pa.array([datetime.date(2020, 2, 29),
+                                   datetime.date(1969, 12, 31),
+                                   datetime.date(1582, 10, 15)])})
+    cpu, tpu = eval_both(D.Year(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist() == [2020, 1969, 1582] == tpu.to_pylist()
+    cpu, tpu = eval_both(D.Month(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist() == [2, 12, 10] == tpu.to_pylist()
+    cpu, tpu = eval_both(D.DayOfMonth(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist() == [29, 31, 15] == tpu.to_pylist()
+
+
+def test_date_add_sub_diff():
+    tbl = pa.table({"d": pa.array([datetime.date(2020, 1, 1)] * 3),
+                    "n": pa.array([1, -1, 365], pa.int32())})
+    d, n = ref(tbl, 0), ref(tbl, 1)
+    cpu, tpu = eval_both(D.DateAdd(d, n), tbl)
+    assert cpu.to_pylist() == [datetime.date(2020, 1, 2),
+                               datetime.date(2019, 12, 31),
+                               datetime.date(2020, 12, 31)]
+    assert tpu.to_pylist() == cpu.to_pylist()
+
+
+def test_timestamp_year():
+    tbl = dg.gen_table([dg.TimestampGen()], 300, seed=8)
+    check(D.Year(ref(tbl, 0)), tbl)
+
+
+def test_abs_unary_minus():
+    tbl = dg.gen_table([dg.LongGen(), dg.DoubleGen()], 300, seed=9)
+    check(E.Abs(ref(tbl, 0)), tbl)
+    check(E.UnaryMinus(ref(tbl, 0)), tbl)
+    check(E.Abs(ref(tbl, 1)), tbl)
