@@ -1,0 +1,142 @@
+"""Aggregate functions with Spark result-type and null semantics.
+
+[REF: sql-plugin/../aggregate/ :: GpuAggregateFunction, GpuSum, GpuMin,
+ GpuMax, GpuCount, GpuAverage]
+
+Two evaluation modes, mirroring the reference's partial/merge/final split:
+
+* ``update``: per-batch segment reduction over sorted groups (device) or
+  per-group numpy reduction (host).  Produces the partial buffer columns.
+* ``merge``: combines partial buffers with the SAME reduction (sum of
+  sums, min of mins, sum of counts) — this is what makes multi-batch and
+  post-shuffle final aggregation correct.
+* ``final``: projects the result column from buffer columns (avg = sum /
+  count; everything else is identity).
+
+Spark semantics honored: sum(int*) -> long, sum over empty/all-null group
+-> null, count never null, avg -> double.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+@dataclasses.dataclass
+class AggregateFunction:
+    child: Expression  # bound input expression (ignored for CountStar)
+
+    name = "agg"
+    # reduction kind per buffer column: "sum" | "min" | "max" | "first"
+    buffer_kinds: List[str] = None  # type: ignore
+
+    @property
+    def input_dtype(self) -> T.DataType:
+        return self.child.dtype
+
+    @property
+    def result_dtype(self) -> T.DataType:
+        raise NotImplementedError
+
+    def buffer_dtypes(self) -> List[T.DataType]:
+        raise NotImplementedError
+
+
+class Sum(AggregateFunction):
+    name = "sum"
+    buffer_kinds = ["sum", "sum"]  # (sum, valid_count)
+
+    @property
+    def result_dtype(self):
+        dt = self.input_dtype
+        if T.is_integral(dt):
+            return T.LongT
+        if isinstance(dt, T.DecimalType):
+            return T.DecimalType(min(dt.precision + 10, 38), dt.scale)
+        return T.DoubleT
+
+    def buffer_dtypes(self):
+        return [self.result_dtype, T.LongT]
+
+
+class Min(AggregateFunction):
+    name = "min"
+    buffer_kinds = ["min"]
+
+    @property
+    def result_dtype(self):
+        return self.input_dtype
+
+    def buffer_dtypes(self):
+        return [self.input_dtype]
+
+
+class Max(AggregateFunction):
+    name = "max"
+    buffer_kinds = ["max"]
+
+    @property
+    def result_dtype(self):
+        return self.input_dtype
+
+    def buffer_dtypes(self):
+        return [self.input_dtype]
+
+
+class Count(AggregateFunction):
+    """count(expr): number of non-null values."""
+
+    name = "count"
+    buffer_kinds = ["sum"]
+
+    @property
+    def result_dtype(self):
+        return T.LongT
+
+    def buffer_dtypes(self):
+        return [T.LongT]
+
+
+class CountStar(AggregateFunction):
+    name = "count_star"
+    buffer_kinds = ["sum"]
+
+    @property
+    def result_dtype(self):
+        return T.LongT
+
+    def buffer_dtypes(self):
+        return [T.LongT]
+
+
+class Average(AggregateFunction):
+    name = "avg"
+    buffer_kinds = ["sum", "sum"]  # (sum as double, valid_count)
+
+    @property
+    def result_dtype(self):
+        return T.DoubleT
+
+    def buffer_dtypes(self):
+        return [T.DoubleT, T.LongT]
+
+
+class First(AggregateFunction):
+    """first(expr, ignoreNulls=False) — order-dependent; within this engine
+    batches preserve input order so 'first' is the first row of the group."""
+
+    name = "first"
+    buffer_kinds = ["first"]
+
+    @property
+    def result_dtype(self):
+        return self.input_dtype
+
+    def buffer_dtypes(self):
+        return [self.input_dtype]

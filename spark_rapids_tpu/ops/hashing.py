@@ -1,0 +1,324 @@
+"""Bit-exact Spark murmur3 hash — the shuffle-partitioning keystone.
+
+[REF: spark-rapids-jni :: src/main/cpp/src/murmur_hash.cu, SURVEY §2.2 N9]
+Spark's ``hash()`` / ``HashPartitioning`` use Murmur3_x86_32 with seed 42
+and Spark-specific quirks that MUST be reproduced bit-for-bit or shuffle
+partitions disagree with Spark CPU results:
+
+* each column's hash seeds the next (h = hash(col_i, h), h0 = 42)
+* nulls leave the running hash unchanged
+* int/short/byte/bool hash as a single 4-byte block; long/timestamp as 8
+* float/double: NaN canonicalized to the positive quiet NaN bit pattern,
+  -0.0 is NOT normalized (Spark hashes the raw bits)
+* strings: 4-byte little-endian blocks, then TAIL BYTES ARE EACH
+  SIGN-EXTENDED AND MIXED AS A FULL BLOCK (Spark's hashUnsafeBytes —
+  deviates from canonical murmur3)
+* decimal(<=18): unscaled long
+
+Three implementations, cross-checked in tests: pure-python scalar
+(reference), vectorized numpy (CPU exec path), and jax (device path,
+uint32 lane ops on the VPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.host import HostCol
+from spark_rapids_tpu.ops.expressions import Expression
+
+C1 = 0xCC9E2D51
+C2 = 0x1B873593
+SEED = 42
+
+# ---------------------------------------------------------------------------
+# pure-python scalar reference
+# ---------------------------------------------------------------------------
+
+_M = 0xFFFFFFFF
+
+
+def _rotl_py(x, r):
+    return ((x << r) | (x >> (32 - r))) & _M
+
+
+def _mix_k1_py(k1):
+    k1 = (k1 * C1) & _M
+    k1 = _rotl_py(k1, 15)
+    return (k1 * C2) & _M
+
+
+def _mix_h1_py(h1, k1):
+    h1 ^= k1
+    h1 = _rotl_py(h1, 13)
+    return (h1 * 5 + 0xE6546B64) & _M
+
+
+def _fmix_py(h1, length):
+    h1 ^= length
+    h1 ^= h1 >> 16
+    h1 = (h1 * 0x85EBCA6B) & _M
+    h1 ^= h1 >> 13
+    h1 = (h1 * 0xC2B2AE35) & _M
+    h1 ^= h1 >> 16
+    return h1
+
+
+def hash_int_py(value: int, seed: int) -> int:
+    h1 = _mix_h1_py(seed & _M, _mix_k1_py(value & _M))
+    return _fmix_py(h1, 4)
+
+
+def hash_long_py(value: int, seed: int) -> int:
+    low = value & _M
+    high = (value >> 32) & _M
+    h1 = _mix_h1_py(seed & _M, _mix_k1_py(low))
+    h1 = _mix_h1_py(h1, _mix_k1_py(high))
+    return _fmix_py(h1, 8)
+
+
+def hash_bytes_py(data: bytes, seed: int) -> int:
+    h1 = seed & _M
+    n = len(data)
+    aligned = n - n % 4
+    for i in range(0, aligned, 4):
+        block = int.from_bytes(data[i:i + 4], "little")
+        h1 = _mix_h1_py(h1, _mix_k1_py(block))
+    for i in range(aligned, n):
+        b = data[i]
+        if b >= 128:
+            b -= 256  # sign-extend
+        h1 = _mix_h1_py(h1, _mix_k1_py(b & _M))
+    return _fmix_py(h1, n)
+
+
+def _f32_bits(v: float) -> int:
+    b = np.float32(v).view(np.uint32)
+    return int(b)
+
+
+def _f64_bits(v: float) -> int:
+    return int(np.float64(v).view(np.uint64))
+
+
+def spark_hash_py(values: List, dtypes: List[T.DataType],
+                  seed: int = SEED) -> int:
+    """Row hash across columns, python reference."""
+    h = seed
+    for v, dt in zip(values, dtypes):
+        if v is None:
+            continue
+        if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                           T.DateType)):
+            h = hash_int_py(int(v) & _M, h)
+        elif isinstance(dt, T.BooleanType):
+            h = hash_int_py(1 if v else 0, h)
+        elif isinstance(dt, (T.LongType, T.TimestampType)):
+            h = hash_long_py(int(v), h)
+        elif isinstance(dt, T.FloatType):
+            f = np.float32(v)
+            bits = (0x7FC00000 if np.isnan(f) else _f32_bits(v))
+            h = hash_int_py(bits, h)
+        elif isinstance(dt, T.DoubleType):
+            d = np.float64(v)
+            bits = (0x7FF8000000000000 if np.isnan(d) else _f64_bits(v))
+            h = hash_long_py(bits, h)
+        elif isinstance(dt, T.StringType):
+            h = hash_bytes_py(v.encode() if isinstance(v, str) else v, h)
+        elif isinstance(dt, T.DecimalType):
+            h = hash_long_py(int(v), h)  # caller passes unscaled
+        else:
+            raise NotImplementedError(f"hash of {dt}")
+    # java int
+    return h - (1 << 32) if h >= (1 << 31) else h
+
+
+# ---------------------------------------------------------------------------
+# vectorized (numpy / jax share the code via xp dispatch on uint32)
+# ---------------------------------------------------------------------------
+
+
+def _rotl(x, r, xp):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+
+def _mix_k1(k1, xp):
+    k1 = k1 * np.uint32(C1)
+    k1 = _rotl(k1, 15, xp)
+    return k1 * np.uint32(C2)
+
+
+def _mix_h1(h1, k1, xp):
+    h1 = h1 ^ k1
+    h1 = _rotl(h1, 13, xp)
+    return h1 * np.uint32(5) + np.uint32(0xE6546B64)
+
+
+def _fmix(h1, length, xp):
+    h1 = h1 ^ np.uint32(length)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    h1 = h1 * np.uint32(0x85EBCA6B)
+    h1 = h1 ^ (h1 >> np.uint32(13))
+    h1 = h1 * np.uint32(0xC2B2AE35)
+    h1 = h1 ^ (h1 >> np.uint32(16))
+    return h1
+
+
+def _hash_int_vec(vals_u32, seed_u32, xp):
+    h1 = _mix_h1(seed_u32, _mix_k1(vals_u32, xp), xp)
+    return _fmix(h1, 4, xp)
+
+
+def _hash_long_vec(vals_i64, seed_u32, xp):
+    u = vals_i64.astype(np.uint64) if xp is np else vals_i64.astype(jnp.uint64)
+    low = (u & np.uint64(_M)).astype(np.uint32)
+    high = (u >> np.uint64(32)).astype(np.uint32)
+    h1 = _mix_h1(seed_u32, _mix_k1(low, xp), xp)
+    h1 = _mix_h1(h1, _mix_k1(high, xp), xp)
+    return _fmix(h1, 8, xp)
+
+
+def _hash_string_vec(mat, lengths, seed_u32, xp):
+    """mat: uint8[B, W]; per-row Spark hashUnsafeBytes."""
+    b, w = mat.shape
+    h1 = seed_u32
+    m32 = mat.astype(np.uint32)
+    aligned = lengths - lengths % 4
+    for blk in range(0, w - w % 4, 4):
+        k = (m32[:, blk] | (m32[:, blk + 1] << np.uint32(8))
+             | (m32[:, blk + 2] << np.uint32(16))
+             | (m32[:, blk + 3] << np.uint32(24)))
+        active = blk < aligned
+        mixed = _mix_h1(h1, _mix_k1(k, xp), xp)
+        h1 = xp.where(active, mixed, h1)
+    # tail bytes: sign-extended single-byte blocks
+    for pos in range(w):
+        active = (pos >= aligned) & (pos < lengths)
+        byte = m32[:, pos]
+        signed = xp.where(byte >= 128,
+                          byte | np.uint32(0xFFFFFF00), byte)
+        mixed = _mix_h1(h1, _mix_k1(signed.astype(np.uint32), xp), xp)
+        h1 = xp.where(active, mixed, h1)
+    return _fmix(h1, lengths.astype(np.uint32), xp)
+
+
+def _canon_float_bits(data, xp):
+    f32 = data.astype(np.float32)
+    bits = f32.view(np.uint32) if xp is np else jax_view32(f32)
+    return xp.where(xp.isnan(f32), np.uint32(0x7FC00000), bits)
+
+
+def _canon_double_bits(data, xp):
+    f64 = data.astype(np.float64)
+    if xp is np:
+        bits = f64.view(np.uint64)
+    else:
+        bits = jax_view64(f64)
+    nanbits = np.uint64(0x7FF8000000000000)
+    return xp.where(xp.isnan(f64), nanbits, bits).astype(np.int64)
+
+
+def jax_view32(f32):
+    return jax_bitcast(f32, jnp.uint32)
+
+
+def jax_view64(f64):
+    return jax_bitcast(f64, jnp.uint64)
+
+
+def jax_bitcast(x, dt):
+    import jax.lax as lax
+    return lax.bitcast_convert_type(x, dt)
+
+
+def hash_column(col, dt: T.DataType, h, valid, xp):
+    """Mix one column into running uint32 hash h; rows where ~valid keep h."""
+    if isinstance(col, DeviceColumn) or isinstance(col, HostCol):
+        raise TypeError("pass raw arrays")
+    data, lengths = col
+    if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        if xp is np:
+            v = data.astype(np.int32).view(np.uint32)
+        else:
+            v = jax_bitcast(data.astype(jnp.int32), jnp.uint32)
+        nh = _hash_int_vec(v, h, xp)
+    elif isinstance(dt, T.BooleanType):
+        v = data.astype(np.uint32)
+        nh = _hash_int_vec(v, h, xp)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        nh = _hash_long_vec(data.astype(np.int64), h, xp)
+    elif isinstance(dt, T.FloatType):
+        nh = _hash_int_vec(_canon_float_bits(data, xp), h, xp)
+    elif isinstance(dt, T.DoubleType):
+        nh = _hash_long_vec(_canon_double_bits(data, xp), h, xp)
+    elif isinstance(dt, T.DecimalType):
+        nh = _hash_long_vec(data.astype(np.int64), h, xp)
+    elif isinstance(dt, (T.StringType, T.BinaryType)):
+        nh = _hash_string_vec(data, lengths, h, xp)
+    else:
+        raise NotImplementedError(f"hash of {dt}")
+    return xp.where(valid, nh, h)
+
+
+def _np_int32_from_u32(h):
+    return h.astype(np.int64).astype(np.int32) if isinstance(h, np.ndarray) \
+        else h
+
+
+@dataclasses.dataclass
+class Murmur3Hash(Expression):
+    exprs: List[Expression]
+    seed: int = SEED
+    dtype: T.DataType = dataclasses.field(default_factory=T.IntegerType)
+
+    @property
+    def name(self):
+        return "Murmur3Hash"
+
+    @property
+    def children(self):
+        return tuple(self.exprs)
+
+    def eval_tpu(self, batch):
+        b = batch.capacity
+        h = jnp.full((b,), self.seed, jnp.uint32)
+        for e in self.exprs:
+            c = e.eval_tpu(batch)
+            h = hash_column((c.data, c.lengths), e.dtype, h,
+                            c.valid_mask(), jnp)
+        return DeviceColumn(self.dtype, jax_bitcast(h, jnp.int32).astype(jnp.int32))
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        h = np.full(n, self.seed, np.uint32)
+        for e in self.exprs:
+            c = e.eval_cpu(batch)
+            if isinstance(e.dtype, (T.StringType, T.BinaryType)):
+                # build byte matrix from object array
+                bs = [s.encode() if isinstance(s, str) else bytes(s)
+                      for s in c.data]
+                w = max((len(x) for x in bs), default=1)
+                w = max(w, 1)
+                mat = np.zeros((n, w), np.uint8)
+                lengths = np.zeros(n, np.int32)
+                for i, x in enumerate(bs):
+                    mat[i, :len(x)] = np.frombuffer(x, np.uint8)
+                    lengths[i] = len(x)
+                data = (mat, lengths)
+            else:
+                data = (c.data, None)
+            h = hash_column(data, e.dtype, h, c.valid_mask(), np)
+        return HostCol(self.dtype, h.view(np.int32))
+
+
+def partition_ids_from_hash(h_i32, num_partitions: int, xp):
+    """Spark pmod(hash, n): non-negative partition id."""
+    n = np.int32(num_partitions)
+    r = h_i32 % n
+    return xp.where(r < 0, r + n, r).astype(np.int32)

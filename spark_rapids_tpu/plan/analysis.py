@@ -1,0 +1,274 @@
+"""Name resolution and implicit type coercion (the analyzer).
+
+Converts unresolved ``sql.column.UExpr`` trees into bound, typed
+``ops.expressions`` nodes against a schema, applying Spark's implicit-cast
+rules: widest numeric type for binary ops, both sides to double for ``/``,
+null literals adopt the other side's type, comparison operands unified.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import List, Optional, Tuple
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops import datetime_ops as D
+from spark_rapids_tpu.ops import expressions as E
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.ops import aggregates as A
+from spark_rapids_tpu.sql.column import UExpr
+
+
+class AnalysisException(Exception):
+    pass
+
+
+def infer_literal_type(v) -> T.DataType:
+    if v is None:
+        return T.NullT
+    if isinstance(v, bool):
+        return T.BooleanT
+    if isinstance(v, int):
+        return T.IntegerT if -(1 << 31) <= v < (1 << 31) else T.LongT
+    if isinstance(v, float):
+        return T.DoubleT
+    if isinstance(v, str):
+        return T.StringT
+    if isinstance(v, decimal.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = -exp if exp < 0 else 0
+        return T.DecimalType(max(len(digits), scale), scale)
+    if isinstance(v, datetime.datetime):
+        return T.TimestampT
+    if isinstance(v, datetime.date):
+        return T.DateT
+    if isinstance(v, bytes):
+        return T.BinaryT
+    raise AnalysisException(f"cannot infer literal type for {v!r}")
+
+
+def literal(v) -> E.Literal:
+    dt = infer_literal_type(v)
+    if isinstance(dt, T.TimestampType):
+        epoch = datetime.datetime(1970, 1, 1,
+                                  tzinfo=datetime.timezone.utc)
+        vv = v if v.tzinfo else v.replace(tzinfo=datetime.timezone.utc)
+        v = int((vv - epoch).total_seconds() * 1_000_000)
+    elif isinstance(dt, T.DateType):
+        v = (v - datetime.date(1970, 1, 1)).days
+    return E.Literal(v, dt)
+
+
+_INT_ORDER = [T.ByteType, T.ShortType, T.IntegerType, T.LongType]
+
+
+def common_type(a: T.DataType, b: T.DataType) -> T.DataType:
+    if a == b:
+        return a
+    if isinstance(a, T.NullType):
+        return b
+    if isinstance(b, T.NullType):
+        return a
+    if isinstance(a, T.DoubleType) or isinstance(b, T.DoubleType):
+        if T.is_numeric(a) and T.is_numeric(b):
+            return T.DoubleT
+    if isinstance(a, T.FloatType) or isinstance(b, T.FloatType):
+        if T.is_numeric(a) and T.is_numeric(b):
+            return T.FloatT
+    if T.is_integral(a) and T.is_integral(b):
+        ia, ib = _INT_ORDER.index(type(a)), _INT_ORDER.index(type(b))
+        return a if ia >= ib else b
+    if isinstance(a, T.DecimalType) and T.is_integral(b):
+        return a
+    if T.is_integral(a) and isinstance(b, T.DecimalType):
+        return b
+    if isinstance(a, T.DateType) and isinstance(b, T.TimestampType):
+        return b
+    if isinstance(a, T.TimestampType) and isinstance(b, T.DateType):
+        return a
+    raise AnalysisException(f"incompatible types: {a} vs {b}")
+
+
+def cast_to(e: E.Expression, dt: T.DataType) -> E.Expression:
+    if e.dtype == dt:
+        return e
+    if isinstance(e, E.Literal) and e.value is None:
+        return E.Literal(None, dt)
+    return E.Cast(e, dt)
+
+
+def _coerce_pair(l: E.Expression, r: E.Expression):
+    ct = common_type(l.dtype, r.dtype)
+    return cast_to(l, ct), cast_to(r, ct)
+
+
+_BIN_ARITH = {"add": E.Add, "sub": E.Subtract, "mul": E.Multiply,
+              "mod": E.Remainder}
+_BIN_CMP = {"eq": E.EqualTo, "lt": E.LessThan, "le": E.LessThanOrEqual,
+            "gt": E.GreaterThan, "ge": E.GreaterThanOrEqual,
+            "eqns": E.EqualNullSafe}
+_UNARY_MATH = {"sqrt": E.Sqrt, "exp": E.Exp, "log": E.Log}
+_DATE_FIELD = {"year": D.Year, "month": D.Month, "dayofmonth": D.DayOfMonth}
+
+
+def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
+    op = u.op
+    if op == "attr":
+        name = u.payload
+        try:
+            idx = schema.field_index(name)
+        except KeyError:
+            raise AnalysisException(
+                f"cannot resolve column '{name}' among "
+                f"{schema.field_names()}")
+        f = schema.fields[idx]
+        return E.BoundReference(idx, f.dtype, f.nullable)
+    if op == "lit":
+        return literal(u.payload)
+    if op == "alias":
+        return E.Alias(resolve(u.children[0], schema), u.payload)
+    if op in _BIN_ARITH:
+        l = resolve(u.children[0], schema)
+        r = resolve(u.children[1], schema)
+        if isinstance(l.dtype, T.StringType) or isinstance(r.dtype, T.StringType):
+            raise AnalysisException(f"'{op}' needs numeric operands")
+        l, r = _coerce_pair(l, r)
+        return _BIN_ARITH[op](l, r)
+    if op == "div":
+        l = resolve(u.children[0], schema)
+        r = resolve(u.children[1], schema)
+        return E.Divide(cast_to(l, T.DoubleT), cast_to(r, T.DoubleT))
+    if op in _BIN_CMP:
+        l = resolve(u.children[0], schema)
+        r = resolve(u.children[1], schema)
+        l, r = _coerce_pair(l, r)
+        if isinstance(l.dtype, T.StringType):
+            return S.string_comparison(op, l, r)
+        return _BIN_CMP[op](l, r)
+    if op in ("and", "or"):
+        l = resolve(u.children[0], schema)
+        r = resolve(u.children[1], schema)
+        for side in (l, r):
+            if not isinstance(side.dtype, (T.BooleanType, T.NullType)):
+                raise AnalysisException(f"'{op}' needs boolean operands, "
+                                        f"got {side.dtype}")
+        cls = E.And if op == "and" else E.Or
+        return cls(cast_to(l, T.BooleanT), cast_to(r, T.BooleanT))
+    if op == "not":
+        return E.Not(resolve(u.children[0], schema))
+    if op == "neg":
+        return E.UnaryMinus(resolve(u.children[0], schema))
+    if op == "abs":
+        return E.Abs(resolve(u.children[0], schema))
+    if op == "isnull":
+        return E.IsNull(resolve(u.children[0], schema))
+    if op == "isnotnull":
+        return E.IsNotNull(resolve(u.children[0], schema))
+    if op == "isnan":
+        return E.IsNaN(resolve(u.children[0], schema))
+    if op == "coalesce":
+        exprs = [resolve(c, schema) for c in u.children]
+        ct = exprs[0].dtype
+        for e in exprs[1:]:
+            ct = common_type(ct, e.dtype)
+        return E.Coalesce([cast_to(e, ct) for e in exprs])
+    if op == "casewhen":
+        kids = [resolve(c, schema) for c in u.children]
+        has_else = len(kids) % 2 == 1
+        pairs = [(kids[i], kids[i + 1]) for i in range(0, len(kids) - 1, 2)]
+        else_v = kids[-1] if has_else else None
+        ct = pairs[0][1].dtype
+        for _, v in pairs[1:]:
+            ct = common_type(ct, v.dtype)
+        if else_v is not None:
+            ct = common_type(ct, else_v.dtype)
+            else_v = cast_to(else_v, ct)
+        pairs = [(p, cast_to(v, ct)) for p, v in pairs]
+        return E.CaseWhen(pairs, else_v)
+    if op in _UNARY_MATH:
+        c = cast_to(resolve(u.children[0], schema), T.DoubleT)
+        return _UNARY_MATH[op](c)
+    if op in ("floor", "ceil"):
+        c = cast_to(resolve(u.children[0], schema), T.DoubleT)
+        return (E.Floor if op == "floor" else E.Ceil)(c)
+    if op == "round":
+        return E.Round(resolve(u.children[0], schema), u.payload)
+    if op == "pow":
+        l = cast_to(resolve(u.children[0], schema), T.DoubleT)
+        r = cast_to(resolve(u.children[1], schema), T.DoubleT)
+        return E.Pow(l, r)
+    if op == "cast":
+        dt = u.payload if isinstance(u.payload, T.DataType) else _parse_type(u.payload)
+        return E.Cast(resolve(u.children[0], schema), dt)
+    if op in _DATE_FIELD:
+        return _DATE_FIELD[op](resolve(u.children[0], schema))
+    if op == "date_add":
+        return D.DateAdd(resolve(u.children[0], schema),
+                         resolve(u.children[1], schema))
+    if op == "date_sub":
+        return D.DateSub(resolve(u.children[0], schema),
+                         resolve(u.children[1], schema))
+    if op == "datediff":
+        return D.DateDiff(resolve(u.children[0], schema),
+                          resolve(u.children[1], schema))
+    if op in ("upper", "lower", "length"):
+        return S.string_unary(op, resolve(u.children[0], schema))
+    if op == "substring":
+        pos, ln = u.payload
+        return S.Substring(resolve(u.children[0], schema), pos, ln)
+    if op in ("startswith", "endswith", "contains"):
+        return S.string_predicate(op, resolve(u.children[0], schema),
+                                  resolve(u.children[1], schema))
+    if op == "concat":
+        return S.Concat([resolve(c, schema) for c in u.children])
+    if op == "hash":
+        from spark_rapids_tpu.ops.hashing import Murmur3Hash
+        return Murmur3Hash([resolve(c, schema) for c in u.children])
+    if op == "agg":
+        raise AnalysisException(
+            f"aggregate function '{u.payload}' is only allowed in agg()")
+    if op == "sortorder":
+        raise AnalysisException("sort order only allowed in orderBy()")
+    raise AnalysisException(f"unknown expression op '{op}'")
+
+
+_AGG_MAP = {"sum": A.Sum, "min": A.Min, "max": A.Max, "count": A.Count,
+            "avg": A.Average, "first": A.First}
+
+
+def resolve_aggregate(u: UExpr, schema: T.StructType
+                      ) -> Tuple[A.AggregateFunction, Optional[str]]:
+    """Resolve an agg expression (optionally aliased).  Returns (fn, name)."""
+    alias = None
+    if u.op == "alias":
+        alias = u.payload
+        u = u.children[0]
+    if u.op != "agg":
+        raise AnalysisException(
+            f"agg() expects aggregate expressions, got {u}")
+    kind = u.payload
+    child = resolve(u.children[0], schema)
+    if kind == "count_star":
+        return A.CountStar(child), alias or "count(1)"
+    if kind == "avg":
+        child = cast_to(child, T.DoubleT)
+    if kind == "sum" and isinstance(child.dtype,
+                                    (T.FloatType,)):
+        child = cast_to(child, T.DoubleT)
+    cls = _AGG_MAP.get(kind)
+    if cls is None:
+        raise AnalysisException(f"unsupported aggregate '{kind}'")
+    fn = cls(child)
+    return fn, alias or f"{kind}({u.children[0]})"
+
+
+def _parse_type(s: str) -> T.DataType:
+    m = {"int": T.IntegerT, "integer": T.IntegerT, "long": T.LongT,
+         "bigint": T.LongT, "short": T.ShortT, "byte": T.ByteT,
+         "float": T.FloatT, "double": T.DoubleT, "string": T.StringT,
+         "boolean": T.BooleanT, "date": T.DateT, "timestamp": T.TimestampT}
+    key = str(s).strip().lower()
+    if key in m:
+        return m[key]
+    raise AnalysisException(f"cannot parse type string {s!r}")

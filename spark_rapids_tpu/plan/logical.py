@@ -1,0 +1,165 @@
+"""Logical plan nodes.
+
+The engine's Catalyst-analog is deliberately thin: the DataFrame API
+resolves names and coerces types eagerly (pyspark-style errors at call
+site), so logical nodes already hold bound, typed expressions.  Physical
+planning (plan/planner.py) maps these 1:1 onto CPU execs; the overrides
+engine (plan/overrides.py) then rewrites supported subtrees onto TPU —
+exactly the reference's split between Spark's planner and GpuOverrides.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops.aggregates import AggregateFunction
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+@dataclasses.dataclass
+class SortOrder:
+    expr: Expression
+    ascending: bool = True
+    nulls_first: bool = True
+
+
+class LogicalPlan:
+    schema: T.StructType
+
+    @property
+    def children(self) -> Tuple["LogicalPlan", ...]:
+        return ()
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+
+@dataclasses.dataclass
+class InMemoryRelation(LogicalPlan):
+    table: pa.Table
+    schema: T.StructType
+    num_partitions: int = 1
+
+    @property
+    def name(self):
+        return "InMemoryRelation"
+
+
+@dataclasses.dataclass
+class ParquetRelation(LogicalPlan):
+    paths: List[str]
+    schema: T.StructType
+
+
+@dataclasses.dataclass
+class Project(LogicalPlan):
+    child: LogicalPlan
+    exprs: List[Expression]
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Filter(LogicalPlan):
+    child: LogicalPlan
+    condition: Expression
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Aggregate(LogicalPlan):
+    child: LogicalPlan
+    grouping: List[Expression]
+    aggregates: List[AggregateFunction]
+    schema: T.StructType  # grouping cols then agg results
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Sort(LogicalPlan):
+    child: LogicalPlan
+    orders: List[SortOrder]
+    global_sort: bool = True
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Limit(LogicalPlan):
+    child: LogicalPlan
+    n: int
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Join(LogicalPlan):
+    left: LogicalPlan
+    right: LogicalPlan
+    join_type: str  # inner, left, right, full, left_semi, left_anti, cross
+    left_keys: List[Expression]
+    right_keys: List[Expression]
+    condition: Optional[Expression]
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass
+class Union(LogicalPlan):
+    inputs: List[LogicalPlan]
+
+    @property
+    def schema(self):
+        return self.inputs[0].schema
+
+    @property
+    def children(self):
+        return tuple(self.inputs)
+
+
+@dataclasses.dataclass
+class Repartition(LogicalPlan):
+    child: LogicalPlan
+    num_partitions: int
+    keys: Optional[List[Expression]] = None  # None = round robin
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)

@@ -1,0 +1,184 @@
+"""User-facing Column API — unresolved expression trees.
+
+Mirrors pyspark's ``Column``: operator overloading builds an unresolved
+tree; resolution against a schema (plan/analysis.py) produces bound, typed
+``ops.expressions`` nodes with Spark's implicit-cast coercion applied.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+
+@dataclasses.dataclass
+class UExpr:
+    """Unresolved expression node: (op, payload, children)."""
+
+    op: str
+    payload: Any = None
+    children: Tuple["UExpr", ...] = ()
+
+    def __str__(self):
+        if self.op == "attr":
+            return str(self.payload)
+        if self.op == "lit":
+            return repr(self.payload)
+        return f"{self.op}({', '.join(str(c) for c in self.children)})"
+
+
+def _to_uexpr(v) -> UExpr:
+    if isinstance(v, Column):
+        return v._u
+    if isinstance(v, UExpr):
+        return v
+    return UExpr("lit", v)
+
+
+class Column:
+    def __init__(self, u: UExpr):
+        self._u = u
+
+    # arithmetic ----------------------------------------------------------
+    def _bin(self, op, other, reverse=False):
+        l, r = self._u, _to_uexpr(other)
+        if reverse:
+            l, r = r, l
+        return Column(UExpr(op, None, (l, r)))
+
+    def __add__(self, o):
+        return self._bin("add", o)
+
+    def __radd__(self, o):
+        return self._bin("add", o, True)
+
+    def __sub__(self, o):
+        return self._bin("sub", o)
+
+    def __rsub__(self, o):
+        return self._bin("sub", o, True)
+
+    def __mul__(self, o):
+        return self._bin("mul", o)
+
+    def __rmul__(self, o):
+        return self._bin("mul", o, True)
+
+    def __truediv__(self, o):
+        return self._bin("div", o)
+
+    def __rtruediv__(self, o):
+        return self._bin("div", o, True)
+
+    def __mod__(self, o):
+        return self._bin("mod", o)
+
+    def __neg__(self):
+        return Column(UExpr("neg", None, (self._u,)))
+
+    # comparisons ---------------------------------------------------------
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin("eq", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Column(UExpr("not", None, (self._bin("eq", o)._u,)))
+
+    def __lt__(self, o):
+        return self._bin("lt", o)
+
+    def __le__(self, o):
+        return self._bin("le", o)
+
+    def __gt__(self, o):
+        return self._bin("gt", o)
+
+    def __ge__(self, o):
+        return self._bin("ge", o)
+
+    def eqNullSafe(self, o):
+        return self._bin("eqns", o)
+
+    # logic ---------------------------------------------------------------
+    def __and__(self, o):
+        return self._bin("and", o)
+
+    def __rand__(self, o):
+        return self._bin("and", o, True)
+
+    def __or__(self, o):
+        return self._bin("or", o)
+
+    def __ror__(self, o):
+        return self._bin("or", o, True)
+
+    def __invert__(self):
+        return Column(UExpr("not", None, (self._u,)))
+
+    # misc ----------------------------------------------------------------
+    def alias(self, name: str) -> "Column":
+        return Column(UExpr("alias", name, (self._u,)))
+
+    def cast(self, dtype) -> "Column":
+        return Column(UExpr("cast", dtype, (self._u,)))
+
+    def isNull(self) -> "Column":
+        return Column(UExpr("isnull", None, (self._u,)))
+
+    def isNotNull(self) -> "Column":
+        return Column(UExpr("isnotnull", None, (self._u,)))
+
+    def isNaN(self) -> "Column":
+        return Column(UExpr("isnan", None, (self._u,)))
+
+    def between(self, low, high) -> "Column":
+        return (self >= low) & (self <= high)
+
+    def when(self, cond: "Column", value) -> "Column":
+        raise TypeError("use functions.when(...)")
+
+    def otherwise(self, value) -> "Column":
+        u = self._u
+        if u.op != "casewhen":
+            raise TypeError("otherwise() only follows when()")
+        return Column(UExpr("casewhen", u.payload,
+                            u.children + (_to_uexpr(value),)))
+
+    def asc(self) -> "Column":
+        return Column(UExpr("sortorder", ("asc", "nulls_first"), (self._u,)))
+
+    def desc(self) -> "Column":
+        return Column(UExpr("sortorder", ("desc", "nulls_last"), (self._u,)))
+
+    def substr(self, start, length) -> "Column":
+        return Column(UExpr("substring", (start, length), (self._u,)))
+
+    def startswith(self, o) -> "Column":
+        return self._bin("startswith", o)
+
+    def endswith(self, o) -> "Column":
+        return self._bin("endswith", o)
+
+    def contains(self, o) -> "Column":
+        return self._bin("contains", o)
+
+    def __str__(self):
+        return str(self._u)
+
+    def __repr__(self):
+        return f"Column<{self._u}>"
+
+    def __hash__(self):
+        return id(self)
+
+    def __bool__(self):
+        raise ValueError(
+            "Cannot convert Column to bool: use '&' for 'and', '|' for "
+            "'or', '~' for 'not' in DataFrame filter expressions.")
+
+
+def col(name: str) -> Column:
+    return Column(UExpr("attr", name))
+
+
+def lit(value) -> Column:
+    return Column(UExpr("lit", value))

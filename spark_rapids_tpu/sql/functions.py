@@ -1,0 +1,96 @@
+"""pyspark.sql.functions-compatible function surface (growing)."""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.sql.column import Column, UExpr, _to_uexpr, col, lit  # noqa: F401
+
+
+def _unary(op):
+    def fn(c) -> Column:
+        return Column(UExpr(op, None, (_to_uexpr(c),)))
+    fn.__name__ = op
+    return fn
+
+
+def _binary(op):
+    def fn(a, b) -> Column:
+        return Column(UExpr(op, None, (_to_uexpr(a), _to_uexpr(b))))
+    fn.__name__ = op
+    return fn
+
+
+sqrt = _unary("sqrt")
+exp = _unary("exp")
+log = _unary("log")
+abs = _unary("abs")  # noqa: A001
+floor = _unary("floor")
+ceil = _unary("ceil")
+year = _unary("year")
+month = _unary("month")
+dayofmonth = _unary("dayofmonth")
+upper = _unary("upper")
+lower = _unary("lower")
+length = _unary("length")
+isnan = _unary("isnan")
+
+pow = _binary("pow")  # noqa: A001
+date_add = _binary("date_add")
+date_sub = _binary("date_sub")
+datediff = _binary("datediff")
+concat = None  # set below (variadic)
+
+
+def round(c, scale=0) -> Column:  # noqa: A001
+    return Column(UExpr("round", scale, (_to_uexpr(c),)))
+
+
+def coalesce(*cols) -> Column:
+    return Column(UExpr("coalesce", None, tuple(_to_uexpr(c) for c in cols)))
+
+
+def when(cond: Column, value) -> Column:
+    return Column(UExpr("casewhen", None,
+                        (_to_uexpr(cond), _to_uexpr(value))))
+
+
+def substring(c, pos, length) -> Column:
+    return Column(UExpr("substring", (pos, length), (_to_uexpr(c),)))
+
+
+def concat_impl(*cols) -> Column:
+    return Column(UExpr("concat", None, tuple(_to_uexpr(c) for c in cols)))
+
+
+concat = concat_impl
+
+
+def hash(*cols) -> Column:  # noqa: A001
+    """Spark murmur3 hash (seed 42)."""
+    return Column(UExpr("hash", None, tuple(_to_uexpr(c) for c in cols)))
+
+
+# aggregate functions -------------------------------------------------------
+
+def _agg(op):
+    def fn(c) -> Column:
+        return Column(UExpr("agg", op, (_to_uexpr(c),)))
+    fn.__name__ = op
+    return fn
+
+
+sum = _agg("sum")  # noqa: A001
+min = _agg("min")  # noqa: A001
+max = _agg("max")  # noqa: A001
+avg = _agg("avg")
+mean = _agg("avg")
+first = _agg("first")
+
+
+def count(c) -> Column:
+    if isinstance(c, str) and c == "*":
+        return Column(UExpr("agg", "count_star", (UExpr("lit", 1),)))
+    return Column(UExpr("agg", "count", (_to_uexpr(c),)))
+
+
+def countDistinct(c) -> Column:
+    return Column(UExpr("agg", "count_distinct", (_to_uexpr(c),)))

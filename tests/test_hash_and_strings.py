@@ -1,0 +1,105 @@
+"""Murmur3 bit-exactness and string-op CPU-vs-TPU tests."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops import hashing as HH
+from spark_rapids_tpu.ops import expressions as E
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.utils import datagen as dg
+from tests.test_expressions import check, eval_both, ref
+
+
+def test_spark_hash_known_vectors():
+    # published Spark value: SELECT hash('Spark') == 228093765
+    assert HH.spark_hash_py(["Spark"], [T.StringT]) == 228093765
+    # null leaves seed: hash(null) == 42
+    assert HH.spark_hash_py([None], [T.IntegerT]) == 42
+
+
+def test_hash_python_vs_numpy_vs_jax_ints():
+    tbl = dg.gen_table([dg.IntegerGen(), dg.LongGen()], 300, seed=11)
+    expr = HH.Murmur3Hash([ref(tbl, 0), ref(tbl, 1)])
+    cpu, tpu = eval_both(expr, tbl)
+    assert cpu.to_pylist() == tpu.to_pylist()
+    # scalar reference spot check
+    a = tbl.column(0).to_pylist()
+    b = tbl.column(1).to_pylist()
+    out = cpu.to_pylist()
+    for i in range(0, 300, 37):
+        expect = HH.spark_hash_py([a[i], b[i]], [T.IntegerT, T.LongT])
+        assert out[i] == expect, i
+
+
+@pytest.mark.parametrize("gen", [dg.FloatGen(), dg.DoubleGen(),
+                                 dg.BooleanGen(), dg.DateGen(),
+                                 dg.TimestampGen(), dg.StringGen(max_len=13)],
+                         ids=lambda g: str(g.dtype))
+def test_hash_cpu_tpu_equal(gen):
+    tbl = dg.gen_table([gen], 300, seed=12)
+    expr = HH.Murmur3Hash([ref(tbl, 0)])
+    cpu, tpu = eval_both(expr, tbl)
+    assert cpu.to_pylist() == tpu.to_pylist()
+
+
+def test_hash_string_scalar_reference():
+    tbl = dg.gen_table([dg.StringGen(max_len=11)], 64, seed=13)
+    expr = HH.Murmur3Hash([ref(tbl, 0)])
+    cpu, _ = eval_both(expr, tbl)
+    vals = tbl.column(0).to_pylist()
+    out = cpu.to_pylist()
+    for i in range(64):
+        assert out[i] == HH.spark_hash_py([vals[i]], [T.StringT]), (i, vals[i])
+
+
+def test_string_comparisons():
+    tbl = dg.gen_table([dg.StringGen(max_len=8), dg.StringGen(max_len=8)],
+                       300, seed=14)
+    for op in ["eq", "lt", "le", "gt", "ge", "eqns"]:
+        check(S.StringComparison(op, ref(tbl, 0), ref(tbl, 1)), tbl)
+
+
+def test_string_compare_prefix_case():
+    tbl = pa.table({"a": pa.array(["abc", "ab", "abc", ""]),
+                    "b": pa.array(["ab", "abc", "abc", "x"])})
+    cpu, tpu = eval_both(S.StringComparison("lt", ref(tbl, 0), ref(tbl, 1)), tbl)
+    assert cpu.to_pylist() == [False, True, False, True] == tpu.to_pylist()
+
+
+def test_length_utf8_codepoints():
+    tbl = pa.table({"s": pa.array(["", "abc", "héllo", "日本語", None])})
+    cpu, tpu = eval_both(S.Length(ref(tbl, 0)), tbl)
+    assert cpu.to_pylist() == [0, 3, 5, 3, None]
+    assert tpu.to_pylist() == [0, 3, 5, 3, None]
+
+
+def test_upper_lower_substring():
+    tbl = dg.gen_table([dg.StringGen(max_len=12)], 200, seed=15)
+    check(S.Upper(ref(tbl, 0)), tbl)
+    check(S.Lower(ref(tbl, 0)), tbl)
+    check(S.Substring(ref(tbl, 0), 2, 3), tbl)
+    check(S.Substring(ref(tbl, 0), -4, 2), tbl)
+    check(S.Substring(ref(tbl, 0), 1, 100), tbl)
+
+
+def test_string_predicates_literal():
+    tbl = pa.table({"s": pa.array(["apple", "applesauce", "grape", "ap",
+                                   None, "pineapple"])})
+    lit = E.Literal("apple", T.StringT)
+    cpu, tpu = eval_both(S.StringPredicate("startswith", ref(tbl, 0), lit), tbl)
+    assert cpu.to_pylist() == [True, True, False, False, None, False]
+    assert tpu.to_pylist() == cpu.to_pylist()
+    cpu, tpu = eval_both(S.StringPredicate("contains", ref(tbl, 0), lit), tbl)
+    assert cpu.to_pylist() == [True, True, False, False, None, True]
+    assert tpu.to_pylist() == cpu.to_pylist()
+    cpu, tpu = eval_both(S.StringPredicate("endswith", ref(tbl, 0), lit), tbl)
+    assert cpu.to_pylist() == [True, False, False, False, None, True]
+    assert tpu.to_pylist() == cpu.to_pylist()
+
+
+def test_concat():
+    tbl = dg.gen_table([dg.StringGen(max_len=6), dg.StringGen(max_len=6)],
+                       200, seed=16)
+    check(S.Concat([ref(tbl, 0), ref(tbl, 1)]), tbl)
