@@ -165,8 +165,9 @@ EXPLAIN = (
     .doc("Explain mode for plan conversion: NONE, ALL, or NOT_ON_GPU "
          "(log every operator that could not be accelerated and why).")
     .string()
-    .check(lambda v: v.upper() in ("NONE", "ALL", "NOT_ON_GPU"),
-           "one of NONE, ALL, NOT_ON_GPU")
+    .check(lambda v: v.upper() in ("NONE", "ALL", "NOT_ON_GPU",
+                                   "NOT_ON_TPU"),
+           "one of NONE, ALL, NOT_ON_GPU, NOT_ON_TPU")
     .create_with_default("NONE")
 )
 

@@ -1,0 +1,577 @@
+"""Hash-aggregate execs (CPU oracle + TPU sort-based groupby).
+
+[REF: sql-plugin/../GpuAggregateExec.scala :: GpuHashAggregateExec,
+ AggHelper, GpuAggregateIterator] — the reference drives cuDF's hash
+groupby; here the device groupby is **sort-based** (SURVEY.md §7 phase 3:
+"XLA sort-based groupby first — lax.sort + segment-reduce — hash tables in
+Pallas later"):
+
+  encode keys as uint64 limbs (ops/ordering.py) → one stable
+  ``lax.sort`` → group boundaries → ``segment_sum/min/max`` with a static
+  segment count = the batch bucket → group representatives scattered to
+  the front.
+
+Everything is static-shape: a (schema, bucket) pair compiles once.  The
+partial/merge/final split mirrors the reference exactly — partial buffers
+(sum+count, min, max, first) are themselves columns, merged by the same
+segment reduction keyed on ``AggregateFunction.buffer_kinds``, so
+multi-batch and (later) post-shuffle final aggregation reuse one kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+from spark_rapids_tpu.exec.basic import concat_device_batches
+from spark_rapids_tpu.ops import ordering as ORD
+from spark_rapids_tpu.ops.aggregates import (
+    AggregateFunction, Average, Count, CountStar, First, Max, Min, Sum)
+from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.plan import logical as L
+
+
+# ---------------------------------------------------------------------------
+# Orderable encode/decode for single-limb types (min/max reductions ride
+# uint64 so NaN/sign semantics match Spark's total order exactly)
+# ---------------------------------------------------------------------------
+
+def encode_orderable(data: jnp.ndarray, dt: T.DataType) -> jnp.ndarray:
+    """Non-float column → order-preserving uint64 (floats stay raw — the
+    TPU x64-rewrite cannot compile 64-bit bitcasts, so float reductions
+    use the NaN-aware float path instead of orderable bits)."""
+    assert not isinstance(dt, (T.FloatType, T.DoubleType))
+    if isinstance(dt, T.BooleanType):
+        return data.astype(jnp.uint64)
+    return ORD._i_to_u64(data)
+
+
+def decode_orderable(u: jnp.ndarray, dt: T.DataType) -> jnp.ndarray:
+    assert not isinstance(dt, (T.FloatType, T.DoubleType))
+    if isinstance(dt, T.BooleanType):
+        return u.astype(jnp.bool_)
+    signed = (u ^ jnp.uint64(1 << 63)).astype(jnp.int64)
+    return signed.astype(T.to_numpy_dtype(dt))
+
+
+def _is_float(dt: T.DataType) -> bool:
+    return isinstance(dt, (T.FloatType, T.DoubleType))
+
+
+# ---------------------------------------------------------------------------
+# The device groupby kernel
+# ---------------------------------------------------------------------------
+
+def segment_groupby(
+    key_cols: Sequence[DeviceColumn],
+    sel: jnp.ndarray,
+    value_cols: Sequence[Tuple[DeviceColumn, str]],
+) -> Tuple[List[DeviceColumn], List[DeviceColumn], jnp.ndarray]:
+    """Group rows by keys; reduce values by kind ('sum'|'min'|'max'|'first').
+
+    Returns (out_key_cols, out_value_cols, out_sel) — groups compacted to
+    the front, capacity unchanged (static shape).
+    """
+    b = int(sel.shape[0])
+    dead = (~sel).astype(jnp.uint64)
+    limbs = [dead] + ORD.batch_group_keys(list(key_cols))
+    sorted_limbs, perm = ORD.sort_by_keys(
+        limbs, jnp.arange(b, dtype=jnp.int32))
+
+    live_sorted = sorted_limbs[0] == 0
+    diff = jnp.zeros((b,), jnp.bool_)
+    for l in sorted_limbs:
+        diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
+    boundary = diff.at[0].set(True)  # row 0 always starts a group
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    num_groups = jnp.sum((boundary & live_sorted).astype(jnp.int32))
+
+    # representative (first sorted) row per live group → scatter to front
+    rep_target = jnp.where(boundary & live_sorted, gid, b)
+
+    def scatter_rep(x_sorted):
+        shape = (b,) + x_sorted.shape[1:]
+        out = jnp.zeros(shape, x_sorted.dtype)
+        return out.at[rep_target].set(x_sorted, mode="drop")
+
+    out_keys = []
+    for c in key_cols:
+        data_s = jnp.take(c.data, perm, axis=0)
+        validity = None
+        if c.validity is not None:
+            validity = scatter_rep(jnp.take(c.validity, perm))
+        lengths = None
+        if c.lengths is not None:
+            lengths = scatter_rep(jnp.take(c.lengths, perm))
+        out_keys.append(DeviceColumn(c.dtype, scatter_rep(data_s),
+                                     validity, lengths))
+
+    first_pos = jax.ops.segment_min(
+        jnp.arange(b, dtype=jnp.int32), gid, num_segments=b)
+
+    out_vals = []
+    for c, kind in value_cols:
+        data_s = jnp.take(c.data, perm, axis=0)
+        valid_s = (jnp.take(c.validity, perm) if c.validity is not None
+                   else jnp.ones((b,), jnp.bool_))
+        contrib = valid_s & live_sorted
+        if kind == "sum":
+            masked = jnp.where(contrib, data_s,
+                               jnp.zeros((), data_s.dtype))
+            agg = jax.ops.segment_sum(masked, gid, num_segments=b)
+            validity = jax.ops.segment_sum(
+                contrib.astype(jnp.int32), gid, num_segments=b) > 0
+        elif kind in ("min", "max"):
+            n_contrib = jax.ops.segment_sum(
+                contrib.astype(jnp.int32), gid, num_segments=b)
+            if _is_float(c.dtype):
+                # Spark float total order: NaN greatest.  No 64-bit
+                # bitcasts on TPU, so reduce raw floats with NaN masked
+                # out and reinstate NaN per the order semantics.
+                isn = jnp.isnan(data_s)
+                real = contrib & ~isn
+                n_real = jax.ops.segment_sum(
+                    real.astype(jnp.int32), gid, num_segments=b)
+                inf = jnp.asarray(np.inf, data_s.dtype)
+                if kind == "min":
+                    agg = jax.ops.segment_min(
+                        jnp.where(real, data_s, inf), gid, num_segments=b)
+                    # all-NaN group → min is NaN
+                    agg = jnp.where((n_real == 0) & (n_contrib > 0),
+                                    jnp.asarray(np.nan, data_s.dtype), agg)
+                else:
+                    agg = jax.ops.segment_max(
+                        jnp.where(real, data_s, -inf), gid, num_segments=b)
+                    any_nan = jax.ops.segment_sum(
+                        (contrib & isn).astype(jnp.int32), gid,
+                        num_segments=b) > 0
+                    agg = jnp.where(any_nan,
+                                    jnp.asarray(np.nan, data_s.dtype), agg)
+            else:
+                u = encode_orderable(data_s, c.dtype)
+                sentinel = jnp.uint64(
+                    0xFFFFFFFFFFFFFFFF if kind == "min" else 0)
+                masked = jnp.where(contrib, u, sentinel)
+                red = (jax.ops.segment_min if kind == "min"
+                       else jax.ops.segment_max)
+                agg = decode_orderable(
+                    red(masked, gid, num_segments=b), c.dtype)
+            validity = n_contrib > 0
+        elif kind == "first":
+            pos = jnp.clip(first_pos, 0, b - 1)
+            agg = jnp.take(data_s, pos, axis=0)
+            validity = jnp.take(valid_s, pos)
+        else:
+            raise ValueError(f"unknown reduction kind {kind}")
+        out_vals.append(DeviceColumn(c.dtype, agg, validity, None))
+
+    out_sel = jnp.arange(b, dtype=jnp.int32) < num_groups
+    return out_keys, out_vals, out_sel
+
+
+# ---------------------------------------------------------------------------
+# Partial update / final projection per aggregate function
+# ---------------------------------------------------------------------------
+
+def _eval_child(fn: AggregateFunction, batch: DeviceBatch) -> DeviceColumn:
+    return fn.child.eval_tpu(batch)
+
+
+def update_value_cols(fns: Sequence[AggregateFunction], batch: DeviceBatch
+                      ) -> List[Tuple[DeviceColumn, str]]:
+    """Per-batch buffer inputs for the partial (update) pass."""
+    out: List[Tuple[DeviceColumn, str]] = []
+    for fn in fns:
+        if isinstance(fn, CountStar):
+            ones = DeviceColumn(T.LongT,
+                                jnp.ones((batch.capacity,), jnp.int64))
+            out.append((ones, "sum"))
+            continue
+        c = _eval_child(fn, batch)
+        valid = c.valid_mask()
+        if isinstance(fn, Count):
+            out.append((DeviceColumn(
+                T.LongT, valid.astype(jnp.int64)), "sum"))
+        elif isinstance(fn, (Sum, Average)):
+            rdt = fn.buffer_dtypes()[0]
+            data = c.data.astype(T.to_numpy_dtype(rdt))
+            out.append((DeviceColumn(rdt, data, c.validity), "sum"))
+            out.append((DeviceColumn(
+                T.LongT, valid.astype(jnp.int64)), "sum"))
+        elif isinstance(fn, (Min, Max)):
+            out.append((c, "min" if isinstance(fn, Min) else "max"))
+        elif isinstance(fn, First):
+            out.append((c, "first"))
+        else:
+            raise NotImplementedError(f"TPU aggregate {fn.name}")
+    return out
+
+
+def merge_kinds(fns: Sequence[AggregateFunction]) -> List[str]:
+    kinds: List[str] = []
+    for fn in fns:
+        kinds.extend(fn.buffer_kinds)
+    return kinds
+
+
+def final_project(fns: Sequence[AggregateFunction],
+                  bufs: List[DeviceColumn]) -> List[DeviceColumn]:
+    out: List[DeviceColumn] = []
+    i = 0
+    for fn in fns:
+        nb = len(fn.buffer_kinds)
+        mine = bufs[i:i + nb]
+        i += nb
+        if isinstance(fn, (Count, CountStar)):
+            out.append(DeviceColumn(T.LongT, mine[0].data, None))
+        elif isinstance(fn, Sum):
+            s, cnt = mine
+            out.append(DeviceColumn(fn.result_dtype, s.data,
+                                    cnt.data > 0))
+        elif isinstance(fn, Average):
+            s, cnt = mine
+            denom = jnp.where(cnt.data > 0, cnt.data, 1)
+            out.append(DeviceColumn(
+                T.DoubleT, s.data / denom.astype(jnp.float64),
+                cnt.data > 0))
+        else:  # Min/Max/First: buffer is the result
+            out.append(mine[0])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TPU exec
+# ---------------------------------------------------------------------------
+
+class TpuHashAggregateExec(TpuExec):
+    """Complete-mode aggregate: update per batch → merge partials → final.
+
+    Gathers all child partitions (the single-partition exchange analog)
+    until the distributed exchange lands. [REF: GpuHashAggregateExec]
+    """
+
+    def __init__(self, grouping: Sequence[Expression],
+                 fns: Sequence[AggregateFunction],
+                 schema: T.StructType, child: TpuExec):
+        super().__init__(schema, child)
+        self.grouping = list(grouping)
+        self.fns = list(fns)
+
+    def node_string(self):
+        keys = ", ".join(str(g) for g in self.grouping)
+        aggs = ", ".join(fn.name for fn in self.fns)
+        return f"TpuHashAggregate [keys=[{keys}] aggs=[{aggs}]]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def _partial(self, batch: DeviceBatch) -> DeviceBatch:
+        keys = [g.eval_tpu(batch) for g in self.grouping]
+        vals = update_value_cols(self.fns, batch)
+        ok, ov, sel = segment_groupby(keys, batch.sel, vals)
+        return DeviceBatch(self._buffer_schema(), tuple(ok + ov), sel)
+
+    def _buffer_schema(self) -> T.StructType:
+        fields = [T.StructField(f"k{i}", g.dtype)
+                  for i, g in enumerate(self.grouping)]
+        j = 0
+        for fn in self.fns:
+            for bd in fn.buffer_dtypes():
+                fields.append(T.StructField(f"b{j}", bd))
+                j += 1
+        return T.StructType(tuple(fields))
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        assert partition == 0
+        child = self.children[0]
+        partials: List[DeviceBatch] = []
+        with self.timer():
+            for p in range(child.num_partitions()):
+                for b in child.execute(p):
+                    partials.append(self._partial(b))
+            if not self.grouping:
+                yield self._reduce_no_keys(partials)
+                return
+            from spark_rapids_tpu.columnar.column import compact
+            merged = concat_device_batches(
+                self._buffer_schema(), [compact(p) for p in partials])
+            nk = len(self.grouping)
+            keys = list(merged.columns[:nk])
+            bufs = list(merged.columns[nk:])
+            kinds = merge_kinds(self.fns)
+            ok, ov, sel = segment_groupby(
+                keys, merged.sel, list(zip(bufs, kinds)))
+            results = final_project(self.fns, ov)
+            out = DeviceBatch(self.schema, tuple(ok + results), sel)
+        self.metric("numOutputBatches").add(1)
+        yield out
+
+    def _reduce_no_keys(self, partials: List[DeviceBatch]) -> DeviceBatch:
+        """Global (no grouping) aggregate → exactly one output row.
+
+        Merges each partial batch's buffer column with the reduction named
+        by its ``buffer_kind`` (sum of sums, min of mins, first found
+        first).  Floats reduce via the NaN-aware path (no 64-bit bitcasts
+        on TPU — see ``segment_groupby``).
+        """
+        kinds = merge_kinds(self.fns)
+        bufs: List[DeviceColumn] = []
+        for j, kind in enumerate(kinds):
+            dt = partials[0].columns[j].dtype
+            acc_data = None   # device scalar accumulator
+            acc_valid = None  # device bool: any contributing value seen
+            acc_nan = None    # floats only: NaN bookkeeping for min/max
+            for p in partials:
+                c = p.columns[j]
+                valid = c.valid_mask() & p.sel
+                got = jnp.any(valid)
+                if kind == "sum":
+                    v = jnp.sum(jnp.where(valid, c.data,
+                                          jnp.zeros((), c.data.dtype)))
+                    if acc_data is None:
+                        acc_data, acc_valid = v, got
+                    else:
+                        acc_data, acc_valid = acc_data + v, acc_valid | got
+                elif kind in ("min", "max"):
+                    if _is_float(dt):
+                        isn = jnp.isnan(c.data)
+                        real = valid & ~isn
+                        inf = jnp.asarray(np.inf, c.data.dtype)
+                        sent = inf if kind == "min" else -inf
+                        v = (jnp.min(jnp.where(real, c.data, sent))
+                             if kind == "min"
+                             else jnp.max(jnp.where(real, c.data, sent)))
+                        has_nan = jnp.any(valid & isn)
+                        has_real = jnp.any(real)
+                        if acc_data is None:
+                            acc_data, acc_valid = v, got
+                            acc_nan = (has_nan, has_real)
+                        else:
+                            acc_data = (jnp.minimum(acc_data, v)
+                                        if kind == "min"
+                                        else jnp.maximum(acc_data, v))
+                            acc_valid = acc_valid | got
+                            acc_nan = (acc_nan[0] | has_nan,
+                                       acc_nan[1] | has_real)
+                    else:
+                        u = encode_orderable(c.data, dt)
+                        sentinel = jnp.uint64(
+                            0xFFFFFFFFFFFFFFFF if kind == "min" else 0)
+                        u = jnp.where(valid, u, sentinel)
+                        v = jnp.min(u) if kind == "min" else jnp.max(u)
+                        if acc_data is None:
+                            acc_data, acc_valid = v, got
+                        else:
+                            acc_data = (jnp.minimum(acc_data, v)
+                                        if kind == "min"
+                                        else jnp.maximum(acc_data, v))
+                            acc_valid = acc_valid | got
+                else:  # first: value (null included) of the first live row
+                    has_row = jnp.any(p.sel)
+                    idx = jnp.argmax(p.sel)
+                    v = c.data[idx]
+                    vv = (c.validity[idx] if c.validity is not None
+                          else jnp.asarray(True))
+                    if acc_data is None:
+                        # acc_valid here = validity of the chosen value;
+                        # acc_nan reused as "found a live row yet"
+                        acc_data = jnp.where(has_row, v,
+                                             jnp.zeros((), v.dtype))
+                        acc_valid = vv & has_row
+                        acc_nan = has_row
+                    else:
+                        take_new = (~acc_nan) & has_row
+                        acc_data = jnp.where(take_new, v, acc_data)
+                        acc_valid = jnp.where(take_new, vv, acc_valid)
+                        acc_nan = acc_nan | has_row
+            if kind in ("min", "max") and not _is_float(dt):
+                acc_data = decode_orderable(jnp.reshape(acc_data, (1,)), dt)
+            elif kind in ("min", "max") and _is_float(dt):
+                # NaN is greatest: max ⇒ NaN if any NaN seen; min ⇒ NaN
+                # only when NaNs were the only contributing values
+                any_nan, any_real = acc_nan
+                make_nan = (any_nan & ~any_real if kind == "min"
+                            else any_nan)
+                acc_data = jnp.reshape(jnp.where(
+                    make_nan, jnp.asarray(np.nan, acc_data.dtype),
+                    acc_data), (1,))
+            else:
+                acc_data = jnp.reshape(acc_data, (1,))
+            bufs.append(DeviceColumn(dt, acc_data,
+                                     jnp.reshape(acc_valid, (1,))))
+        results = final_project(self.fns, bufs)
+        # pad the single row to the minimum bucket
+        bucket = 8
+        cols = []
+        for c in results:
+            data = jnp.pad(c.data, (0, bucket - 1))
+            validity = (None if c.validity is None
+                        else jnp.pad(c.validity, (0, bucket - 1)))
+            cols.append(DeviceColumn(c.dtype, data, validity))
+        sel = jnp.arange(bucket, dtype=jnp.int32) < 1
+        return DeviceBatch(self.schema, tuple(cols), sel)
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle exec
+# ---------------------------------------------------------------------------
+
+class CpuAggregateExec(CpuExec):
+    def __init__(self, grouping: Sequence[Expression],
+                 fns: Sequence[AggregateFunction],
+                 schema: T.StructType, child: CpuExec):
+        super().__init__(schema, child)
+        self.grouping = list(grouping)
+        self.fns = list(fns)
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        child = self.children[0]
+        groups = {}
+        order: List[tuple] = []
+        for p in range(child.num_partitions()):
+            for b in child.execute(p):
+                n = b.num_rows
+                key_cols = [g.eval_cpu(b) for g in self.grouping]
+                val_cols = [None if isinstance(fn, CountStar)
+                            else fn.child.eval_cpu(b) for fn in self.fns]
+                for i in range(n):
+                    key = tuple(
+                        None if (kc.validity is not None
+                                 and not kc.validity[i])
+                        else _norm_key(kc.data[i], kc.dtype)
+                        for kc in key_cols)
+                    st = groups.get(key)
+                    if st is None:
+                        st = [_new_acc(fn) for fn in self.fns]
+                        groups[key] = st
+                        order.append(key)
+                    for acc, fn, vc in zip(st, self.fns, val_cols):
+                        _acc_update(acc, fn, vc, i)
+        if not self.grouping and not groups:
+            groups[()] = [_new_acc(fn) for fn in self.fns]
+            order.append(())
+        rows = []
+        for key in order:
+            st = groups[key]
+            rows.append(list(key) + [_acc_final(a, fn)
+                                     for a, fn in zip(st, self.fns)])
+        cols = list(zip(*rows)) if rows else [[] for _ in self.schema.fields]
+        out_cols = []
+        for vals, f in zip(cols, self.schema.fields):
+            vals = list(vals)
+            validity = np.array([v is not None for v in vals], bool)
+            if isinstance(f.dtype, (T.StringType, T.BinaryType)):
+                data = np.array([v if v is not None else "" for v in vals],
+                                dtype=object)
+            else:
+                npdt = T.to_numpy_dtype(f.dtype)
+                data = np.array([v if v is not None else 0 for v in vals])
+                data = data.astype(npdt, copy=False)
+            out_cols.append(H.HostCol(
+                f.dtype, data, None if validity.all() else validity))
+        yield H.HostBatch(self.schema, out_cols)
+
+
+def _norm_key(v, dt):
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        f = float(v)
+        if np.isnan(f):
+            return "NaN"
+        if f == 0.0:
+            return 0.0  # -0.0 and 0.0 one group (Spark normalizes keys)
+        return f
+    if isinstance(dt, T.BooleanType):
+        return bool(v)
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        return v
+    return int(v)
+
+
+def _new_acc(fn):
+    return {"sum": 0, "count": 0, "min": None, "max": None, "first": None,
+            "has_first": False}
+
+
+def _acc_update(acc, fn, vc, i):
+    if isinstance(fn, CountStar):
+        acc["count"] += 1
+        return
+    valid = vc.validity is None or bool(vc.validity[i])
+    if isinstance(fn, First):
+        if not acc["has_first"]:
+            acc["first"] = vc.data[i] if valid else None
+            acc["has_first"] = True
+        return
+    if not valid:
+        return
+    v = vc.data[i]
+    if isinstance(fn, Count):
+        acc["count"] += 1
+    elif isinstance(fn, (Sum, Average)):
+        acc["count"] += 1
+        if T.is_integral(fn.child.dtype) or isinstance(
+                fn.child.dtype, T.DecimalType):
+            with np.errstate(over="ignore"):  # Spark non-ANSI sum wraps
+                acc["sum"] = np.int64(acc["sum"] + np.int64(v))
+        else:
+            acc["sum"] = float(acc["sum"]) + float(v)
+    elif isinstance(fn, Min):
+        acc["min"] = v if acc["min"] is None else _spark_min(acc["min"], v, fn)
+    elif isinstance(fn, Max):
+        acc["max"] = v if acc["max"] is None else _spark_max(acc["max"], v, fn)
+
+
+def _total_key(v, dt):
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        f = float(v)
+        if np.isnan(f):
+            return (1, 0.0)
+        return (0, f)
+    return (0, v)
+
+
+def _spark_min(a, b, fn):
+    dt = fn.child.dtype
+    return a if _total_key(a, dt) <= _total_key(b, dt) else b
+
+
+def _spark_max(a, b, fn):
+    dt = fn.child.dtype
+    return a if _total_key(a, dt) >= _total_key(b, dt) else b
+
+
+def _acc_final(acc, fn):
+    if isinstance(fn, (Count, CountStar)):
+        return int(acc["count"])
+    if isinstance(fn, Sum):
+        if acc["count"] == 0:
+            return None
+        return acc["sum"]
+    if isinstance(fn, Average):
+        if acc["count"] == 0:
+            return None
+        return float(acc["sum"]) / acc["count"]
+    if isinstance(fn, Min):
+        return acc["min"]
+    if isinstance(fn, Max):
+        return acc["max"]
+    if isinstance(fn, First):
+        return acc["first"]
+    raise NotImplementedError(fn.name)
+
+
+def plan_cpu_aggregate(node: L.Aggregate, child: CpuExec,
+                       conf: RapidsConf) -> CpuExec:
+    return CpuAggregateExec(node.grouping, node.aggregates, node.schema,
+                            child)

@@ -1,0 +1,117 @@
+"""Physical operator base classes.
+
+[REF: sql-plugin/../GpuExec.scala :: GpuExec.internalDoExecuteColumnar,
+ GpuMetrics] — re-designed for this engine's split: ``CpuExec`` nodes pump
+``HostBatch`` (the numpy oracle/fallback path, vanilla-Spark analog) and
+``TpuExec`` nodes pump ``DeviceBatch`` (static-shape XLA path).  Transition
+nodes (exec/transitions.py) convert at the boundary, exactly where the
+reference inserts GpuRowToColumnarExec/GpuColumnarToRowExec.
+
+Execution model: a physical plan is a tree; ``execute(partition)`` returns
+an iterator of batches for that partition (iterator chaining = the
+reference's operator pipelining, SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, Tuple
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+
+class Metric:
+    """One operator metric (opTime, numOutputRows, ...).
+
+    [REF: sql-plugin/../GpuMetrics.scala :: GpuMetric]
+    """
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def add(self, v):
+        self.value += v
+
+
+class MetricTimer:
+    def __init__(self, metric: Metric):
+        self.metric = metric
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.metric.add(time.perf_counter() - self._t0)
+        return False
+
+
+class ExecNode:
+    """Base physical operator."""
+
+    def __init__(self, schema: T.StructType, *children: "ExecNode"):
+        self.schema = schema
+        self._children: Tuple[ExecNode, ...] = children
+        self.metrics: Dict[str, Metric] = {}
+        for m in ("opTime", "numOutputRows", "numOutputBatches"):
+            self.metrics[m] = Metric(m)
+
+    @property
+    def children(self) -> Tuple["ExecNode", ...]:
+        return self._children
+
+    @property
+    def name(self) -> str:
+        return type(self).__name__
+
+    def metric(self, name: str) -> Metric:
+        if name not in self.metrics:
+            self.metrics[name] = Metric(name)
+        return self.metrics[name]
+
+    def timer(self, name: str = "opTime") -> MetricTimer:
+        return MetricTimer(self.metric(name))
+
+    def num_partitions(self) -> int:
+        if self._children:
+            return self._children[0].num_partitions()
+        return 1
+
+    def execute(self, partition: int) -> Iterator:
+        raise NotImplementedError
+
+    # -- plan display -------------------------------------------------------
+    def node_string(self) -> str:
+        return self.name
+
+    def tree_string(self, indent: int = 0) -> str:
+        lines = ["  " * indent + ("*" if self.is_tpu else "") +
+                 self.node_string()]
+        for c in self._children:
+            lines.append(c.tree_string(indent + 1))
+        return "\n".join(lines)
+
+    @property
+    def is_tpu(self) -> bool:
+        return isinstance(self, TpuExec)
+
+    def collect_metrics(self, out=None):
+        out = out if out is not None else []
+        out.append((self.name, {k: m.value for k, m in self.metrics.items()}))
+        for c in self._children:
+            c.collect_metrics(out)
+        return out
+
+
+class CpuExec(ExecNode):
+    """Operator over HostBatch (numpy) — the CPU-fallback / oracle path."""
+
+
+class TpuExec(ExecNode):
+    """Operator over DeviceBatch (jax) — the accelerated path.
+
+    [REF: GpuExec.scala :: GpuExec]
+    """

@@ -1,0 +1,427 @@
+"""Basic physical operators: scan, project, filter, limit, union, range.
+
+[REF: sql-plugin/../basicPhysicalOperators.scala :: GpuProjectExec,
+ GpuFilterExec, GpuRangeExec; GpuUnionExec; limit execs in
+ sql-plugin/../limit.scala]
+
+TPU-first notes:
+* ``TpuFilterExec`` never changes shapes — it ANDs the predicate into the
+  batch ``sel`` mask (null predicate = drop row, Spark semantics).
+  Compaction happens only at deliberate boundaries (shuffle/host transfer).
+* ``TpuProjectExec`` evaluates the bound expression tree; XLA fuses the
+  whole projection into one program per (schema, bucket) via jit caching
+  inside the expression kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import (
+    DeviceBatch, host_to_device, round_up_pow2)
+from spark_rapids_tpu.exec.base import CpuExec, ExecNode, TpuExec
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+def _slice_table(table: pa.Table, num_partitions: int) -> List[pa.Table]:
+    n = table.num_rows
+    if num_partitions <= 1:
+        return [table]
+    step = (n + num_partitions - 1) // num_partitions
+    out = []
+    for i in range(num_partitions):
+        lo = min(i * step, n)
+        out.append(table.slice(lo, min(step, n - lo)))
+    return out
+
+
+class CpuScanExec(CpuExec):
+    """In-memory arrow table scan → HostBatch per partition slice."""
+
+    def __init__(self, table: pa.Table, schema: T.StructType,
+                 num_partitions: int = 1, batch_rows: int = 1 << 20):
+        super().__init__(schema)
+        self.table = table
+        self._num_partitions = num_partitions
+        self.batch_rows = batch_rows
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        part = _slice_table(self.table, self._num_partitions)[partition]
+        for lo in range(0, max(part.num_rows, 1), self.batch_rows):
+            chunk = part.slice(lo, self.batch_rows)
+            if chunk.num_rows == 0 and lo > 0:
+                break
+            with self.timer():
+                b = H.from_arrow_table(chunk)
+                b = H.HostBatch(self.schema, b.columns)
+            self.metric("numOutputRows").add(b.num_rows)
+            self.metric("numOutputBatches").add(1)
+            yield b
+
+
+class TpuScanExec(TpuExec):
+    """In-memory arrow table scan → padded DeviceBatch per partition.
+
+    The H2D transfer point [REF: GpuRowToColumnarExec.scala] — in this
+    engine scans land device-resident batches directly.
+    """
+
+    def __init__(self, table: pa.Table, schema: T.StructType,
+                 num_partitions: int = 1, batch_rows: int = 1 << 20,
+                 min_bucket: int = 1024):
+        super().__init__(schema)
+        self.table = table
+        self._num_partitions = num_partitions
+        self.batch_rows = batch_rows
+        self.min_bucket = min_bucket
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        part = _slice_table(self.table, self._num_partitions)[partition]
+        for lo in range(0, max(part.num_rows, 1), self.batch_rows):
+            chunk = part.slice(lo, self.batch_rows)
+            if chunk.num_rows == 0 and lo > 0:
+                break
+            with self.timer():
+                b = host_to_device(chunk, min_bucket=self.min_bucket)
+                b = DeviceBatch(self.schema, b.columns, b.sel)
+            self.metric("numOutputRows").add(int(np.sum(np.asarray(b.sel))))
+            self.metric("numOutputBatches").add(1)
+            yield b
+
+
+class CpuProjectExec(CpuExec):
+    def __init__(self, exprs: Sequence[Expression], schema: T.StructType,
+                 child: CpuExec):
+        super().__init__(schema, child)
+        self.exprs = list(exprs)
+
+    def node_string(self):
+        return f"Project [{', '.join(str(e) for e in self.exprs)}]"
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        for b in self.children[0].execute(partition):
+            with self.timer():
+                cols = [e.eval_cpu(b) for e in self.exprs]
+                out = H.HostBatch(self.schema, cols)
+            self.metric("numOutputRows").add(out.num_rows)
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+class TpuProjectExec(TpuExec):
+    """[REF: basicPhysicalOperators.scala :: GpuProjectExec]"""
+
+    def __init__(self, exprs: Sequence[Expression], schema: T.StructType,
+                 child: TpuExec):
+        super().__init__(schema, child)
+        self.exprs = list(exprs)
+
+    def node_string(self):
+        return f"TpuProject [{', '.join(str(e) for e in self.exprs)}]"
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        for b in self.children[0].execute(partition):
+            with self.timer():
+                cols = tuple(e.eval_tpu(b) for e in self.exprs)
+                out = DeviceBatch(self.schema, cols, b.sel)
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+class CpuFilterExec(CpuExec):
+    def __init__(self, condition: Expression, child: CpuExec):
+        super().__init__(child.schema, child)
+        self.condition = condition
+
+    def node_string(self):
+        return f"Filter [{self.condition}]"
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        for b in self.children[0].execute(partition):
+            with self.timer():
+                c = self.condition.eval_cpu(b)
+                keep = c.data.astype(bool)
+                if c.validity is not None:
+                    keep = keep & c.validity  # null predicate drops the row
+                cols = [H.HostCol(col.dtype, col.data[keep],
+                                  None if col.validity is None
+                                  else col.validity[keep])
+                        for col in b.columns]
+                out = H.HostBatch(b.schema, cols)
+            self.metric("numOutputRows").add(out.num_rows)
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+class TpuFilterExec(TpuExec):
+    """Predicate folds into ``sel`` — no shape change, no compaction.
+
+    [REF: basicPhysicalOperators.scala :: GpuFilterExec] (cuDF materializes
+    via apply_boolean_mask; here liveness is a mask by design).
+    """
+
+    def __init__(self, condition: Expression, child: TpuExec):
+        super().__init__(child.schema, child)
+        self.condition = condition
+
+    def node_string(self):
+        return f"TpuFilter [{self.condition}]"
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        for b in self.children[0].execute(partition):
+            with self.timer():
+                c = self.condition.eval_tpu(b)
+                keep = c.data.astype(jnp.bool_)
+                if c.validity is not None:
+                    keep = keep & c.validity
+                out = b.with_sel(b.sel & keep)
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+class CpuLocalLimitExec(CpuExec):
+    def __init__(self, n: int, child: CpuExec):
+        super().__init__(child.schema, child)
+        self.n = n
+
+    def node_string(self):
+        return f"LocalLimit [{self.n}]"
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        remaining = self.n
+        for b in self.children[0].execute(partition):
+            if remaining <= 0:
+                break
+            take = min(remaining, b.num_rows)
+            cols = [H.HostCol(c.dtype, c.data[:take],
+                              None if c.validity is None else c.validity[:take])
+                    for c in b.columns]
+            remaining -= take
+            yield H.HostBatch(b.schema, cols)
+
+
+class TpuLocalLimitExec(TpuExec):
+    """Keep the first n live rows (batch order).  Mask-only, static shape.
+
+    [REF: limit.scala :: GpuLocalLimitExec]
+    """
+
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__(child.schema, child)
+        self.n = n
+
+    def node_string(self):
+        return f"TpuLocalLimit [{self.n}]"
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        remaining = self.n
+        for b in self.children[0].execute(partition):
+            if remaining <= 0:
+                break
+            with self.timer():
+                live_prefix = jnp.cumsum(b.sel.astype(jnp.int32))
+                keep = b.sel & (live_prefix <= remaining)
+                out = b.with_sel(keep)
+            # how many we actually emitted (host sync per batch boundary)
+            remaining -= int(jnp.sum(keep.astype(jnp.int32)))
+            yield out
+
+
+class CpuGlobalLimitExec(CpuExec):
+    """Single-partition global cut across all child partitions.
+
+    [REF: limit.scala :: GpuGlobalLimitExec] — planned above a per-
+    partition LocalLimit, exactly Spark's GlobalLimit(LocalLimit(...)).
+    """
+
+    def __init__(self, n: int, child: CpuExec):
+        super().__init__(child.schema, child)
+        self.n = n
+
+    def node_string(self):
+        return f"GlobalLimit [{self.n}]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        remaining = self.n
+        child = self.children[0]
+        for p in range(child.num_partitions()):
+            for b in child.execute(p):
+                if remaining <= 0:
+                    return
+                take = min(remaining, b.num_rows)
+                cols = [H.HostCol(c.dtype, c.data[:take],
+                                  None if c.validity is None
+                                  else c.validity[:take])
+                        for c in b.columns]
+                remaining -= take
+                yield H.HostBatch(b.schema, cols)
+
+
+class TpuGlobalLimitExec(TpuExec):
+    """[REF: limit.scala :: GpuGlobalLimitExec]"""
+
+    def __init__(self, n: int, child: TpuExec):
+        super().__init__(child.schema, child)
+        self.n = n
+
+    def node_string(self):
+        return f"TpuGlobalLimit [{self.n}]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        remaining = self.n
+        child = self.children[0]
+        for p in range(child.num_partitions()):
+            for b in child.execute(p):
+                if remaining <= 0:
+                    return
+                with self.timer():
+                    live_prefix = jnp.cumsum(b.sel.astype(jnp.int32))
+                    keep = b.sel & (live_prefix <= remaining)
+                    out = b.with_sel(keep)
+                remaining -= int(jnp.sum(keep.astype(jnp.int32)))
+                yield out
+
+
+class CpuUnionExec(CpuExec):
+    def __init__(self, children_: Sequence[CpuExec]):
+        super().__init__(children_[0].schema, *children_)
+
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions() for c in self.children)
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        for c in self.children:
+            np_ = c.num_partitions()
+            if partition < np_:
+                for b in c.execute(partition):
+                    yield H.HostBatch(self.schema, b.columns)
+                return
+            partition -= np_
+        raise IndexError("partition out of range")
+
+
+class TpuUnionExec(TpuExec):
+    """[REF: GpuUnionExec] — partitions concatenate across children."""
+
+    def __init__(self, children_: Sequence[TpuExec]):
+        super().__init__(children_[0].schema, *children_)
+
+    def num_partitions(self) -> int:
+        return sum(c.num_partitions() for c in self.children)
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        for c in self.children:
+            np_ = c.num_partitions()
+            if partition < np_:
+                for b in c.execute(partition):
+                    yield DeviceBatch(self.schema, b.columns, b.sel)
+                return
+            partition -= np_
+        raise IndexError("partition out of range")
+
+
+class TpuCoalesceBatchesExec(TpuExec):
+    """Concatenate small device batches up to a target row budget.
+
+    [REF: GpuCoalesceBatches.scala :: GpuCoalesceBatches] — goal-directed:
+    ``target_rows`` (TargetSize analog) or require_single (RequireSingleBatch,
+    used by ops that need the whole partition, e.g. final sort).
+    Concat = pad columns to the shared bucket and jnp.concatenate; the
+    result bucket is the pow-2 ceiling of the live-row total.
+    """
+
+    def __init__(self, child: TpuExec, target_rows: int = 1 << 22,
+                 require_single: bool = False):
+        super().__init__(child.schema, child)
+        self.target_rows = target_rows
+        self.require_single = require_single
+
+    def node_string(self):
+        goal = "single" if self.require_single else f"target={self.target_rows}"
+        return f"TpuCoalesceBatches [{goal}]"
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.columnar.column import compact
+        pending: List[DeviceBatch] = []
+        pending_rows = 0
+        for b in self.children[0].execute(partition):
+            n = int(jnp.sum(b.sel.astype(jnp.int32)))
+            if (not self.require_single and pending
+                    and pending_rows + n > self.target_rows):
+                yield self._emit(pending)
+                pending, pending_rows = [], 0
+            pending.append(compact(b))
+            pending_rows += n
+        if pending:
+            yield self._emit(pending)
+
+    def _emit(self, batches: List[DeviceBatch]) -> DeviceBatch:
+        with self.timer("concatTime"):
+            out = concat_device_batches(self.schema, batches)
+        self.metric("numOutputBatches").add(1)
+        return out
+
+
+def concat_device_batches(schema: T.StructType,
+                          batches: List[DeviceBatch]) -> DeviceBatch:
+    """Concatenate compacted device batches into one bucketed batch."""
+    if len(batches) == 1:
+        return batches[0]
+    counts = [int(jnp.sum(b.sel.astype(jnp.int32))) for b in batches]
+    total = sum(counts)
+    bucket = round_up_pow2(max(total, 1))
+    cols = []
+    for ci, f in enumerate(schema.fields):
+        parts_data = []
+        parts_val = []
+        parts_len = []
+        any_val = any(b.columns[ci].validity is not None for b in batches)
+        is_str = batches[0].columns[ci].is_string
+        width = max(b.columns[ci].data.shape[1] for b in batches) if is_str else 0
+        for b, n in zip(batches, counts):
+            c = b.columns[ci]
+            if is_str:
+                d = c.data[:n]
+                if d.shape[1] < width:
+                    d = jnp.pad(d, ((0, 0), (0, width - d.shape[1])))
+                parts_data.append(d)
+                parts_len.append(c.lengths[:n])
+            else:
+                parts_data.append(c.data[:n])
+            if any_val:
+                v = (c.validity[:n] if c.validity is not None
+                     else jnp.ones((n,), jnp.bool_))
+                parts_val.append(v)
+        data = jnp.concatenate(parts_data, axis=0)
+        pad = bucket - total
+        if pad:
+            data = (jnp.pad(data, ((0, pad), (0, 0))) if is_str
+                    else jnp.pad(data, (0, pad)))
+        validity = None
+        if any_val:
+            validity = jnp.pad(jnp.concatenate(parts_val), (0, pad))
+        lengths = None
+        if is_str:
+            lengths = jnp.pad(jnp.concatenate(parts_len), (0, pad))
+        cols.append(type(batches[0].columns[ci])(f.dtype, data, validity,
+                                                 lengths))
+    sel = jnp.arange(bucket, dtype=jnp.int32) < total
+    return DeviceBatch(schema, tuple(cols), sel)

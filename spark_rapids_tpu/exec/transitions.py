@@ -1,0 +1,49 @@
+"""Columnar CPU↔TPU transition operators.
+
+[REF: sql-plugin/../GpuTransitionOverrides.scala; GpuRowToColumnarExec.scala,
+ GpuColumnarToRowExec.scala] — inserted by plan/overrides.py at every
+device/host boundary of the rewritten plan.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator
+
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import (
+    DeviceBatch, device_to_host, host_to_device)
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+
+
+class HostToDeviceExec(TpuExec):
+    """CPU child → device batches (the H2D admission point)."""
+
+    def __init__(self, child: CpuExec, min_bucket: int = 1024):
+        super().__init__(child.schema, child)
+        self.min_bucket = min_bucket
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        for b in self.children[0].execute(partition):
+            with self.timer("transferTime"):
+                tbl = H.to_arrow_table(b)
+                out = host_to_device(tbl, min_bucket=self.min_bucket)
+                out = DeviceBatch(self.schema, out.columns, out.sel)
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+class DeviceToHostExec(CpuExec):
+    """TPU child → host batches (D2H; compacts first)."""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child.schema, child)
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        for b in self.children[0].execute(partition):
+            with self.timer("transferTime"):
+                tbl = device_to_host(b)
+                out = H.from_arrow_table(tbl)
+                out = H.HostBatch(self.schema, out.columns)
+            self.metric("numOutputRows").add(out.num_rows)
+            self.metric("numOutputBatches").add(1)
+            yield out

@@ -32,9 +32,10 @@ from spark_rapids_tpu.ops.expressions import Expression
 class AggregateFunction:
     child: Expression  # bound input expression (ignored for CountStar)
 
+    # class attributes (NOT dataclass fields — subclasses override them)
     name = "agg"
     # reduction kind per buffer column: "sum" | "min" | "max" | "first"
-    buffer_kinds: List[str] = None  # type: ignore
+    buffer_kinds = None
 
     @property
     def input_dtype(self) -> T.DataType:

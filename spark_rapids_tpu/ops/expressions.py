@@ -641,6 +641,27 @@ class IsNaN(Expression):
         return HostCol(self.dtype, np.isnan(c.data) & c.valid_mask(), None)
 
 
+
+def device_select(cond1d, a: "DeviceColumn", b: "DeviceColumn",
+                  dtype) -> "DeviceColumn":
+    """Row-wise select between two device columns (string-aware).
+
+    cond1d: bool[B]; takes a where True else b.  Validity NOT handled here
+    (callers own null semantics).  For strings, pads byte matrices to the
+    common width so shapes align.
+    """
+    if a.lengths is not None or b.lengths is not None:
+        wa = a.data.shape[1]
+        wb = b.data.shape[1]
+        w = max(wa, wb)
+        da = jnp.pad(a.data, ((0, 0), (0, w - wa))) if wa < w else a.data
+        db = jnp.pad(b.data, ((0, 0), (0, w - wb))) if wb < w else b.data
+        data = jnp.where(cond1d[:, None], da, db)
+        lengths = jnp.where(cond1d, a.lengths, b.lengths)
+        return DeviceColumn(dtype, data, None, lengths)
+    return DeviceColumn(dtype, jnp.where(cond1d, a.data, b.data), None)
+
+
 @dataclasses.dataclass
 class Coalesce(Expression):
     exprs: List[Expression]
@@ -655,13 +676,13 @@ class Coalesce(Expression):
 
     def eval_tpu(self, batch):
         cols = [e.eval_tpu(batch) for e in self.exprs]
-        data = cols[-1].data
+        acc = cols[-1]
         validity = cols[-1].valid_mask()
         for c in reversed(cols[:-1]):
             cv = c.valid_mask()
-            data = jnp.where(cv, c.data, data)
+            acc = device_select(cv, c, acc, self.dtype)
             validity = cv | validity
-        return DeviceColumn(self.dtype, data, validity)
+        return DeviceColumn(self.dtype, acc.data, validity, acc.lengths)
 
     def eval_cpu(self, batch):
         cols = [e.eval_cpu(batch) for e in self.exprs]
@@ -732,19 +753,22 @@ class CaseWhen(Expression):
 
     def eval_tpu(self, batch):
         if self.else_value is not None:
-            e = self.else_value.eval_tpu(batch)
-            data, validity = e.data, e.valid_mask()
+            acc = self.else_value.eval_tpu(batch)
+            validity = acc.valid_mask()
         else:
             first = self.branches[0][1].eval_tpu(batch)
-            data = jnp.zeros_like(first.data)
+            acc = DeviceColumn(
+                self.dtype, jnp.zeros_like(first.data), None,
+                None if first.lengths is None
+                else jnp.zeros_like(first.lengths))
             validity = jnp.zeros((batch.capacity,), jnp.bool_)
         for pred, val in reversed(self.branches):
             p = pred.eval_tpu(batch)
             v = val.eval_tpu(batch)
             cond = p.data & p.valid_mask()
-            data = jnp.where(cond, v.data, data)
+            acc = device_select(cond, v, acc, self.dtype)
             validity = jnp.where(cond, v.valid_mask(), validity)
-        return DeviceColumn(self.dtype, data, validity)
+        return DeviceColumn(self.dtype, acc.data, validity, acc.lengths)
 
     def eval_cpu(self, batch):
         n = batch.num_rows

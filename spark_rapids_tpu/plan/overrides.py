@@ -1,0 +1,339 @@
+"""The plan-rewrite engine: CPU physical plan → TPU plan with fallback.
+
+[REF: sql-plugin/../GpuOverrides.scala :: GpuOverrides (expressions/execs
+ rule maps, wrapPlan), RapidsMeta.scala :: SparkPlanMeta.tagForGpu /
+ willNotWorkOnGpu / convertToGpu, GpuTransitionOverrides.scala]
+
+Mechanics mirror the reference faithfully because this IS the product's
+soul (SURVEY.md §7):
+
+* every exec/expression class has a rule in a registry;
+* each plan node is wrapped in a Meta that accumulates human-readable
+  "will not work on TPU because ..." reasons (type checks, per-op conf
+  kill-switches, missing rules);
+* tagged-ok subtrees convert to Tpu execs; transitions are inserted at
+  every boundary (HostToDevice/DeviceToHost — the reference's
+  Row/ColumnarToRow analog);
+* ``spark.rapids.sql.explain=NOT_ON_TPU|ALL`` reports the rewrite, and
+  ``spark.rapids.sql.test.enabled`` turns unexpected fallback into an
+  exception (the integration-test mode, SURVEY.md §4.1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import CpuExec, ExecNode, TpuExec
+from spark_rapids_tpu.exec.transitions import DeviceToHostExec, HostToDeviceExec
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+# ---------------------------------------------------------------------------
+# Type support lattice — the TypeSig analog
+# [REF: sql-plugin/../TypeChecks.scala :: TypeSig]
+# ---------------------------------------------------------------------------
+
+def is_device_supported_type(dt: T.DataType) -> Optional[str]:
+    """None if supported on device; else the reason string."""
+    if isinstance(dt, T.DecimalType):
+        if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+            return (f"decimal precision {dt.precision} > 18 "
+                    "(decimal128 not yet enabled)")
+        return None
+    if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
+        return f"nested type {dt.simple_name} not yet supported on device"
+    if isinstance(dt, (T.BooleanType, T.ByteType, T.ShortType, T.IntegerType,
+                       T.LongType, T.FloatType, T.DoubleType, T.StringType,
+                       T.BinaryType, T.DateType, T.TimestampType, T.NullType)):
+        return None
+    return f"type {dt.simple_name} not supported on device"
+
+
+# ---------------------------------------------------------------------------
+# Meta: per-node tagging state
+# ---------------------------------------------------------------------------
+
+class ExecMeta:
+    def __init__(self, cpu: CpuExec, conf: RapidsConf,
+                 children: List["ExecMeta"]):
+        self.cpu = cpu
+        self.conf = conf
+        self.children = children
+        self.reasons: List[str] = []
+        self.rule: Optional["ExecRule"] = None
+
+    def will_not_work(self, reason: str):
+        if reason not in self.reasons:
+            self.reasons.append(reason)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def tag_expressions(self, exprs):
+        for e in exprs:
+            tag_expression(e, self)
+
+    def tag(self):
+        rule = EXEC_RULES.get(type(self.cpu))
+        if rule is None:
+            self.will_not_work(
+                f"no TPU rule for exec {type(self.cpu).__name__}")
+            return
+        self.rule = rule
+        if not self.conf.is_op_enabled("exec", rule.name):
+            self.will_not_work(
+                f"exec {rule.name} disabled by "
+                f"spark.rapids.sql.exec.{rule.name}=false")
+        for f in self.cpu.schema.fields:
+            r = is_device_supported_type(f.dtype)
+            if r:
+                self.will_not_work(f"output column '{f.name}': {r}")
+        rule.tag(self)
+
+
+def tag_expression(e: Expression, meta: ExecMeta):
+    name = type(e).__name__
+    if not meta.conf.is_op_enabled("expression", name):
+        meta.will_not_work(
+            f"expression {name} disabled by "
+            f"spark.rapids.sql.expression.{name}=false")
+    r = is_device_supported_type(e.dtype)
+    if r:
+        meta.will_not_work(f"expression {e}: {r}")
+    if not hasattr(e, "eval_tpu") or (
+            type(e).eval_tpu is Expression.eval_tpu):
+        meta.will_not_work(f"expression {name} has no TPU implementation")
+    for c in e.children:
+        tag_expression(c, meta)
+
+
+# ---------------------------------------------------------------------------
+# Rules
+# ---------------------------------------------------------------------------
+
+class ExecRule:
+    """One entry of the GpuOverrides execs map."""
+
+    def __init__(self, name: str,
+                 tag: Callable[[ExecMeta], None],
+                 convert: Callable[[CpuExec, List[TpuExec]], TpuExec],
+                 desc: str = ""):
+        self.name = name
+        self._tag = tag
+        self.convert = convert
+        self.desc = desc
+
+    def tag(self, meta: ExecMeta):
+        self._tag(meta)
+
+
+EXEC_RULES: Dict[Type[CpuExec], ExecRule] = {}
+
+
+def register_exec(cpu_cls: Type[CpuExec], name: str, desc: str = ""):
+    def deco(fns):
+        tag, convert = fns
+        EXEC_RULES[cpu_cls] = ExecRule(name, tag, convert, desc)
+        return fns
+    return deco
+
+
+def _tag_scan(meta: ExecMeta):
+    pass
+
+
+def _convert_scan(cpu: B.CpuScanExec, children):
+    return B.TpuScanExec(cpu.table, cpu.schema, cpu.num_partitions(),
+                         cpu.batch_rows)
+
+
+EXEC_RULES[B.CpuScanExec] = ExecRule(
+    "InMemoryScan", _tag_scan, _convert_scan,
+    "in-memory table scan landing device-resident columnar batches")
+
+EXEC_RULES[B.CpuProjectExec] = ExecRule(
+    "Project",
+    lambda m: m.tag_expressions(m.cpu.exprs),
+    lambda cpu, ch: B.TpuProjectExec(cpu.exprs, cpu.schema, ch[0]),
+    "columnar projection")
+
+EXEC_RULES[B.CpuFilterExec] = ExecRule(
+    "Filter",
+    lambda m: m.tag_expressions([m.cpu.condition]),
+    lambda cpu, ch: B.TpuFilterExec(cpu.condition, ch[0]),
+    "columnar filter (predicate folds into the selection mask)")
+
+EXEC_RULES[B.CpuLocalLimitExec] = ExecRule(
+    "LocalLimit",
+    lambda m: None,
+    lambda cpu, ch: B.TpuLocalLimitExec(cpu.n, ch[0]),
+    "limit over live rows")
+
+EXEC_RULES[B.CpuGlobalLimitExec] = ExecRule(
+    "GlobalLimit",
+    lambda m: None,
+    lambda cpu, ch: B.TpuGlobalLimitExec(cpu.n, ch[0]),
+    "global limit cut across partitions")
+
+EXEC_RULES[B.CpuUnionExec] = ExecRule(
+    "Union",
+    lambda m: None,
+    lambda cpu, ch: B.TpuUnionExec(ch),
+    "union of children partitions")
+
+
+def _tag_aggregate(meta: ExecMeta):
+    from spark_rapids_tpu.exec.aggregate import CpuAggregateExec
+    from spark_rapids_tpu.ops.aggregates import (
+        Average, Count, CountStar, First, Max, Min, Sum)
+    cpu: CpuAggregateExec = meta.cpu
+    meta.tag_expressions(cpu.grouping)
+    for fn in cpu.fns:
+        if not isinstance(fn, (Sum, Min, Max, Count, CountStar, Average,
+                               First)):
+            meta.will_not_work(
+                f"aggregate function {fn.name} has no TPU implementation")
+            continue
+        if not isinstance(fn, CountStar):
+            meta.tag_expressions([fn.child])
+        if isinstance(fn, (Min, Max, First)) and isinstance(
+                fn.input_dtype, (T.StringType, T.BinaryType)):
+            meta.will_not_work(
+                f"{fn.name} over {fn.input_dtype.simple_name} input not yet "
+                "supported on device (string agg buffers)")
+
+
+def _convert_aggregate(cpu, ch):
+    from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema, ch[0])
+
+
+def _register_lazy_rules():
+    """Rules for exec classes defined in lazily-imported modules."""
+    from spark_rapids_tpu.exec.aggregate import CpuAggregateExec
+    EXEC_RULES.setdefault(CpuAggregateExec, ExecRule(
+        "HashAggregate", _tag_aggregate, _convert_aggregate,
+        "sort-based device groupby (lax.sort + segment reduce)"))
+    try:
+        from spark_rapids_tpu.exec.sort import CpuSortExec
+        from spark_rapids_tpu.exec.sort import _tag_sort, _convert_sort
+        EXEC_RULES.setdefault(CpuSortExec, ExecRule(
+            "Sort", _tag_sort, _convert_sort,
+            "device lexicographic sort (lax.sort on orderable keys)"))
+    except ImportError:
+        pass
+    try:
+        from spark_rapids_tpu.exec.join import (
+            CpuJoinExec, _tag_join, _convert_join)
+        EXEC_RULES.setdefault(CpuJoinExec, ExecRule(
+            "SortMergeJoin", _tag_join, _convert_join,
+            "device sort-merge equi-join"))
+    except ImportError:
+        pass
+    try:
+        from spark_rapids_tpu.exec.exchange import (
+            CpuShuffleExchangeExec, _tag_exchange, _convert_exchange)
+        EXEC_RULES.setdefault(CpuShuffleExchangeExec, ExecRule(
+            "ShuffleExchange", _tag_exchange, _convert_exchange,
+            "device hash partitioning (bit-exact Spark murmur3)"))
+    except ImportError:
+        pass
+    try:
+        from spark_rapids_tpu.io.parquet import (
+            CpuParquetScanExec, _tag_parquet, _convert_parquet)
+        EXEC_RULES.setdefault(CpuParquetScanExec, ExecRule(
+            "ParquetScan", _tag_parquet, _convert_parquet,
+            "parquet scan landing device-resident batches"))
+    except ImportError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# The rewrite pass
+# ---------------------------------------------------------------------------
+
+class OverrideResult:
+    def __init__(self, plan: ExecNode, metas: List[ExecMeta]):
+        self.plan = plan
+        self.metas = metas
+
+    def fallback_report(self) -> List[str]:
+        out = []
+        for m in self.metas:
+            if not m.can_run_on_tpu:
+                for r in m.reasons:
+                    out.append(
+                        f"!Exec <{type(m.cpu).__name__}> cannot run on TPU "
+                        f"because {r}")
+        return out
+
+
+def wrap(cpu: CpuExec, conf: RapidsConf, all_metas: List[ExecMeta]) -> ExecMeta:
+    children = [wrap(c, conf, all_metas) for c in cpu.children
+                if isinstance(c, CpuExec)]
+    meta = ExecMeta(cpu, conf, children)
+    meta.tag()
+    all_metas.append(meta)
+    return meta
+
+
+def _rebuild_cpu(cpu: CpuExec, new_children: List[ExecNode]) -> CpuExec:
+    """Re-point a CPU exec at (possibly transition-wrapped) children."""
+    cpu._children = tuple(new_children)
+    return cpu
+
+
+def convert_meta(meta: ExecMeta) -> ExecNode:
+    """Bottom-up conversion with transition insertion."""
+    converted = [convert_meta(c) for c in meta.children]
+    if meta.can_run_on_tpu:
+        tpu_children = [
+            c if isinstance(c, TpuExec) else HostToDeviceExec(c)
+            for c in converted
+        ]
+        return meta.rule.convert(meta.cpu, tpu_children)
+    cpu_children = [
+        c if isinstance(c, CpuExec) else DeviceToHostExec(c)
+        for c in converted
+    ]
+    return _rebuild_cpu(meta.cpu, cpu_children)
+
+
+def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
+    """GpuOverrides.apply + GpuTransitionOverrides in one pass."""
+    if not conf.sql_enabled:
+        return OverrideResult(cpu_plan, [])
+    _register_lazy_rules()
+    metas: List[ExecMeta] = []
+    root = wrap(cpu_plan, conf, metas)
+    plan = convert_meta(root)
+    if isinstance(plan, TpuExec):
+        plan = DeviceToHostExec(plan)
+    result = OverrideResult(plan, metas)
+
+    explain = conf.explain
+    report = result.fallback_report()
+    if explain == "ALL" or (explain in ("NOT_ON_GPU", "NOT_ON_TPU")
+                            and report):
+        print("TPU plan rewrite:")
+        for line in report:
+            print("  " + line)
+        if explain == "ALL":
+            print(plan.tree_string())
+
+    if conf.test_enabled and report:
+        allowed = set(conf.allowed_non_gpu)
+        bad = [m for m in metas if not m.can_run_on_tpu
+               and type(m.cpu).__name__ not in allowed
+               and (EXEC_RULES.get(type(m.cpu)) is None
+                    or EXEC_RULES[type(m.cpu)].name not in allowed)]
+        if bad:
+            lines = "\n".join(r for m in bad for r in m.reasons)
+            raise AssertionError(
+                "Part of the plan is not columnar (TPU test mode): \n"
+                + lines)
+    return result

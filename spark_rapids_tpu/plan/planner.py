@@ -1,0 +1,52 @@
+"""Physical planning: logical plan → CPU physical plan.
+
+The vanilla-Spark-planner analog.  Produces a ``CpuExec`` tree; the
+overrides engine (plan/overrides.py) then rewrites supported subtrees onto
+TPU — the same split as Spark's planner + the reference's GpuOverrides
+ColumnarRule [REF: sql-plugin/../GpuOverrides.scala :: ColumnarOverrideRules].
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec import basic as B
+from spark_rapids_tpu.exec.base import CpuExec
+from spark_rapids_tpu.plan import logical as L
+
+
+def plan_physical(node: L.LogicalPlan, conf: RapidsConf) -> CpuExec:
+    if isinstance(node, L.InMemoryRelation):
+        return B.CpuScanExec(node.table, node.schema, node.num_partitions,
+                             conf.batch_rows)
+    if isinstance(node, L.ParquetRelation):
+        from spark_rapids_tpu.io.parquet import CpuParquetScanExec
+        return CpuParquetScanExec(node.paths, node.schema, conf)
+    if isinstance(node, L.Project):
+        return B.CpuProjectExec(node.exprs, node.schema,
+                                plan_physical(node.child, conf))
+    if isinstance(node, L.Filter):
+        return B.CpuFilterExec(node.condition,
+                               plan_physical(node.child, conf))
+    if isinstance(node, L.Limit):
+        return B.CpuGlobalLimitExec(
+            node.n, B.CpuLocalLimitExec(node.n,
+                                        plan_physical(node.child, conf)))
+    if isinstance(node, L.Union):
+        return B.CpuUnionExec([plan_physical(c, conf) for c in node.inputs])
+    if isinstance(node, L.Aggregate):
+        from spark_rapids_tpu.exec.aggregate import plan_cpu_aggregate
+        return plan_cpu_aggregate(node, plan_physical(node.child, conf), conf)
+    if isinstance(node, L.Sort):
+        from spark_rapids_tpu.exec.sort import CpuSortExec
+        return CpuSortExec(node.orders, plan_physical(node.child, conf))
+    if isinstance(node, L.Join):
+        from spark_rapids_tpu.exec.join import CpuJoinExec
+        return CpuJoinExec(node.join_type, node.left_keys, node.right_keys,
+                           node.condition, node.schema,
+                           plan_physical(node.left, conf),
+                           plan_physical(node.right, conf))
+    if isinstance(node, L.Repartition):
+        from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
+        return CpuShuffleExchangeExec(
+            plan_physical(node.child, conf), node.num_partitions, node.keys)
+    raise NotImplementedError(f"no physical plan for {node.name}")

@@ -134,7 +134,12 @@ class Column:
         return (self >= low) & (self <= high)
 
     def when(self, cond: "Column", value) -> "Column":
-        raise TypeError("use functions.when(...)")
+        u = self._u
+        if u.op != "casewhen":
+            raise TypeError("when() only chains after functions.when(...)")
+        return Column(UExpr("casewhen", u.payload,
+                            u.children + (_to_uexpr(cond),
+                                          _to_uexpr(value))))
 
     def otherwise(self, value) -> "Column":
         u = self._u

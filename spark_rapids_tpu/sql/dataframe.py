@@ -1,0 +1,320 @@
+"""DataFrame API over logical plans (pyspark.sql.DataFrame surface).
+
+Eager analysis (names resolve at call time, like pyspark), lazy execution.
+``_execute`` runs the full pipeline: physical planning → TPU overrides
+rewrite (plan/overrides.py) → partition pump → arrow collect.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.plan import analysis as AN
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.overrides import apply_overrides
+from spark_rapids_tpu.plan.planner import plan_physical
+from spark_rapids_tpu.sql.column import Column, UExpr, col as _col
+
+
+class Row(tuple):
+    """Lightweight pyspark.Row analog: tuple + field access."""
+
+    def __new__(cls, values, fields):
+        r = super().__new__(cls, values)
+        r.__dict__ = {}
+        r.__dict__["_fieldnames"] = fields
+        return r
+
+    def __getattr__(self, item):
+        names = self.__dict__.get("_fieldnames", ())
+        if item in names:
+            return self[names.index(item)]
+        raise AttributeError(item)
+
+    def __getitem__(self, item):
+        if isinstance(item, str):
+            return self[self.__dict__["_fieldnames"].index(item)]
+        return super().__getitem__(item)
+
+    def asDict(self):
+        return dict(zip(self.__dict__["_fieldnames"], self))
+
+    def __repr__(self):
+        names = self.__dict__.get("_fieldnames", ())
+        inner = ", ".join(f"{n}={v!r}" for n, v in zip(names, self))
+        return f"Row({inner})"
+
+
+def _to_column(c: Union[str, Column]) -> Column:
+    return _col(c) if isinstance(c, str) else c
+
+
+class DataFrame:
+    def __init__(self, session, plan: L.LogicalPlan):
+        self.session = session
+        self._plan = plan
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def schema(self) -> T.StructType:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self.schema.field_names()
+
+    # -- transformations ----------------------------------------------------
+    def select(self, *cols) -> "DataFrame":
+        exprs = []
+        fields = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                for i, f in enumerate(self.schema.fields):
+                    from spark_rapids_tpu.ops.expressions import BoundReference
+                    exprs.append(BoundReference(i, f.dtype, f.nullable))
+                    fields.append(f)
+                continue
+            u = _to_column(c)._u
+            e = AN.resolve(u, self.schema)
+            name = self._output_name(u, e)
+            exprs.append(e)
+            fields.append(T.StructField(name, e.dtype))
+        schema = T.StructType(tuple(fields))
+        return DataFrame(self.session, L.Project(self._plan, exprs, schema))
+
+    @staticmethod
+    def _output_name(u: UExpr, e) -> str:
+        if u.op == "alias":
+            return u.payload
+        if u.op == "attr":
+            return u.payload
+        return str(e)
+
+    def withColumn(self, name: str, c: Column) -> "DataFrame":
+        cols = [_col(n) for n in self.columns if n != name]
+        return self.select(*cols, c.alias(name))
+
+    def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
+        cols = [(_col(n).alias(new) if n == old else _col(n))
+                for n in self.columns]
+        return self.select(*cols)
+
+    def drop(self, *names) -> "DataFrame":
+        keep = [n for n in self.columns if n not in names]
+        return self.select(*keep)
+
+    def filter(self, condition: Union[str, Column]) -> "DataFrame":
+        if isinstance(condition, str):
+            raise NotImplementedError("SQL-string filters not yet supported")
+        cond = AN.resolve(condition._u, self.schema)
+        if not isinstance(cond.dtype, (T.BooleanType, T.NullType)):
+            raise AN.AnalysisException(
+                f"filter condition must be boolean, got {cond.dtype}")
+        return DataFrame(self.session, L.Filter(self._plan, cond))
+
+    where = filter
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self.session, L.Limit(self._plan, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        if len(other.schema) != len(self.schema):
+            raise AN.AnalysisException("union: column count mismatch")
+        return DataFrame(self.session, L.Union([self._plan, other._plan]))
+
+    unionAll = union
+
+    def distinct(self) -> "DataFrame":
+        return self.groupBy(*self.columns).agg()
+
+    def repartition(self, num: int, *cols) -> "DataFrame":
+        keys = [AN.resolve(_to_column(c)._u, self.schema) for c in cols] or None
+        return DataFrame(self.session,
+                         L.Repartition(self._plan, num, keys))
+
+    def groupBy(self, *cols) -> "GroupedData":
+        exprs = []
+        names = []
+        for c in cols:
+            u = _to_column(c)._u
+            e = AN.resolve(u, self.schema)
+            exprs.append(e)
+            names.append(self._output_name(u, e))
+        return GroupedData(self, exprs, names)
+
+    groupby = groupBy
+
+    def agg(self, *aggs) -> "DataFrame":
+        return GroupedData(self, [], []).agg(*aggs)
+
+    def orderBy(self, *cols, ascending=None) -> "DataFrame":
+        orders = []
+        for i, c in enumerate(cols):
+            u = _to_column(c)._u
+            asc, nulls_first = True, True
+            if u.op == "sortorder":
+                direction, nulls = u.payload
+                asc = direction == "asc"
+                nulls_first = nulls == "nulls_first"
+                u = u.children[0]
+            if ascending is not None:
+                asc = (ascending[i] if isinstance(ascending, (list, tuple))
+                       else bool(ascending))
+                nulls_first = asc
+            e = AN.resolve(u, self.schema)
+            orders.append(L.SortOrder(e, asc, nulls_first))
+        return DataFrame(self.session, L.Sort(self._plan, orders))
+
+    sort = orderBy
+
+    def join(self, other: "DataFrame", on=None, how: str = "inner"
+             ) -> "DataFrame":
+        how = {"inner": "inner", "left": "left", "leftouter": "left",
+               "left_outer": "left", "right": "right",
+               "rightouter": "right", "right_outer": "right",
+               "outer": "full", "full": "full", "fullouter": "full",
+               "full_outer": "full", "semi": "left_semi",
+               "leftsemi": "left_semi", "left_semi": "left_semi",
+               "anti": "left_anti", "leftanti": "left_anti",
+               "left_anti": "left_anti", "cross": "cross"}[how.lower()]
+        if on is None:
+            on = []
+        if isinstance(on, str):
+            on = [on]
+        left_keys, right_keys = [], []
+        using = all(isinstance(c, str) for c in on)
+        if using:
+            for name in on:
+                left_keys.append(AN.resolve(UExpr("attr", name), self.schema))
+                right_keys.append(AN.resolve(UExpr("attr", name),
+                                             other.schema))
+        else:
+            raise NotImplementedError(
+                "join on Column expressions not yet supported; use column "
+                "name lists")
+        # output schema: USING semantics — join cols once (from left), then
+        # remaining left cols, then remaining right cols
+        fields: List[T.StructField] = []
+        if using:
+            for name in on:
+                f = self.schema.fields[self.schema.field_index(name)]
+                nullable = f.nullable or how in ("right", "full")
+                fields.append(T.StructField(name, f.dtype, nullable))
+            for f in self.schema.fields:
+                if f.name not in on:
+                    nullable = f.nullable or how in ("right", "full")
+                    fields.append(T.StructField(f.name, f.dtype, nullable))
+            if how not in ("left_semi", "left_anti"):
+                for f in other.schema.fields:
+                    if f.name not in on:
+                        nullable = f.nullable or how in ("left", "full")
+                        fields.append(T.StructField(f.name, f.dtype, nullable))
+        schema = T.StructType(tuple(fields))
+        return DataFrame(self.session, L.Join(
+            self._plan, other._plan, how, left_keys, right_keys, None,
+            schema))
+
+    def crossJoin(self, other: "DataFrame") -> "DataFrame":
+        return self.join(other, on=[], how="cross")
+
+    # -- actions ------------------------------------------------------------
+    def _execute_plan(self):
+        conf = self.session.rapids_conf()
+        cpu = plan_physical(self._plan, conf)
+        result = apply_overrides(cpu, conf)
+        return result.plan
+
+    def toArrow(self) -> pa.Table:
+        plan = self._execute_plan()
+        tables = []
+        for p in range(plan.num_partitions()):
+            for batch in plan.execute(p):
+                tables.append(H.to_arrow_table(batch))
+        if not tables:
+            return pa.table(
+                {f.name: pa.array([], type=T.to_arrow(f.dtype))
+                 for f in self.schema.fields})
+        return pa.concat_tables(tables)
+
+    def collect(self) -> List[Row]:
+        tbl = self.toArrow()
+        names = tuple(tbl.column_names)
+        cols = [tbl.column(i).to_pylist() for i in range(tbl.num_columns)]
+        return [Row(vals, names) for vals in zip(*cols)] if cols else []
+
+    def count(self) -> int:
+        return self.toArrow().num_rows
+
+    def toPandas(self):
+        return self.toArrow().to_pandas()
+
+    def show(self, n: int = 20, truncate: bool = True):
+        print(self.limit(n).toArrow().to_pandas().to_string())
+
+    def explain(self, extended: bool = False):
+        conf = self.session.rapids_conf()
+        cpu = plan_physical(self._plan, conf)
+        result = apply_overrides(cpu, conf)
+        print(result.plan.tree_string())
+        if extended:
+            for line in result.fallback_report():
+                print(line)
+
+    @property
+    def write(self):
+        from spark_rapids_tpu.io.readers import DataFrameWriter
+        return DataFrameWriter(self)
+
+
+class GroupedData:
+    def __init__(self, df: DataFrame, grouping, names):
+        self.df = df
+        self.grouping = grouping
+        self.names = names
+
+    def agg(self, *aggs) -> DataFrame:
+        fns = []
+        fields = [T.StructField(n, g.dtype)
+                  for n, g in zip(self.names, self.grouping)]
+        for a in aggs:
+            fn, name = AN.resolve_aggregate(_to_column(a)._u, self.df.schema)
+            fns.append(fn)
+            fields.append(T.StructField(name, fn.result_dtype))
+        schema = T.StructType(tuple(fields))
+        return DataFrame(self.df.session, L.Aggregate(
+            self.df._plan, self.grouping, fns, schema))
+
+    def count(self) -> DataFrame:
+        from spark_rapids_tpu.sql import functions as F
+        return self.agg(F.count("*").alias("count"))
+
+    def _simple(self, kind, *cols):
+        from spark_rapids_tpu.sql import functions as F
+        targets = cols or [
+            n for n in self.df.columns
+            if T.is_numeric(self.df.schema.fields[
+                self.df.schema.field_index(n)].dtype)
+            and n not in self.names]
+        fn = getattr(F, kind)
+        return self.agg(*[fn(_col(c)).alias(f"{kind}({c})") for c in targets])
+
+    def sum(self, *cols):
+        return self._simple("sum", *cols)
+
+    def min(self, *cols):
+        return self._simple("min", *cols)
+
+    def max(self, *cols):
+        return self._simple("max", *cols)
+
+    def avg(self, *cols):
+        return self._simple("avg", *cols)
+
+    mean = avg
+
+
+from spark_rapids_tpu.sql.column import col  # noqa: E402,F401  (re-export)

@@ -5,16 +5,24 @@ from __future__ import annotations
 from spark_rapids_tpu.sql.column import Column, UExpr, _to_uexpr, col, lit  # noqa: F401
 
 
+def _cu(c) -> UExpr:
+    """Function-argument conversion: bare strings are column names
+    (pyspark.sql.functions semantics), everything else like _to_uexpr."""
+    if isinstance(c, str):
+        return UExpr("attr", c)
+    return _to_uexpr(c)
+
+
 def _unary(op):
     def fn(c) -> Column:
-        return Column(UExpr(op, None, (_to_uexpr(c),)))
+        return Column(UExpr(op, None, (_cu(c),)))
     fn.__name__ = op
     return fn
 
 
 def _binary(op):
     def fn(a, b) -> Column:
-        return Column(UExpr(op, None, (_to_uexpr(a), _to_uexpr(b))))
+        return Column(UExpr(op, None, (_cu(a), _to_uexpr(b))))
     fn.__name__ = op
     return fn
 
@@ -41,11 +49,11 @@ concat = None  # set below (variadic)
 
 
 def round(c, scale=0) -> Column:  # noqa: A001
-    return Column(UExpr("round", scale, (_to_uexpr(c),)))
+    return Column(UExpr("round", scale, (_cu(c),)))
 
 
 def coalesce(*cols) -> Column:
-    return Column(UExpr("coalesce", None, tuple(_to_uexpr(c) for c in cols)))
+    return Column(UExpr("coalesce", None, tuple(_cu(c) for c in cols)))
 
 
 def when(cond: Column, value) -> Column:
@@ -54,11 +62,11 @@ def when(cond: Column, value) -> Column:
 
 
 def substring(c, pos, length) -> Column:
-    return Column(UExpr("substring", (pos, length), (_to_uexpr(c),)))
+    return Column(UExpr("substring", (pos, length), (_cu(c),)))
 
 
 def concat_impl(*cols) -> Column:
-    return Column(UExpr("concat", None, tuple(_to_uexpr(c) for c in cols)))
+    return Column(UExpr("concat", None, tuple(_cu(c) for c in cols)))
 
 
 concat = concat_impl
@@ -66,14 +74,14 @@ concat = concat_impl
 
 def hash(*cols) -> Column:  # noqa: A001
     """Spark murmur3 hash (seed 42)."""
-    return Column(UExpr("hash", None, tuple(_to_uexpr(c) for c in cols)))
+    return Column(UExpr("hash", None, tuple(_cu(c) for c in cols)))
 
 
 # aggregate functions -------------------------------------------------------
 
 def _agg(op):
     def fn(c) -> Column:
-        return Column(UExpr("agg", op, (_to_uexpr(c),)))
+        return Column(UExpr("agg", op, (_cu(c),)))
     fn.__name__ = op
     return fn
 
@@ -89,8 +97,8 @@ first = _agg("first")
 def count(c) -> Column:
     if isinstance(c, str) and c == "*":
         return Column(UExpr("agg", "count_star", (UExpr("lit", 1),)))
-    return Column(UExpr("agg", "count", (_to_uexpr(c),)))
+    return Column(UExpr("agg", "count", (_cu(c),)))
 
 
 def countDistinct(c) -> Column:
-    return Column(UExpr("agg", "count_distinct", (_to_uexpr(c),)))
+    return Column(UExpr("agg", "count_distinct", (_cu(c),)))

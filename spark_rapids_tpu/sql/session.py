@@ -1,0 +1,159 @@
+"""TpuSession — the SparkSession-analog entry point.
+
+The reference is a plugin into an existing engine; this framework carries a
+minimal session so the plugin machinery (conf, plan rewrite, fallback
+reporting) has an engine to plug into.  Conf surface and behavior mirror
+``spark.rapids.*`` [REF: sql-plugin/../RapidsConf.scala].
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Union
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.runtime.device import ensure_initialized
+
+
+class RuntimeConf:
+    """Mutable session conf view (spark.conf analog)."""
+
+    def __init__(self, raw: Dict[str, Any]):
+        self._raw = dict(raw)
+
+    def set(self, key: str, value) -> None:
+        self._raw[str(key)] = value
+
+    def get(self, key: str, default=None):
+        return self._raw.get(key, default)
+
+    def unset(self, key: str) -> None:
+        self._raw.pop(key, None)
+
+    def snapshot(self) -> RapidsConf:
+        return RapidsConf(self._raw)
+
+
+class TpuSessionBuilder:
+    def __init__(self):
+        self._conf: Dict[str, Any] = {}
+
+    def config(self, key=None, value=None, conf: Optional[Dict] = None
+               ) -> "TpuSessionBuilder":
+        if conf:
+            self._conf.update(conf)
+        if key is not None:
+            self._conf[key] = value
+        return self
+
+    def getOrCreate(self) -> "TpuSession":
+        return TpuSession(self._conf)
+
+
+def _infer_arrow_type(values: List[Any]) -> pa.DataType:
+    """Scan ALL values (pyspark-style): int → int64 (LongType), numeric
+    int/float mixes promote to float64."""
+    saw_int = saw_float = False
+    for v in values:
+        if v is None:
+            continue
+        if isinstance(v, bool):
+            return pa.bool_()
+        if isinstance(v, int):
+            saw_int = True
+            continue
+        if isinstance(v, float):
+            saw_float = True
+            continue
+        if isinstance(v, str):
+            return pa.string()
+        if isinstance(v, bytes):
+            return pa.binary()
+        if isinstance(v, decimal.Decimal):
+            return pa.decimal128(18, max(0, -v.as_tuple().exponent))
+        if isinstance(v, datetime.datetime):
+            return pa.timestamp("us", tz="UTC")
+        if isinstance(v, datetime.date):
+            return pa.date32()
+    if saw_float:
+        return pa.float64()
+    if saw_int:
+        return pa.int64()
+    return pa.int32()
+
+
+class _BuilderDescriptor:
+    """Class-level ``TpuSession.builder`` (SparkSession.builder idiom)."""
+
+    def __get__(self, obj, objtype=None) -> TpuSessionBuilder:
+        return TpuSessionBuilder()
+
+
+class TpuSession:
+    builder = _BuilderDescriptor()
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        ensure_initialized()
+        self.conf = RuntimeConf(conf or {})
+
+    # -- data ingestion -----------------------------------------------------
+    def createDataFrame(self, data, schema=None) -> "DataFrame":
+        from spark_rapids_tpu.plan.logical import InMemoryRelation
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+
+        table = self._to_arrow(data, schema)
+        st = T.StructType(tuple(
+            T.StructField(n, T.from_arrow(table.schema.field(n).type))
+            for n in table.column_names))
+        nparts = int(self.conf.get("spark.default.parallelism", 1))
+        return DataFrame(self, InMemoryRelation(table, st, nparts))
+
+    def _to_arrow(self, data, schema) -> pa.Table:
+        if isinstance(data, pa.Table):
+            return data
+        if hasattr(data, "to_arrow"):  # pandas-ish escape hatch
+            return data.to_arrow()
+        if hasattr(data, "__dataframe__") or str(type(data)).endswith(
+                "DataFrame'>"):
+            return pa.Table.from_pandas(data)
+        rows = list(data)
+        if schema is not None and isinstance(schema, (list, tuple)) and rows:
+            names = list(schema)
+            cols = list(zip(*rows)) if rows else [[] for _ in names]
+            arrays = [pa.array(list(c), type=_infer_arrow_type(list(c)))
+                      for c in cols]
+            return pa.table(arrays, names=names)
+        if isinstance(schema, T.StructType):
+            names = schema.field_names()
+            cols = list(zip(*rows)) if rows else [[] for _ in names]
+            arrays = [
+                pa.array(list(c), type=T.to_arrow(f.dtype))
+                for c, f in zip(cols, schema.fields)
+            ]
+            return pa.table(arrays, names=names)
+        raise TypeError(
+            "createDataFrame expects a pyarrow.Table, pandas DataFrame, or "
+            "list of tuples with a schema (list of names or StructType)")
+
+    def range(self, start: int, end: Optional[int] = None,
+              step: int = 1) -> "DataFrame":
+        if end is None:
+            start, end = 0, start
+        vals = np.arange(start, end, step, dtype=np.int64)
+        return self.createDataFrame(pa.table({"id": pa.array(vals)}))
+
+    @property
+    def read(self):
+        from spark_rapids_tpu.io.readers import DataFrameReader
+        return DataFrameReader(self)
+
+    def rapids_conf(self) -> RapidsConf:
+        return self.conf.snapshot()
+
+    def stop(self):
+        pass

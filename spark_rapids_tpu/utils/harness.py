@@ -1,0 +1,76 @@
+"""End-to-end CPU-vs-TPU oracle harness for DataFrame queries.
+
+[REF: integration_tests/src/main/python/asserts.py ::
+ assert_gpu_and_cpu_are_equal_collect, spark_session.py ::
+ with_cpu_session/with_gpu_session] — the workhorse test pattern: build
+the same query twice, once with ``spark.rapids.sql.enabled=false`` (the
+numpy oracle path) and once ``=true`` with test mode on (any unexpected
+fallback raises), and compare collected results.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+def tpu_session(conf: Optional[Dict] = None) -> TpuSession:
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.test.enabled": True}
+    base.update(conf or {})
+    return TpuSession(base)
+
+
+def cpu_session(conf: Optional[Dict] = None) -> TpuSession:
+    base = {"spark.rapids.sql.enabled": False}
+    base.update(conf or {})
+    return TpuSession(base)
+
+
+def assert_tpu_and_cpu_are_equal_collect(
+    df_builder: Callable[[TpuSession], "object"],
+    conf: Optional[Dict] = None,
+    ignore_order: bool = False,
+    approx_float: bool = False,
+    allow_non_tpu: Optional[list] = None,
+):
+    """df_builder: session -> DataFrame.  Runs both ways and compares."""
+    from spark_rapids_tpu.utils.asserts import assert_tables_equal
+
+    tconf = dict(conf or {})
+    if allow_non_tpu:
+        tconf["spark.rapids.sql.test.allowedNonGpu"] = ",".join(allow_non_tpu)
+    t = df_builder(tpu_session(tconf)).toArrow()
+    c = df_builder(cpu_session(conf)).toArrow()
+    assert_tables_equal(c, t, ignore_order=ignore_order,
+                        approx_float=approx_float)
+    return c, t
+
+
+def assert_tpu_fallback_collect(
+    df_builder: Callable[[TpuSession], "object"],
+    fallback_exec: str,
+    conf: Optional[Dict] = None,
+    ignore_order: bool = False,
+):
+    """Assert the query still works WITH the plugin on but the named exec
+    falls back to CPU [REF: asserts.py :: assert_gpu_fallback_collect]."""
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    from spark_rapids_tpu.utils.asserts import assert_tables_equal
+
+    tconf = dict(conf or {})
+    tconf["spark.rapids.sql.test.enabled"] = False
+    s = tpu_session(tconf)
+    df = df_builder(s)
+    rc = s.rapids_conf()
+    result = apply_overrides(plan_physical(df._plan, rc), rc)
+    lines = [ln.strip() for ln in result.plan.tree_string().splitlines()]
+    # CPU nodes print bare ("Project [...]"); TPU ones as "*TpuProject".
+    assert any(ln.startswith(fallback_exec) for ln in lines), (
+        f"expected {fallback_exec} to fall back to CPU; plan:\n"
+        + "\n".join(lines))
+    t = df.toArrow()
+    c = df_builder(cpu_session(conf)).toArrow()
+    assert_tables_equal(c, t, ignore_order=ignore_order)

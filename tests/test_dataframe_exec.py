@@ -1,0 +1,244 @@
+"""End-to-end DataFrame execution: CPU-vs-TPU oracle over the exec layer.
+
+[REF: integration_tests/src/main/python/ — the CPU/GPU equality pattern]
+Covers scan→project→filter→limit→union and the sort-based device
+aggregate, including fallback and test-mode assertions.
+"""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col, lit
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, assert_tpu_fallback_collect,
+    tpu_session)
+
+import numpy as np
+
+
+def gen_table(seed=0, n=500):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "i": dg.IntegerGen().generate(rng, n),
+        "l": dg.LongGen().generate(rng, n),
+        "d": dg.DoubleGen().generate(rng, n),
+        "f": dg.FloatGen().generate(rng, n),
+        "s": dg.StringGen().generate(rng, n),
+        "b": dg.BooleanGen().generate(rng, n),
+        "g": pa.array([f"g{int(x) % 7}" for x in range(n)]),
+        "k": pa.array((np.arange(n) % 13).astype(np.int32)),
+    })
+
+
+def test_project_arithmetic():
+    t = gen_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            (col("i") + col("k")).alias("a"),
+            (col("l") * 3).alias("m"),
+            (col("d") / 2.0).alias("dv"),
+            (-col("i")).alias("n"),
+            col("s"),
+        ))
+
+
+def test_filter_with_nulls():
+    t = gen_table(1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).filter(
+            (col("i") > 0) & col("d").isNotNull()))
+
+
+def test_filter_string_predicate():
+    t = gen_table(2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).filter(col("g") == "g3"))
+
+
+def test_limit():
+    t = gen_table(3)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select("i", "s").limit(17))
+
+
+def test_union():
+    t1, t2 = gen_table(4, 100), gen_table(5, 80)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t1).union(s.createDataFrame(t2)))
+
+
+def test_with_column_and_case_when():
+    t = gen_table(6)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).withColumn(
+            "c", F.when(col("i") > 0, lit("pos"))
+                  .when(col("i") < 0, lit("neg")).otherwise(lit("zero"))))
+
+
+def test_groupby_sum_count_avg():
+    t = gen_table(7)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("g").agg(
+            F.sum("i").alias("si"),
+            F.sum("d").alias("sd"),
+            F.count("*").alias("c"),
+            F.count("d").alias("cd"),
+            F.avg("l").alias("al"),
+        ), ignore_order=True, approx_float=True)
+
+
+def test_groupby_min_max():
+    t = gen_table(8)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.min("i").alias("mi"),
+            F.max("d").alias("xd"),
+            F.min("f").alias("mf"),
+            F.max("l").alias("xl"),
+        ), ignore_order=True)
+
+
+def test_groupby_multi_key_with_null_keys():
+    t = gen_table(9)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("g", "b").agg(
+            F.count("*").alias("c"), F.sum("l").alias("sl")),
+        ignore_order=True)
+
+
+def test_groupby_string_key_with_nulls():
+    t = gen_table(10)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("s").agg(
+            F.count("*").alias("c")), ignore_order=True)
+
+
+def test_global_aggregate():
+    t = gen_table(11)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.sum("i").alias("si"), F.min("d").alias("md"),
+            F.max("f").alias("xf"), F.count("s").alias("cs"),
+            F.avg("d").alias("ad")), approx_float=True)
+
+
+def test_global_aggregate_empty_input():
+    t = gen_table(12).slice(0, 0)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.sum("i").alias("si"), F.count("*").alias("c")))
+
+
+def test_distinct():
+    t = gen_table(13)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select("g", "k").distinct(),
+        ignore_order=True)
+
+
+def test_expression_killswitch_falls_back():
+    t = gen_table(14)
+    assert_tpu_fallback_collect(
+        lambda s: s.createDataFrame(t).select((col("i") + 1).alias("x")),
+        "Project",
+        conf={"spark.rapids.sql.expression.Add": False})
+
+
+def test_test_mode_raises_on_unexpected_fallback():
+    t = gen_table(15)
+    s = tpu_session({"spark.rapids.sql.expression.Add": False})
+    with pytest.raises(AssertionError, match="not columnar"):
+        s.createDataFrame(t).select((col("i") + 1).alias("x")).toArrow()
+
+
+def test_chained_pipeline():
+    t = gen_table(16, 1000)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: (s.createDataFrame(t)
+                   .filter(col("i").isNotNull() & (col("i") % 3 == 0))
+                   .withColumn("v", col("i") * col("k"))
+                   .groupBy("g").agg(F.sum("v").alias("sv"),
+                                     F.max("k").alias("xk"))
+                   ), ignore_order=True)
+
+
+def test_collect_and_row_api():
+    s = tpu_session()
+    rows = s.createDataFrame([(1, "a"), (2, "b")], ["x", "y"]).collect()
+    assert rows[0].x == 1 and rows[1]["y"] == "b"
+    assert rows[0].asDict() == {"x": 1, "y": "a"}
+
+
+def test_multi_partition_scan():
+    t = gen_table(17, 300)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).filter(col("k") > 5),
+        conf={"spark.default.parallelism": 4})
+
+
+def test_multi_partition_groupby():
+    t = gen_table(18, 300)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("g").agg(
+            F.sum("l").alias("sl"), F.count("*").alias("c")),
+        conf={"spark.default.parallelism": 3}, ignore_order=True)
+
+
+def test_groupby_double_key_nan_negzero():
+    # NaN keys form ONE group; -0.0 and 0.0 merge (Spark normalizes keys)
+    t = pa.table({"d": pa.array([0.0, -0.0, float("nan"), float("nan"),
+                                 1.5, None, None, float("inf")]),
+                  "x": pa.array([1, 2, 3, 4, 5, 6, 7, 8])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("d").agg(
+            F.count("*").alias("c"), F.sum("x").alias("sx")),
+        ignore_order=True)
+
+
+def test_min_max_double_with_nan_and_inf():
+    t = pa.table({
+        "g": pa.array(["a", "a", "b", "b", "c", "c", "d"]),
+        "d": pa.array([1.0, float("nan"), float("nan"), float("nan"),
+                       float("inf"), float("nan"), None]),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("g").agg(
+            F.min("d").alias("mn"), F.max("d").alias("mx")),
+        ignore_order=True)
+
+
+def test_global_min_max_nan_only():
+    t = pa.table({"d": pa.array([float("nan"), float("nan")])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.min("d").alias("mn"), F.max("d").alias("mx")))
+
+
+def test_global_first_with_leading_null():
+    t = pa.table({"v": pa.array([None, 5, 6], type=pa.int32())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(F.first("v").alias("f")))
+
+
+def test_global_limit_across_partitions():
+    t = gen_table(20, 100)
+    for n in (10, 95):
+        c, out = assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.createDataFrame(t).limit(n).select("i"),
+            conf={"spark.default.parallelism": 4})
+        assert out.num_rows == min(n, 100)
+
+
+def test_create_dataframe_long_inference():
+    s = tpu_session()
+    df = s.createDataFrame([(1,), (2**40,)], ["x"])
+    assert df.collect()[1].x == 2**40
+
+
+def test_builder_class_idiom():
+    from spark_rapids_tpu.sql.session import TpuSession
+    s = (TpuSession.builder.config("spark.rapids.sql.enabled", True)
+         .getOrCreate())
+    assert s.rapids_conf().sql_enabled
