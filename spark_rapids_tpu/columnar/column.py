@@ -162,7 +162,7 @@ jax.tree_util.register_pytree_node(DeviceBatch, _batch_flatten, _batch_unflatten
 # dynamic→static boundary; called before shuffle/join-build/host transfer.
 # ---------------------------------------------------------------------------
 
-def compact(batch: DeviceBatch) -> DeviceBatch:
+def _compact_impl(batch: DeviceBatch) -> DeviceBatch:
     # Stable argsort on "dead" flag moves live rows to the front preserving
     # order.  One lax.sort; vectorizes fine on TPU.
     order = jnp.argsort((~batch.sel).astype(jnp.int8), stable=True)
@@ -170,6 +170,13 @@ def compact(batch: DeviceBatch) -> DeviceBatch:
     count = jnp.sum(batch.sel.astype(jnp.int32))
     sel = jnp.arange(batch.capacity, dtype=jnp.int32) < count
     return DeviceBatch(batch.schema, cols, sel)
+
+
+def compact(batch: DeviceBatch) -> DeviceBatch:
+    from spark_rapids_tpu.runtime.kernel_cache import (
+        cached_kernel, fingerprint)
+    return cached_kernel(("compact", fingerprint(batch.schema)),
+                         lambda: _compact_impl)(batch)
 
 
 # ---------------------------------------------------------------------------

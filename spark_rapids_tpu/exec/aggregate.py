@@ -70,6 +70,25 @@ def _is_float(dt: T.DataType) -> bool:
 # The device groupby kernel
 # ---------------------------------------------------------------------------
 
+def segmented_scan(op, values: jnp.ndarray, boundary: jnp.ndarray
+                   ) -> jnp.ndarray:
+    """Inclusive segmented scan: row i gets op-reduction of its segment's
+    rows [segment_start..i].
+
+    THE TPU-idiom replacement for segment_sum/min/max over sorted data:
+    XLA lowers scatter (which jax.ops.segment_* use) to a *serial* loop on
+    TPU — catastrophic at batch sizes (measured: minutes at 128k rows).
+    ``associative_scan`` is log-depth slices+concats, which the TPU
+    vectorizes."""
+    def comb(a, bb):
+        va, fa = a
+        vb, fb = bb
+        return jnp.where(fb, vb, op(va, vb)), fa | fb
+
+    v, _ = jax.lax.associative_scan(comb, (values, boundary))
+    return v
+
+
 def segment_groupby(
     key_cols: Sequence[DeviceColumn],
     sel: jnp.ndarray,
@@ -78,44 +97,40 @@ def segment_groupby(
     """Group rows by keys; reduce values by kind ('sum'|'min'|'max'|'first').
 
     Returns (out_key_cols, out_value_cols, out_sel) — groups compacted to
-    the front, capacity unchanged (static shape).
+    the front, capacity unchanged (static shape).  Scatter-free: one
+    stable sort, segmented scans, and a second sort that compacts each
+    group's END row (which holds the full-segment scan result) to the
+    front in group order.
     """
     b = int(sel.shape[0])
     dead = (~sel).astype(jnp.uint64)
     limbs = [dead] + ORD.batch_group_keys(list(key_cols))
-    sorted_limbs, perm = ORD.sort_by_keys(
-        limbs, jnp.arange(b, dtype=jnp.int32))
+    sorted_limbs, perm = ORD.sort_by_keys(limbs)
 
     live_sorted = sorted_limbs[0] == 0
     diff = jnp.zeros((b,), jnp.bool_)
     for l in sorted_limbs:
         diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
     boundary = diff.at[0].set(True)  # row 0 always starts a group
-    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     num_groups = jnp.sum((boundary & live_sorted).astype(jnp.int32))
 
-    # representative (first sorted) row per live group → scatter to front
-    rep_target = jnp.where(boundary & live_sorted, gid, b)
+    # group END rows hold the completed segment reductions
+    is_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    # compaction: ends of live groups to the front, in group order
+    rank = jnp.where(is_end & live_sorted, jnp.uint64(0), jnp.uint64(1))
+    _, perm2 = ORD.sort_by_keys([rank])
 
-    def scatter_rep(x_sorted):
-        shape = (b,) + x_sorted.shape[1:]
-        out = jnp.zeros(shape, x_sorted.dtype)
-        return out.at[rep_target].set(x_sorted, mode="drop")
+    def to_front(x_sorted):
+        return jnp.take(x_sorted, perm2, axis=0)
 
     out_keys = []
     for c in key_cols:
-        data_s = jnp.take(c.data, perm, axis=0)
-        validity = None
-        if c.validity is not None:
-            validity = scatter_rep(jnp.take(c.validity, perm))
-        lengths = None
-        if c.lengths is not None:
-            lengths = scatter_rep(jnp.take(c.lengths, perm))
-        out_keys.append(DeviceColumn(c.dtype, scatter_rep(data_s),
-                                     validity, lengths))
-
-    first_pos = jax.ops.segment_min(
-        jnp.arange(b, dtype=jnp.int32), gid, num_segments=b)
+        data_s = to_front(jnp.take(c.data, perm, axis=0))
+        validity = (to_front(jnp.take(c.validity, perm))
+                    if c.validity is not None else None)
+        lengths = (to_front(jnp.take(c.lengths, perm))
+                   if c.lengths is not None else None)
+        out_keys.append(DeviceColumn(c.dtype, data_s, validity, lengths))
 
     out_vals = []
     for c, kind in value_cols:
@@ -123,36 +138,36 @@ def segment_groupby(
         valid_s = (jnp.take(c.validity, perm) if c.validity is not None
                    else jnp.ones((b,), jnp.bool_))
         contrib = valid_s & live_sorted
+        n_contrib = segmented_scan(
+            jnp.add, contrib.astype(jnp.int32), boundary)
         if kind == "sum":
             masked = jnp.where(contrib, data_s,
                                jnp.zeros((), data_s.dtype))
-            agg = jax.ops.segment_sum(masked, gid, num_segments=b)
-            validity = jax.ops.segment_sum(
-                contrib.astype(jnp.int32), gid, num_segments=b) > 0
+            agg = segmented_scan(jnp.add, masked, boundary)
+            validity = n_contrib > 0
         elif kind in ("min", "max"):
-            n_contrib = jax.ops.segment_sum(
-                contrib.astype(jnp.int32), gid, num_segments=b)
             if _is_float(c.dtype):
                 # Spark float total order: NaN greatest.  No 64-bit
                 # bitcasts on TPU, so reduce raw floats with NaN masked
                 # out and reinstate NaN per the order semantics.
                 isn = jnp.isnan(data_s)
                 real = contrib & ~isn
-                n_real = jax.ops.segment_sum(
-                    real.astype(jnp.int32), gid, num_segments=b)
+                n_real = segmented_scan(
+                    jnp.add, real.astype(jnp.int32), boundary)
                 inf = jnp.asarray(np.inf, data_s.dtype)
                 if kind == "min":
-                    agg = jax.ops.segment_min(
-                        jnp.where(real, data_s, inf), gid, num_segments=b)
+                    agg = segmented_scan(
+                        jnp.minimum, jnp.where(real, data_s, inf), boundary)
                     # all-NaN group → min is NaN
                     agg = jnp.where((n_real == 0) & (n_contrib > 0),
                                     jnp.asarray(np.nan, data_s.dtype), agg)
                 else:
-                    agg = jax.ops.segment_max(
-                        jnp.where(real, data_s, -inf), gid, num_segments=b)
-                    any_nan = jax.ops.segment_sum(
-                        (contrib & isn).astype(jnp.int32), gid,
-                        num_segments=b) > 0
+                    agg = segmented_scan(
+                        jnp.maximum, jnp.where(real, data_s, -inf),
+                        boundary)
+                    any_nan = segmented_scan(
+                        jnp.add, (contrib & isn).astype(jnp.int32),
+                        boundary) > 0
                     agg = jnp.where(any_nan,
                                     jnp.asarray(np.nan, data_s.dtype), agg)
             else:
@@ -160,18 +175,19 @@ def segment_groupby(
                 sentinel = jnp.uint64(
                     0xFFFFFFFFFFFFFFFF if kind == "min" else 0)
                 masked = jnp.where(contrib, u, sentinel)
-                red = (jax.ops.segment_min if kind == "min"
-                       else jax.ops.segment_max)
+                red = jnp.minimum if kind == "min" else jnp.maximum
                 agg = decode_orderable(
-                    red(masked, gid, num_segments=b), c.dtype)
+                    segmented_scan(red, masked, boundary), c.dtype)
             validity = n_contrib > 0
         elif kind == "first":
-            pos = jnp.clip(first_pos, 0, b - 1)
-            agg = jnp.take(data_s, pos, axis=0)
-            validity = jnp.take(valid_s, pos)
+            # keep-leftmost segmented scan: end row sees the start value
+            agg = segmented_scan(lambda a, bb: a, data_s, boundary)
+            validity = segmented_scan(
+                lambda a, bb: a, valid_s, boundary)
         else:
             raise ValueError(f"unknown reduction kind {kind}")
-        out_vals.append(DeviceColumn(c.dtype, agg, validity, None))
+        out_vals.append(DeviceColumn(c.dtype, to_front(agg),
+                                     to_front(validity), None))
 
     out_sel = jnp.arange(b, dtype=jnp.int32) < num_groups
     return out_keys, out_vals, out_sel
@@ -274,10 +290,22 @@ class TpuHashAggregateExec(TpuExec):
         return 1
 
     def _partial(self, batch: DeviceBatch) -> DeviceBatch:
-        keys = [g.eval_tpu(batch) for g in self.grouping]
-        vals = update_value_cols(self.fns, batch)
-        ok, ov, sel = segment_groupby(keys, batch.sel, vals)
-        return DeviceBatch(self._buffer_schema(), tuple(ok + ov), sel)
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        grouping, fns = self.grouping, self.fns
+        buffer_schema = self._buffer_schema()
+
+        def build():
+            def run(b):
+                keys = [g.eval_tpu(b) for g in grouping]
+                vals = update_value_cols(fns, b)
+                ok, ov, sel = segment_groupby(keys, b.sel, vals)
+                return DeviceBatch(buffer_schema, tuple(ok + ov), sel)
+            return run
+
+        fn = cached_kernel(
+            ("agg_partial", fingerprint(grouping), fingerprint(fns)), build)
+        return fn(batch)
 
     def _buffer_schema(self) -> T.StructType:
         fields = [T.StructField(f"k{i}", g.dtype)
@@ -297,22 +325,43 @@ class TpuHashAggregateExec(TpuExec):
             for p in range(child.num_partitions()):
                 for b in child.execute(p):
                     partials.append(self._partial(b))
+            if not partials:
+                # empty child: grouped agg → no groups; global agg still
+                # produces its one default row (sum=null, count=0)
+                from spark_rapids_tpu.columnar.column import empty_batch
+                partials.append(self._partial(
+                    empty_batch(self.children[0].schema)))
             if not self.grouping:
-                yield self._reduce_no_keys(partials)
-                return
-            from spark_rapids_tpu.columnar.column import compact
-            merged = concat_device_batches(
-                self._buffer_schema(), [compact(p) for p in partials])
-            nk = len(self.grouping)
-            keys = list(merged.columns[:nk])
-            bufs = list(merged.columns[nk:])
-            kinds = merge_kinds(self.fns)
-            ok, ov, sel = segment_groupby(
-                keys, merged.sel, list(zip(bufs, kinds)))
-            results = final_project(self.fns, ov)
-            out = DeviceBatch(self.schema, tuple(ok + results), sel)
+                out = self._reduce_no_keys(partials)
+            else:
+                from spark_rapids_tpu.columnar.column import compact
+                merged = concat_device_batches(
+                    self._buffer_schema(), [compact(p) for p in partials])
+                out = self._merge_final(merged)
         self.metric("numOutputBatches").add(1)
         yield out
+
+    def _merge_final(self, merged: DeviceBatch) -> DeviceBatch:
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        grouping, fns, schema = self.grouping, self.fns, self.schema
+        nk = len(grouping)
+
+        def build():
+            def run(m):
+                keys = list(m.columns[:nk])
+                bufs = list(m.columns[nk:])
+                kinds = merge_kinds(fns)
+                ok, ov, sel = segment_groupby(
+                    keys, m.sel, list(zip(bufs, kinds)))
+                results = final_project(fns, ov)
+                return DeviceBatch(schema, tuple(ok + results), sel)
+            return run
+
+        fn = cached_kernel(
+            ("agg_merge", fingerprint(grouping), fingerprint(fns),
+             fingerprint(schema)), build)
+        return fn(merged)
 
     def _reduce_no_keys(self, partials: List[DeviceBatch]) -> DeviceBatch:
         """Global (no grouping) aggregate → exactly one output row.

@@ -1,0 +1,174 @@
+"""Shuffle exchange execs (repartitioning).
+
+[REF: sql-plugin/../GpuShuffleExchangeExecBase.scala,
+ GpuHashPartitioning.scala] — the reference partitions on device with
+cuDF murmur3 ``hash_partition`` + ``contiguous_split`` and moves blocks
+via the shuffle manager.  Here, within one process, the TPU exchange is
+**zero-copy**: partition ids are computed on device with the bit-exact
+Spark murmur3 (ops/hashing.py) and each output partition is the same
+device batch viewed through a different ``sel`` mask — no data movement
+until a real multi-host transport (parallel/distributed.py rides
+``lax.all_to_all`` for the ICI path).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import DeviceBatch
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+from spark_rapids_tpu.ops import hashing as HH
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+class CpuShuffleExchangeExec(CpuExec):
+    def __init__(self, child: CpuExec, num_partitions: int,
+                 keys: Optional[Sequence[Expression]] = None):
+        super().__init__(child.schema, child)
+        self.nparts = num_partitions
+        self.keys = list(keys) if keys else None
+        self._materialized: Optional[List[List[H.HostBatch]]] = None
+
+    def node_string(self):
+        kind = "hash" if self.keys else "roundrobin"
+        return f"ShuffleExchange [{kind} {self.nparts}]"
+
+    def num_partitions(self) -> int:
+        return self.nparts
+
+    def _materialize(self):
+        if self._materialized is not None:
+            return self._materialized
+        child = self.children[0]
+        out: List[List[H.HostBatch]] = [[] for _ in range(self.nparts)]
+        row_counter = 0
+        for p in range(child.num_partitions()):
+            for b in child.execute(p):
+                n = b.num_rows
+                if self.keys:
+                    h = np.full(n, 42, np.uint32)
+                    valid_all = np.ones(n, bool)
+                    for e in self.keys:
+                        c = e.eval_cpu(b)
+                        data = c.data
+                        if isinstance(c.dtype, (T.StringType, T.BinaryType)):
+                            mat, lengths = _host_strings_to_mat(data)
+                            col_ = (mat, lengths)
+                        else:
+                            col_ = (data, None)
+                        valid = (c.validity if c.validity is not None
+                                 else valid_all)
+                        h = HH.hash_column(col_, c.dtype, h, valid, np)
+                    pid = HH.partition_ids_from_hash(
+                        HH._np_int32_from_u32(h), self.nparts, np)
+                else:
+                    pid = (np.arange(n) + row_counter) % self.nparts
+                    row_counter += n
+                for p_out in range(self.nparts):
+                    mask = pid == p_out
+                    if not mask.any():
+                        continue
+                    cols = [H.HostCol(
+                        c.dtype, c.data[mask],
+                        None if c.validity is None else c.validity[mask])
+                        for c in b.columns]
+                    out[p_out].append(H.HostBatch(b.schema, cols))
+        self._materialized = out
+        return out
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        for b in self._materialize()[partition]:
+            yield b
+
+
+def _host_strings_to_mat(data: np.ndarray):
+    enc = [v.encode() if isinstance(v, str) else bytes(v) for v in data]
+    mx = max((len(v) for v in enc), default=1) or 1
+    mat = np.zeros((len(enc), mx), np.uint8)
+    lengths = np.zeros(len(enc), np.int32)
+    for i, v in enumerate(enc):
+        mat[i, :len(v)] = np.frombuffer(v, np.uint8)
+        lengths[i] = len(v)
+    return mat, lengths
+
+
+class TpuShuffleExchangeExec(TpuExec):
+    """Zero-copy device repartition: sel-mask views per partition.
+
+    [REF: GpuShuffleExchangeExecBase — device murmur3 partitioning]
+    """
+
+    def __init__(self, child: TpuExec, num_partitions: int,
+                 keys: Optional[Sequence[Expression]] = None):
+        super().__init__(child.schema, child)
+        self.nparts = num_partitions
+        self.keys = list(keys) if keys else None
+        self._materialized = None
+
+    def node_string(self):
+        kind = "hash" if self.keys else "roundrobin"
+        return f"TpuShuffleExchange [{kind} {self.nparts}]"
+
+    def num_partitions(self) -> int:
+        return self.nparts
+
+    def _pids(self, b: DeviceBatch, row_base: int) -> jnp.ndarray:
+        if self.keys:
+            from spark_rapids_tpu.runtime.kernel_cache import (
+                cached_kernel, fingerprint)
+            keys = self.keys
+
+            def build():
+                def run(batch):
+                    n = batch.capacity
+                    h = jnp.full((n,), 42, jnp.uint32)
+                    for e in keys:
+                        c = e.eval_tpu(batch)
+                        valid = c.valid_mask()
+                        h = HH.hash_column((c.data, c.lengths), c.dtype, h,
+                                           valid, jnp)
+                    h_i32 = HH.jax_bitcast(h, jnp.int32)
+                    return HH.partition_ids_from_hash(h_i32, self.nparts,
+                                                      jnp)
+                return run
+
+            fn = cached_kernel(
+                ("partition_ids", self.nparts, fingerprint(keys),
+                 fingerprint(b.schema)), build)
+            return fn(b)
+        live_prefix = jnp.cumsum(b.sel.astype(jnp.int32)) - 1
+        return (live_prefix + row_base) % self.nparts
+
+    def _materialize(self):
+        if self._materialized is not None:
+            return self._materialized
+        child = self.children[0]
+        pairs = []  # (batch, pid array)
+        row_base = 0
+        with self.timer("partitionTime"):
+            for p in range(child.num_partitions()):
+                for b in child.execute(p):
+                    pairs.append((b, self._pids(b, row_base)))
+                    row_base += int(jnp.sum(b.sel.astype(jnp.int32)))
+        self._materialized = pairs
+        return pairs
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        for b, pid in self._materialize():
+            out = b.with_sel(b.sel & (pid == partition))
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+def _tag_exchange(meta):
+    if meta.cpu.keys:
+        meta.tag_expressions(meta.cpu.keys)
+
+
+def _convert_exchange(cpu, ch):
+    return TpuShuffleExchangeExec(ch[0], cpu.nparts, cpu.keys)

@@ -1,0 +1,499 @@
+"""Join execs: CPU oracle hash join + TPU sort-merge equi-join.
+
+[REF: sql-plugin/../GpuShuffledHashJoinExec.scala, joins/,
+ GpuSortMergeJoinMeta] — the reference builds cuDF hash tables; the
+TPU-first design is sort-merge (SURVEY §7 phase 5: "sort-merge first,
+Pallas hash join second"):
+
+  encode join keys as uint64 limbs → sort the build (right) side with one
+  ``lax.sort`` → vectorized lexicographic binary search gives each left
+  row its [lo, hi) match range → static-shape expansion (the only
+  dynamic→static point: the output row count syncs to host once to pick
+  the output bucket, the analog of cuDF's join output allocation).
+
+Null keys never match (Spark equi-join semantics); rows with null keys
+still surface for outer/anti outputs.  Float keys fall back to CPU for
+now (binary-search equality on raw floats vs NaN is ill-defined and
+64-bit bitcasts don't compile on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import (
+    DeviceBatch, DeviceColumn, compact, round_up_pow2)
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+from spark_rapids_tpu.exec.basic import concat_device_batches
+from spark_rapids_tpu.ops import ordering as ORD
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+# ---------------------------------------------------------------------------
+# helpers shared by both paths
+# ---------------------------------------------------------------------------
+
+def _gather_all(child, schema, device: bool):
+    if device:
+        batches = [compact(b) for p in range(child.num_partitions())
+                   for b in child.execute(p)]
+        if not batches:
+            from spark_rapids_tpu.columnar.column import empty_batch
+            return empty_batch(schema)
+        return concat_device_batches(schema, batches)
+    from spark_rapids_tpu.exec.sort import _concat_host
+    batches = [b for p in range(child.num_partitions())
+               for b in child.execute(p)]
+    if not batches:
+        return H.HostBatch(schema, [
+            H.HostCol(f.dtype,
+                      np.array([], dtype=object)
+                      if isinstance(f.dtype, (T.StringType, T.BinaryType))
+                      else np.zeros(0, T.to_numpy_dtype(f.dtype)), None)
+            for f in schema.fields])
+    return _concat_host(schema, batches)
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle
+# ---------------------------------------------------------------------------
+
+class CpuJoinExec(CpuExec):
+    def __init__(self, join_type: str, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 condition: Optional[Expression], schema: T.StructType,
+                 left: CpuExec, right: CpuExec):
+        super().__init__(schema, left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+
+    def node_string(self):
+        return f"Join [{self.join_type}]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        lb = _gather_all(self.children[0], self.children[0].schema, False)
+        rb = _gather_all(self.children[1], self.children[1].schema, False)
+        nl, nr = lb.num_rows, rb.num_rows
+        jt = self.join_type
+
+        def key_tuple(cols, i):
+            out = []
+            for c in cols:
+                if c.validity is not None and not c.validity[i]:
+                    return None
+                v = c.data[i]
+                if isinstance(c.dtype, (T.FloatType, T.DoubleType)):
+                    f = float(v)
+                    v = "NaN" if np.isnan(f) else (0.0 if f == 0.0 else f)
+                elif isinstance(c.dtype, (T.StringType, T.BinaryType)):
+                    pass
+                else:
+                    v = int(v)
+                out.append(v)
+            return tuple(out)
+
+        lk = [e.eval_cpu(lb) for e in self.left_keys]
+        rk = [e.eval_cpu(rb) for e in self.right_keys]
+
+        pairs: List[Tuple[int, int]] = []  # (-1 = null side)
+        if jt == "cross":
+            pairs = [(i, j) for i in range(nl) for j in range(nr)]
+        else:
+            index = {}
+            for j in range(nr):
+                k = key_tuple(rk, j)
+                if k is not None:
+                    index.setdefault(k, []).append(j)
+            matched_r = np.zeros(nr, dtype=bool)
+            for i in range(nl):
+                k = key_tuple(lk, i)
+                matches = index.get(k, []) if k is not None else []
+                if jt == "left_semi":
+                    if matches:
+                        pairs.append((i, -1))
+                elif jt == "left_anti":
+                    if not matches:
+                        pairs.append((i, -1))
+                elif matches:
+                    for j in matches:
+                        matched_r[j] = True
+                        pairs.append((i, j))
+                elif jt in ("left", "full"):
+                    pairs.append((i, -1))
+            if jt == "right":
+                # right-preserving: keep matched pairs + unmatched right
+                keep = [(i, j) for (i, j) in pairs if j >= 0]
+                keep += [(-1, j) for j in range(nr) if not matched_r[j]]
+                pairs = keep
+            elif jt == "full":
+                pairs += [(-1, j) for j in range(nr) if not matched_r[j]]
+
+        lidx = np.array([p[0] for p in pairs], dtype=np.int64)
+        ridx = np.array([p[1] for p in pairs], dtype=np.int64)
+        yield self._materialize(lb, rb, lidx, ridx)
+
+    def _materialize(self, lb, rb, lidx, ridx) -> H.HostBatch:
+        lkey_idx = [e.index for e in self.left_keys]
+        rkey_idx = [e.index for e in self.right_keys]
+        semi = self.join_type in ("left_semi", "left_anti")
+        cross = self.join_type == "cross"
+        cols: List[H.HostCol] = []
+        out_i = 0
+
+        def gather(c: H.HostCol, idx) -> Tuple[np.ndarray, np.ndarray]:
+            take = np.clip(idx, 0, max(len(c.data) - 1, 0))
+            if len(c.data) == 0:
+                data = np.zeros(len(idx), dtype=c.data.dtype)
+            else:
+                data = c.data[take]
+            valid = (c.validity[take] if c.validity is not None
+                     else np.ones(len(idx), bool)) if len(c.data) else \
+                np.zeros(len(idx), bool)
+            valid = valid & (idx >= 0)
+            return data, valid
+
+        if not cross:
+            for ki in range(len(lkey_idx)):
+                f = self.schema.fields[out_i]
+                ld, lv = gather(lb.columns[lkey_idx[ki]], lidx)
+                if self.join_type in ("right", "full"):
+                    rd, rv = gather(rb.columns[rkey_idx[ki]], ridx)
+                    data = np.where(lv, ld, rd)
+                    valid = lv | rv
+                else:
+                    data, valid = ld, lv
+                cols.append(H.HostCol(f.dtype, data,
+                                      None if valid.all() else valid))
+                out_i += 1
+        for i in range(len(lb.columns)):
+            if not cross and i in lkey_idx:
+                continue
+            f = self.schema.fields[out_i]
+            data, valid = gather(lb.columns[i], lidx)
+            cols.append(H.HostCol(f.dtype, data,
+                                  None if valid.all() else valid))
+            out_i += 1
+        if not semi:
+            for j in range(len(rb.columns)):
+                if not cross and j in rkey_idx:
+                    continue
+                f = self.schema.fields[out_i]
+                data, valid = gather(rb.columns[j], ridx)
+                cols.append(H.HostCol(f.dtype, data,
+                                      None if valid.all() else valid))
+                out_i += 1
+        return H.HostBatch(self.schema, cols)
+
+
+# ---------------------------------------------------------------------------
+# device search machinery
+# ---------------------------------------------------------------------------
+
+def _lex_search(sorted_limbs: List[jnp.ndarray],
+                query_limbs: List[jnp.ndarray], side: str) -> jnp.ndarray:
+    """Vectorized lexicographic binary search.
+
+    Returns, per query row, the first index i in the sorted table where
+    table[i] >= query ('left') or > query ('right').  All limbs uint64.
+    """
+    n = int(sorted_limbs[0].shape[0])
+    nq = int(query_limbs[0].shape[0])
+    lo = jnp.zeros((nq,), jnp.int32)
+    hi = jnp.full((nq,), n, jnp.int32)
+    steps = max(1, int(np.ceil(np.log2(max(n, 2)))) + 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, n - 1)
+        lt = jnp.zeros((nq,), jnp.bool_)
+        eq = jnp.ones((nq,), jnp.bool_)
+        for sl, ql in zip(sorted_limbs, query_limbs):
+            tv = jnp.take(sl, midc)
+            lt = lt | (eq & (tv < ql))
+            eq = eq & (tv == ql)
+        go_right = lt | (eq if side == "right" else jnp.zeros_like(eq))
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def _expand_counts(counts: jnp.ndarray) -> Tuple[int, jnp.ndarray,
+                                                 jnp.ndarray, int]:
+    """counts[B] → (bucket, row_idx[bucket], offset[bucket], total).
+
+    The ONE host sync of the join: total match count picks the output
+    bucket (pow-2), everything else stays on device with static shapes.
+    """
+    cum = jnp.cumsum(counts.astype(jnp.int64))
+    total = int(cum[-1]) if counts.shape[0] else 0
+    bucket = round_up_pow2(max(total, 1))
+    j = jnp.arange(bucket, dtype=jnp.int64)
+    i = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    i_c = jnp.clip(i, 0, max(counts.shape[0] - 1, 0))
+    start = jnp.take(cum, i_c) - jnp.take(counts.astype(jnp.int64), i_c)
+    off = (j - start).astype(jnp.int32)
+    return bucket, i_c, off, total
+
+
+def _key_limbs(batch: DeviceBatch, keys: Sequence[Expression]
+               ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """(equality limbs, has_null_key) for the join keys of a batch."""
+    has_null = jnp.zeros((batch.capacity,), jnp.bool_)
+    limbs: List[jnp.ndarray] = []
+    for e in keys:
+        c = e.eval_tpu(batch)
+        if c.validity is not None:
+            has_null = has_null | ~c.validity
+        limbs.extend(ORD.column_order_keys(c, True, True))
+    return limbs, has_null
+
+
+def _gather_col(c: DeviceColumn, idx: jnp.ndarray,
+                valid_out: jnp.ndarray) -> DeviceColumn:
+    g = c.gather(jnp.clip(idx, 0, c.capacity - 1))
+    base = g.valid_mask()
+    return DeviceColumn(c.dtype, g.data, base & valid_out, g.lengths)
+
+
+class TpuSortMergeJoinExec(TpuExec):
+    """[REF: GpuShuffledHashJoinExec — same plan position, sort-merge
+    algorithm per SURVEY §7]"""
+
+    def __init__(self, join_type: str, left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression],
+                 condition: Optional[Expression], schema: T.StructType,
+                 left: TpuExec, right: TpuExec):
+        super().__init__(schema, left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+
+    def node_string(self):
+        return f"TpuSortMergeJoin [{self.join_type}]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        jt = self.join_type
+        if jt == "right":
+            yield from self._execute_swapped()
+            return
+        lb = _gather_all(self.children[0], self.children[0].schema, True)
+        rb = _gather_all(self.children[1], self.children[1].schema, True)
+        with self.timer():
+            if jt == "cross":
+                yield self._cross(lb, rb)
+                return
+            yield from self._merge_join(lb, rb, jt)
+
+    # -- core ---------------------------------------------------------------
+    def _match_ranges(self, lb, rb):
+        """Sort right side; binary-search match ranges for left rows.
+
+        One cached jitted kernel per (keys, schemas) pair."""
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        left_keys, right_keys = self.left_keys, self.right_keys
+
+        def build():
+            def run(lb, rb):
+                r_limbs, r_null = _key_limbs(rb, right_keys)
+                r_excl = ((~rb.sel) | r_null).astype(jnp.uint64)
+                sorted_limbs, perm = ORD.sort_by_keys(
+                    [r_excl] + r_limbs)
+                l_limbs, l_null = _key_limbs(lb, left_keys)
+                q_limbs = [jnp.zeros((lb.capacity,), jnp.uint64)] + l_limbs
+                lo = _lex_search(sorted_limbs, q_limbs, "left")
+                hi = _lex_search(sorted_limbs, q_limbs, "right")
+                m = hi - lo
+                l_live = lb.sel & ~l_null
+                m = jnp.where(l_live, m, 0)
+                return m, lo, perm, l_null
+            return run
+
+        fn = cached_kernel(
+            ("join_match", fingerprint(left_keys), fingerprint(right_keys),
+             fingerprint(lb.schema), fingerprint(rb.schema)), build)
+        return fn(lb, rb)
+
+    def _merge_join(self, lb, rb, jt):
+        m, lo, perm, l_null = self._match_ranges(lb, rb)
+
+        if jt in ("left_semi", "left_anti"):
+            keep = (m > 0) if jt == "left_semi" else (m == 0)
+            out = lb.with_sel(lb.sel & keep)
+            yield self._project_semi(out)
+            return
+
+        counts = m
+        if jt in ("left", "full"):
+            counts = jnp.where(lb.sel & (m == 0), 1, m)
+        bucket, li, off, total = _expand_counts(counts)
+
+        l_idx = li
+        matched = jnp.take(m, li) > 0
+        r_sorted_pos = jnp.take(lo, li) + off
+        r_idx = jnp.take(perm, jnp.clip(r_sorted_pos, 0, rb.capacity - 1))
+        out_live = jnp.arange(bucket, dtype=jnp.int64) < total
+        r_valid = out_live & matched
+        l_valid = out_live
+
+        if jt == "full":
+            # append unmatched live right rows after the left-join block
+            matched_r = jnp.zeros((rb.capacity,), jnp.bool_).at[
+                jnp.where(r_valid, r_idx, rb.capacity)].set(
+                True, mode="drop")
+            r_unmatched = rb.sel & ~matched_r
+            n_extra = int(jnp.sum(r_unmatched.astype(jnp.int32)))
+            full_bucket = round_up_pow2(max(total + n_extra, 1))
+            # indices of unmatched right rows, compacted
+            ridx_extra = jnp.nonzero(
+                r_unmatched, size=rb.capacity, fill_value=rb.capacity)[0]
+            pad = full_bucket - bucket
+            if pad > 0:
+                l_idx = jnp.pad(l_idx, (0, pad))
+                r_idx = jnp.pad(r_idx, (0, pad))
+                l_valid = jnp.pad(l_valid, (0, pad))
+                r_valid = jnp.pad(r_valid, (0, pad))
+                out_live = jnp.pad(out_live, (0, pad))
+            j = jnp.arange(full_bucket, dtype=jnp.int64)
+            in_extra = (j >= total) & (j < total + n_extra)
+            extra_pos = jnp.clip(j - total, 0, rb.capacity - 1)
+            r_idx = jnp.where(
+                in_extra,
+                jnp.take(ridx_extra, extra_pos.astype(jnp.int32),
+                         mode="clip"),
+                r_idx).astype(jnp.int32)
+            l_valid = jnp.where(in_extra, False, l_valid)
+            r_valid = jnp.where(in_extra, True, r_valid)
+            out_live = out_live | in_extra
+            total += n_extra
+
+        yield self._materialize(lb, rb, l_idx, r_idx, l_valid, r_valid,
+                                out_live, jt)
+
+    def _execute_swapped(self):
+        """right outer = left outer with sides swapped, columns remapped."""
+        inner = TpuSortMergeJoinExec(
+            "left", self.right_keys, self.left_keys, self.condition,
+            self._swapped_schema(), self.children[1], self.children[0])
+        nk = len(self.left_keys)
+        lkey = [e.index for e in self.left_keys]
+        rkey = [e.index for e in self.right_keys]
+        l_rest = [i for i in range(len(self.children[0].schema))
+                  if i not in lkey]
+        r_rest = [i for i in range(len(self.children[1].schema))
+                  if i not in rkey]
+        # swapped output: [keys, right_rest, left_rest] → want
+        # [keys, left_rest, right_rest]
+        n_r, n_l = len(r_rest), len(l_rest)
+        order = (list(range(nk))
+                 + [nk + n_r + i for i in range(n_l)]
+                 + [nk + i for i in range(n_r)])
+        for b in inner.execute(0):
+            cols = tuple(b.columns[i] for i in order)
+            yield DeviceBatch(self.schema, cols, b.sel)
+
+    def _swapped_schema(self) -> T.StructType:
+        nk = len(self.left_keys)
+        rkey = [e.index for e in self.right_keys]
+        lkey = [e.index for e in self.left_keys]
+        fields = list(self.schema.fields[:nk])
+        rf = [f for i, f in enumerate(self.children[1].schema.fields)
+              if i not in rkey]
+        lf = [f for i, f in enumerate(self.children[0].schema.fields)
+              if i not in lkey]
+        return T.StructType(tuple(fields + rf + lf))
+
+    def _cross(self, lb, rb) -> DeviceBatch:
+        nl = int(jnp.sum(lb.sel.astype(jnp.int32)))
+        nr = int(jnp.sum(rb.sel.astype(jnp.int32)))
+        total = nl * nr
+        bucket = round_up_pow2(max(total, 1))
+        j = jnp.arange(bucket, dtype=jnp.int64)
+        l_idx = (j // max(nr, 1)).astype(jnp.int32)
+        r_idx = (j % max(nr, 1)).astype(jnp.int32)
+        out_live = j < total
+        return self._materialize(lb, rb, l_idx, r_idx, out_live, out_live,
+                                 out_live, "cross")
+
+    def _project_semi(self, lb: DeviceBatch) -> DeviceBatch:
+        """semi/anti output: [keys, left-rest] column order."""
+        lkey = [e.index for e in self.left_keys]
+        order = lkey + [i for i in range(len(lb.columns)) if i not in lkey]
+        cols = tuple(lb.columns[i] for i in order)
+        return DeviceBatch(self.schema, cols, lb.sel)
+
+    def _materialize(self, lb, rb, l_idx, r_idx, l_valid, r_valid,
+                     out_live, jt) -> DeviceBatch:
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        fn = cached_kernel(
+            ("join_mat", jt, fingerprint(self.left_keys),
+             fingerprint(self.right_keys), fingerprint(self.schema),
+             fingerprint(lb.schema), fingerprint(rb.schema)),
+            lambda: (lambda *a: self._materialize_impl(*a, jt)))
+        return fn(lb, rb, l_idx, r_idx, l_valid, r_valid, out_live)
+
+    def _materialize_impl(self, lb, rb, l_idx, r_idx, l_valid, r_valid,
+                          out_live, jt) -> DeviceBatch:
+        lkey = [e.index for e in self.left_keys]
+        rkey = [e.index for e in self.right_keys]
+        cross = jt == "cross"
+        cols: List[DeviceColumn] = []
+        if not cross:
+            for ki in range(len(lkey)):
+                lc = _gather_col(lb.columns[lkey[ki]], l_idx, l_valid)
+                if jt == "full":
+                    from spark_rapids_tpu.ops.expressions import device_select
+                    rc = _gather_col(rb.columns[rkey[ki]], r_idx, r_valid)
+                    lv = lc.valid_mask()
+                    sel_c = device_select(lv, lc, rc, lc.dtype)
+                    cols.append(DeviceColumn(
+                        lc.dtype, sel_c.data, lv | rc.valid_mask(),
+                        sel_c.lengths))
+                else:
+                    cols.append(lc)
+        for i in range(len(lb.columns)):
+            if not cross and i in lkey:
+                continue
+            cols.append(_gather_col(lb.columns[i], l_idx, l_valid))
+        for j in range(len(rb.columns)):
+            if not cross and j in rkey:
+                continue
+            cols.append(_gather_col(rb.columns[j], r_idx, r_valid))
+        sel = out_live
+        return DeviceBatch(self.schema, tuple(cols), sel)
+
+
+def _tag_join(meta):
+    cpu = meta.cpu
+    if cpu.condition is not None:
+        meta.will_not_work("join residual conditions not yet on device")
+    for e in list(cpu.left_keys) + list(cpu.right_keys):
+        if isinstance(e.dtype, (T.FloatType, T.DoubleType)):
+            meta.will_not_work(
+                "float join keys not yet supported on device (no 64-bit "
+                "bitcast on TPU; NaN equality under binary search)")
+    from spark_rapids_tpu.plan.overrides import tag_expression
+    for e in list(cpu.left_keys) + list(cpu.right_keys):
+        tag_expression(e, meta)
+
+
+def _convert_join(cpu, ch):
+    return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
+                                cpu.right_keys, cpu.condition, cpu.schema,
+                                ch[0], ch[1])

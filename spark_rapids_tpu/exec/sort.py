@@ -1,0 +1,136 @@
+"""Sort execs (global sort; device lexicographic sort on orderable keys).
+
+[REF: sql-plugin/../GpuSortExec.scala :: GpuSortExec, SortUtils.scala] —
+the reference calls cuDF's multi-key radix/merge sort; here the device
+sort is one stable ``lax.sort`` over the orderable key limbs from
+ops/ordering.py (direction and null placement baked into the encoding),
+with the whole partition coalesced first (RequireSingleBatch goal, as the
+reference's total-order sort requires).  Out-of-core (spill-merge) sort is
+a later phase (SURVEY §2.1 #16).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import DeviceBatch, compact
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+from spark_rapids_tpu.exec.basic import concat_device_batches
+from spark_rapids_tpu.ops import ordering as ORD
+from spark_rapids_tpu.plan.logical import SortOrder
+
+
+class CpuSortExec(CpuExec):
+    """Numpy-oracle global sort (gathers all partitions)."""
+
+    def __init__(self, orders: Sequence[SortOrder], child: CpuExec):
+        super().__init__(child.schema, child)
+        self.orders = list(orders)
+
+    def node_string(self):
+        return f"Sort [{', '.join(str(o.expr) for o in self.orders)}]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        child = self.children[0]
+        batches = [b for p in range(child.num_partitions())
+                   for b in child.execute(p)]
+        if not batches:
+            return
+        merged = _concat_host(self.schema, batches)
+        limbs: List[np.ndarray] = []
+        for o in self.orders:
+            c = o.expr.eval_cpu(merged)
+            limbs.extend(ORD.np_order_keys(
+                c.data, c.validity, c.dtype, o.ascending, o.nulls_first))
+        n = merged.num_rows
+        limbs.append(np.arange(n, dtype=np.int64).view(np.uint64))  # stable
+        perm = np.lexsort(list(reversed(limbs)))
+        cols = [H.HostCol(c.dtype, c.data[perm],
+                          None if c.validity is None else c.validity[perm])
+                for c in merged.columns]
+        yield H.HostBatch(self.schema, cols)
+
+
+def _concat_host(schema, batches: List[H.HostBatch]) -> H.HostBatch:
+    if len(batches) == 1:
+        return batches[0]
+    cols = []
+    for i, f in enumerate(schema.fields):
+        any_val = any(b.columns[i].validity is not None for b in batches)
+        data = np.concatenate([b.columns[i].data for b in batches])
+        validity = None
+        if any_val:
+            validity = np.concatenate([
+                b.columns[i].validity if b.columns[i].validity is not None
+                else np.ones(len(b.columns[i].data), bool)
+                for b in batches])
+        cols.append(H.HostCol(f.dtype, data, validity))
+    return H.HostBatch(schema, cols)
+
+
+class TpuSortExec(TpuExec):
+    """[REF: GpuSortExec] — single lax.sort over encoded key limbs."""
+
+    def __init__(self, orders: Sequence[SortOrder], child: TpuExec):
+        super().__init__(child.schema, child)
+        self.orders = list(orders)
+
+    def node_string(self):
+        return f"TpuSort [{', '.join(str(o.expr) for o in self.orders)}]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        child = self.children[0]
+        batches = [compact(b) for p in range(child.num_partitions())
+                   for b in child.execute(p)]
+        if not batches:
+            return
+        with self.timer():
+            merged = concat_device_batches(self.schema, batches)
+            yield sort_batch(merged, self.orders)
+        self.metric("numOutputBatches").add(1)
+
+
+def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]
+               ) -> DeviceBatch:
+    """Stable sort of live rows by the given orders; dead rows to the end.
+
+    One cached jitted kernel per (orders, schema) — compiles once per
+    bucket and stays hot across queries."""
+    from spark_rapids_tpu.runtime.kernel_cache import (
+        cached_kernel, fingerprint)
+    fn = cached_kernel(
+        ("sort", fingerprint(list(orders)), fingerprint(batch.schema)),
+        lambda: (lambda b: _sort_batch_impl(b, orders)))
+    return fn(batch)
+
+
+def _sort_batch_impl(batch: DeviceBatch, orders: Sequence[SortOrder]
+                     ) -> DeviceBatch:
+    dead = (~batch.sel).astype(jnp.uint64)
+    limbs: List[jnp.ndarray] = [dead]
+    for o in orders:
+        c = o.expr.eval_tpu(batch)
+        limbs.extend(ORD.column_order_keys(c, o.ascending, o.nulls_first))
+    _, perm = ORD.sort_by_keys(limbs)
+    cols = tuple(c.gather(perm) for c in batch.columns)
+    sel = jnp.take(batch.sel, perm)
+    return DeviceBatch(batch.schema, cols, sel)
+
+
+def _tag_sort(meta):
+    meta.tag_expressions([o.expr for o in meta.cpu.orders])
+
+
+def _convert_sort(cpu, ch):
+    return TpuSortExec(cpu.orders, ch[0])

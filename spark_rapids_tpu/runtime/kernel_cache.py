@@ -1,0 +1,48 @@
+"""Operator-kernel executable cache.
+
+THE TPU-idiom mechanism (SURVEY §7): each physical operator's device work
+is one jitted function, cached by the operator's *structural fingerprint*
+(expression tree, literals, dtypes, options); jax's own jit cache then
+keys on input shapes, so each (op, schema, bucket) pair compiles exactly
+once and stays hot across queries — the analog of cuDF's precompiled
+kernels, and essential on TPU where eager dispatch means one XLA
+compilation per arithmetic op.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Tuple
+
+import jax
+
+_CACHE: Dict[tuple, Callable] = {}
+
+
+def fingerprint(v) -> object:
+    """Structural, hashable key for expression/aggregate trees."""
+    from spark_rapids_tpu.columnar import dtypes as T
+
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        if isinstance(v, T.DataType):
+            return v.simple_name
+        return (type(v).__name__,) + tuple(
+            fingerprint(getattr(v, f.name)) for f in dataclasses.fields(v))
+    if isinstance(v, (list, tuple)):
+        return tuple(fingerprint(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, fingerprint(x)) for k, x in v.items()))
+    return repr(v)
+
+
+def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
+    """Return the jitted kernel for key, building+jitting it on first use."""
+    fn = _CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(builder())
+        _CACHE[key] = fn
+    return fn
+
+
+def cache_stats() -> Tuple[int,]:
+    return (len(_CACHE),)

@@ -135,17 +135,19 @@ class Column:
 
     def when(self, cond: "Column", value) -> "Column":
         u = self._u
-        if u.op != "casewhen":
-            raise TypeError("when() only chains after functions.when(...)")
+        if u.op != "casewhen" or u.payload == "closed":
+            raise TypeError("when() only chains after functions.when(...) "
+                            "and before otherwise()")
         return Column(UExpr("casewhen", u.payload,
                             u.children + (_to_uexpr(cond),
                                           _to_uexpr(value))))
 
     def otherwise(self, value) -> "Column":
         u = self._u
-        if u.op != "casewhen":
-            raise TypeError("otherwise() only follows when()")
-        return Column(UExpr("casewhen", u.payload,
+        if u.op != "casewhen" or u.payload == "closed":
+            raise TypeError("otherwise() only follows when() and may "
+                            "appear once")
+        return Column(UExpr("casewhen", "closed",
                             u.children + (_to_uexpr(value),)))
 
     def asc(self) -> "Column":

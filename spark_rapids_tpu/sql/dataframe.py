@@ -95,8 +95,11 @@ class DataFrame:
         return str(e)
 
     def withColumn(self, name: str, c: Column) -> "DataFrame":
-        cols = [_col(n) for n in self.columns if n != name]
-        return self.select(*cols, c.alias(name))
+        if name in self.columns:  # replace in place (pyspark semantics)
+            cols = [(c.alias(name) if n == name else _col(n))
+                    for n in self.columns]
+            return self.select(*cols)
+        return self.select(*[_col(n) for n in self.columns], c.alias(name))
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
         cols = [(_col(n).alias(new) if n == old else _col(n))

@@ -22,7 +22,7 @@ def _unary(op):
 
 def _binary(op):
     def fn(a, b) -> Column:
-        return Column(UExpr(op, None, (_cu(a), _to_uexpr(b))))
+        return Column(UExpr(op, None, (_cu(a), _cu(b))))
     fn.__name__ = op
     return fn
 

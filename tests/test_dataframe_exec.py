@@ -242,3 +242,39 @@ def test_builder_class_idiom():
     s = (TpuSession.builder.config("spark.rapids.sql.enabled", True)
          .getOrCreate())
     assert s.rapids_conf().sql_enabled
+
+
+def test_aggregate_above_empty_limit():
+    t = gen_table(21, 50)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).limit(0).groupBy("g").agg(
+            F.sum("i").alias("si")))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).limit(0).agg(
+            F.sum("i").alias("si"), F.count("*").alias("c")))
+
+
+def test_when_after_otherwise_raises():
+    c = F.when(col("i") > 0, 1).otherwise(2)
+    with pytest.raises(TypeError):
+        c.when(col("i") < 0, 3)
+    with pytest.raises(TypeError):
+        c.otherwise(4)
+
+
+def test_with_column_replaces_in_place():
+    s = tpu_session()
+    df = s.createDataFrame([(1, 2, 3)], ["a", "b", "c"])
+    out = df.withColumn("b", col("b") * 10)
+    assert out.columns == ["a", "b", "c"]
+    assert out.collect()[0].b == 20
+
+
+def test_binary_function_string_args_are_columns():
+    import datetime
+    s = tpu_session()
+    d1 = datetime.date(2024, 3, 1)
+    d2 = datetime.date(2024, 2, 1)
+    df = s.createDataFrame([(d1, d2)], ["end", "start"])
+    assert df.select(
+        F.datediff("end", "start").alias("dd")).collect()[0].dd == 29

@@ -1,0 +1,177 @@
+"""Sort and join CPU-vs-TPU oracle tests.
+
+[REF: integration_tests/src/main/python/sort_test.py, join_test.py]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, assert_tpu_fallback_collect)
+
+
+def gen_table(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "i": dg.IntegerGen(min_val=-50, max_val=50).generate(rng, n),
+        "l": dg.LongGen().generate(rng, n),
+        "d": dg.DoubleGen().generate(rng, n),
+        "s": dg.StringGen().generate(rng, n),
+        "k": pa.array((np.arange(n) % 11).astype(np.int32)),
+    })
+
+
+# ---------------------------------------------------------------------------
+# sort
+# ---------------------------------------------------------------------------
+
+def test_orderby_int_asc():
+    t = gen_table(0)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("i", "l"))
+
+
+def test_orderby_desc_and_nulls():
+    t = gen_table(1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy(col("i").desc(), col("l")))
+
+
+def test_orderby_double_nan():
+    t = pa.table({"d": pa.array([1.0, float("nan"), None, -0.0, 0.0,
+                                 float("-inf"), float("inf"), 2.5]),
+                  "x": pa.array(list(range(8)))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("d", "x"))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy(col("d").desc(), col("x")))
+
+
+def test_orderby_string():
+    t = gen_table(2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("s", "i"))
+
+
+def test_orderby_multi_partition():
+    t = gen_table(3)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("k", col("i").desc()),
+        conf={"spark.default.parallelism": 3})
+
+
+def test_sort_then_limit_topn():
+    t = gen_table(4)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("l").limit(13))
+
+
+# ---------------------------------------------------------------------------
+# join
+# ---------------------------------------------------------------------------
+
+def two_tables(seed=0, nl=300, nr=200, nullable=True):
+    rng = np.random.default_rng(seed)
+    kl = dg.IntegerGen(min_val=0, max_val=40,
+                       null_ratio=0.1 if nullable else 0).generate(rng, nl)
+    kr = dg.IntegerGen(min_val=0, max_val=40,
+                       null_ratio=0.1 if nullable else 0).generate(rng, nr)
+    left = pa.table({
+        "k": kl,
+        "lv": dg.LongGen().generate(rng, nl),
+        "ls": dg.StringGen().generate(rng, nl),
+    })
+    right = pa.table({
+        "k": kr,
+        "rv": dg.DoubleGen().generate(rng, nr),
+    })
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_join_int_key(how):
+    l, r = two_tables(5)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k", how),
+        ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full"])
+def test_join_string_key(how):
+    rng = np.random.default_rng(7)
+    l = pa.table({"g": dg.StringGen(max_len=12).generate(rng, 150),
+                  "x": dg.IntegerGen().generate(rng, 150)})
+    r = pa.table({"g": dg.StringGen(max_len=12).generate(rng, 120),
+                  "y": dg.LongGen().generate(rng, 120)})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "g", how),
+        ignore_order=True)
+
+
+def test_join_multi_key():
+    rng = np.random.default_rng(8)
+    l = pa.table({"a": dg.IntegerGen(min_val=0, max_val=5).generate(rng, 200),
+                  "b": dg.StringGen(max_len=4).generate(rng, 200),
+                  "x": dg.LongGen().generate(rng, 200)})
+    r = pa.table({"a": dg.IntegerGen(min_val=0, max_val=5).generate(rng, 150),
+                  "b": dg.StringGen(max_len=4).generate(rng, 150),
+                  "y": dg.DoubleGen().generate(rng, 150)})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r), ["a", "b"], "inner"),
+        ignore_order=True)
+
+
+def test_cross_join():
+    l = pa.table({"x": pa.array([1, 2, 3])})
+    r = pa.table({"y": pa.array(["a", "b"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).crossJoin(s.createDataFrame(r)),
+        ignore_order=True)
+
+
+def test_join_empty_side():
+    l, r = two_tables(9)
+    empty = r.slice(0, 0)
+    for how in ("inner", "left", "left_anti"):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.createDataFrame(l).join(
+                s.createDataFrame(empty), "k", how),
+            ignore_order=True)
+
+
+def test_join_float_key_falls_back():
+    rng = np.random.default_rng(10)
+    l = pa.table({"d": dg.DoubleGen().generate(rng, 50),
+                  "x": dg.IntegerGen().generate(rng, 50)})
+    r = pa.table({"d": dg.DoubleGen().generate(rng, 50),
+                  "y": dg.IntegerGen().generate(rng, 50)})
+    assert_tpu_fallback_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "d"),
+        "Join", ignore_order=True)
+
+
+def test_join_then_aggregate():
+    l, r = two_tables(11)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: (s.createDataFrame(l)
+                   .join(s.createDataFrame(r), "k", "inner")
+                   .groupBy("k").agg(F.count("*").alias("c"),
+                                     F.sum("lv").alias("sl"))),
+        ignore_order=True)
+
+
+def test_join_skewed_duplicate_keys():
+    # many-to-many expansion
+    l = pa.table({"k": pa.array([1] * 50 + [2] * 3 + [3]),
+                  "x": pa.array(list(range(54)))})
+    r = pa.table({"k": pa.array([1] * 40 + [3] * 2),
+                  "y": pa.array(list(range(42)))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k"),
+        ignore_order=True)
