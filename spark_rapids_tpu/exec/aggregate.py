@@ -103,11 +103,11 @@ def segment_groupby(
     front in group order.
     """
     b = int(sel.shape[0])
-    dead = (~sel).astype(jnp.uint64)
-    limbs = [dead] + ORD.batch_group_keys(list(key_cols))
+    parts = [ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols))
+    limbs = ORD.fuse_parts(parts)
     sorted_limbs, perm = ORD.sort_by_keys(limbs)
 
-    live_sorted = sorted_limbs[0] == 0
+    live_sorted = jnp.take(sel, perm)
     diff = jnp.zeros((b,), jnp.bool_)
     for l in sorted_limbs:
         diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
@@ -117,7 +117,7 @@ def segment_groupby(
     # group END rows hold the completed segment reductions
     is_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
     # compaction: ends of live groups to the front, in group order
-    rank = jnp.where(is_end & live_sorted, jnp.uint64(0), jnp.uint64(1))
+    rank = (~(is_end & live_sorted)).astype(jnp.uint8)
     _, perm2 = ORD.sort_by_keys([rank])
 
     def to_front(x_sorted):

@@ -246,17 +246,17 @@ def _expand_counts(counts: jnp.ndarray) -> Tuple[int, jnp.ndarray,
     return bucket, i_c, off, total
 
 
-def _key_limbs(batch: DeviceBatch, keys: Sequence[Expression]
-               ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
-    """(equality limbs, has_null_key) for the join keys of a batch."""
+def _key_parts(batch: DeviceBatch, keys: Sequence[Expression]
+               ) -> Tuple[List["ORD.Part"], jnp.ndarray]:
+    """(equality key parts, has_null_key) for the join keys of a batch."""
     has_null = jnp.zeros((batch.capacity,), jnp.bool_)
-    limbs: List[jnp.ndarray] = []
+    parts: List[ORD.Part] = []
     for e in keys:
         c = e.eval_tpu(batch)
         if c.validity is not None:
             has_null = has_null | ~c.validity
-        limbs.extend(ORD.column_order_keys(c, True, True))
-    return limbs, has_null
+        parts.extend(ORD.column_order_parts(c, True, True))
+    return parts, has_null
 
 
 def _gather_col(c: DeviceColumn, idx: jnp.ndarray,
@@ -310,12 +310,16 @@ class TpuSortMergeJoinExec(TpuExec):
 
         def build():
             def run(lb, rb):
-                r_limbs, r_null = _key_limbs(rb, right_keys)
-                r_excl = ((~rb.sel) | r_null).astype(jnp.uint64)
-                sorted_limbs, perm = ORD.sort_by_keys(
-                    [r_excl] + r_limbs)
-                l_limbs, l_null = _key_limbs(lb, left_keys)
-                q_limbs = [jnp.zeros((lb.capacity,), jnp.uint64)] + l_limbs
+                r_parts, r_null = _key_parts(rb, right_keys)
+                r_excl = (~rb.sel) | r_null
+                sorted_limbs, perm = ORD.sort_by_keys(ORD.fuse_parts(
+                    [ORD._flag_part(r_excl)] + r_parts))
+                l_parts, l_null = _key_parts(lb, left_keys)
+                # identical part widths on both sides ⇒ identical fused
+                # limb layout, so fused limbs compare 1:1
+                q_zero = ORD._flag_part(
+                    jnp.zeros((lb.capacity,), jnp.bool_))
+                q_limbs = ORD.fuse_parts([q_zero] + l_parts)
                 lo = _lex_search(sorted_limbs, q_limbs, "left")
                 hi = _lex_search(sorted_limbs, q_limbs, "right")
                 m = hi - lo

@@ -117,12 +117,11 @@ def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]
 
 def _sort_batch_impl(batch: DeviceBatch, orders: Sequence[SortOrder]
                      ) -> DeviceBatch:
-    dead = (~batch.sel).astype(jnp.uint64)
-    limbs: List[jnp.ndarray] = [dead]
+    parts = [ORD._flag_part(~batch.sel)]
     for o in orders:
         c = o.expr.eval_tpu(batch)
-        limbs.extend(ORD.column_order_keys(c, o.ascending, o.nulls_first))
-    _, perm = ORD.sort_by_keys(limbs)
+        parts.extend(ORD.column_order_parts(c, o.ascending, o.nulls_first))
+    _, perm = ORD.sort_by_keys(ORD.fuse_parts(parts))
     cols = tuple(c.gather(perm) for c in batch.columns)
     sel = jnp.take(batch.sel, perm)
     return DeviceBatch(batch.schema, cols, sel)

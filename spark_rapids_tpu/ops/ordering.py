@@ -39,90 +39,162 @@ def _i_to_u64(x: jnp.ndarray) -> jnp.ndarray:
     return (x64.astype(jnp.uint64)) ^ jnp.uint64(1 << 63)
 
 
-def _string_limbs(data: jnp.ndarray, lengths: jnp.ndarray) -> List[jnp.ndarray]:
-    """uint8[B,W] + len → ceil(W/8) big-endian uint64 limbs.
+# A key "part" is (array, bits): an order-preserving unsigned value held
+# in a uint64 array occupying the low `bits` bits — or (array, "f64") for
+# a raw float64 limb (unfusable: no 64-bit bitcast compiles on TPU).
+# ``fuse_parts`` then packs consecutive parts into as few uint64 sort
+# operands as possible: sort operand count is the dominant TPU compile
+# cost (~25-60 s per extra operand at 128k rows, measured), so a typical
+# (dead, null, int32-key) triple becomes ONE operand instead of three.
+Part = Tuple[jnp.ndarray, object]
+
+
+def _int_part(x: jnp.ndarray, width: int, ascending: bool) -> Part:
+    if width == 64:
+        u = _i_to_u64(x)
+        return ((~u if not ascending else u), 64)
+    bias = jnp.int64(1 << (width - 1))
+    u = (x.astype(jnp.int64) + bias).astype(jnp.uint64)
+    if not ascending:
+        u = u ^ jnp.uint64((1 << width) - 1)
+    return (u, width)
+
+
+def _flag_part(flag_is_one: jnp.ndarray) -> Part:
+    return (flag_is_one.astype(jnp.uint64), 1)
+
+
+def _f32_orderable_u32(x: jnp.ndarray, normalize_zero: bool) -> jnp.ndarray:
+    import jax
+    canon = jnp.where(jnp.isnan(x), jnp.asarray(np.nan, jnp.float32), x)
+    if normalize_zero:
+        canon = jnp.where(canon == 0.0, jnp.asarray(0.0, jnp.float32),
+                          canon)
+    bits = jax.lax.bitcast_convert_type(canon.astype(jnp.float32),
+                                        jnp.uint32)
+    neg = (bits >> jnp.uint32(31)) != 0
+    return jnp.where(neg, ~bits, bits | jnp.uint32(1 << 31))
+
+
+def fuse_parts(parts: List[Part]) -> List[jnp.ndarray]:
+    """Pack consecutive uint parts into shared uint64 limbs (big-endian:
+    earlier = more significant), flushing around raw-float parts."""
+    limbs: List[jnp.ndarray] = []
+    acc = None
+    used = 0
+    for arr, bits in parts:
+        if bits == "f64":
+            if acc is not None:
+                limbs.append(acc)
+                acc, used = None, 0
+            limbs.append(arr)
+            continue
+        if acc is None:
+            acc, used = arr, bits
+        elif used + bits <= 64:
+            acc = (acc << jnp.uint64(bits)) | arr
+            used += bits
+        else:
+            limbs.append(acc)
+            acc, used = arr, bits
+    if acc is not None:
+        limbs.append(acc)
+    return limbs
+
+
+def _string_parts(data: jnp.ndarray, lengths: jnp.ndarray) -> List[Part]:
+    """uint8[B,W] + len → big-endian packed byte parts + a length part.
 
     Bytes beyond each row's length are zeroed so 'ab' < 'ab\\x00…' padding
-    can't corrupt comparisons (real NUL bytes inside strings still order
-    correctly only when lengths differ at the same limb — to disambiguate
-    'a' vs 'a\\0' a final length limb is appended by the caller).
+    can't corrupt comparisons; the trailing length part disambiguates
+    real NUL bytes ('a' vs 'a\\0').  The final byte chunk is annotated
+    with its true bit width so short strings fuse with neighbors.
     """
     b, w = data.shape
-    wpad = (-w) % 8
-    if wpad:
-        data = jnp.pad(data, ((0, 0), (0, wpad)))
-        w += wpad
     colidx = jnp.arange(w, dtype=jnp.int32)
     masked = jnp.where(colidx[None, :] < lengths[:, None], data,
                        jnp.uint8(0))
-    limbs = []
-    for i in range(w // 8):
-        chunk = masked[:, i * 8:(i + 1) * 8].astype(jnp.uint64)
+    parts: List[Part] = []
+    for i in range(0, w, 8):
+        chunk = masked[:, i:i + 8].astype(jnp.uint64)
+        nbytes = chunk.shape[1]
         limb = jnp.zeros((b,), jnp.uint64)
-        for j in range(8):
+        for j in range(nbytes):
             limb = (limb << jnp.uint64(8)) | chunk[:, j]
-        limbs.append(limb)
-    return limbs
+        parts.append((limb, 8 * nbytes))
+    parts.append((lengths.astype(jnp.int64).astype(jnp.uint64), 32))
+    return parts
+
+
+_INT_WIDTH = {T.ByteType: 8, T.ShortType: 16, T.IntegerType: 32,
+              T.DateType: 32, T.LongType: 64, T.TimestampType: 64}
+
+
+def column_order_parts(col: DeviceColumn, ascending: bool = True,
+                       nulls_first: bool = True,
+                       distinguish_neg_zero: bool = True) -> List[Part]:
+    """Encode one column as key parts (most-significant first).
+
+    Parts are width-annotated unsigned values (fused downstream) except
+    float64, which stays a RAW float limb: XLA's ``lax.sort`` comparator
+    is IEEE total order (-NaN < -inf < … < -0/+0 < … < +inf < NaN, zeros
+    tied), which matches Java ``Double.compare`` (Spark's ordering) once
+    NaNs are canonicalized and the zero tie is broken by a trailing
+    sign part.  Raw f64 avoids 64-bit bitcasts, which the TPU
+    x64-rewrite pass cannot compile (probed on the real chip); f32 CAN
+    bitcast, so it rides orderable u32 bits.
+    """
+    dt = col.dtype
+    parts: List[Part]
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        parts = _string_parts(col.data, col.lengths)
+        if not ascending:
+            parts = [(a ^ jnp.uint64((1 << b) - 1), b) for a, b in parts]
+    elif isinstance(dt, T.FloatType):
+        u = _f32_orderable_u32(col.data,
+                               normalize_zero=not distinguish_neg_zero)
+        if not ascending:
+            u = ~u
+        parts = [(u.astype(jnp.uint64), 32)]
+    elif isinstance(dt, T.DoubleType):
+        # NaN placement rides its own part: XLA negation does not flip
+        # NaN's sign, so descending-by-negation alone would sort NaN
+        # last instead of first.  Spark: NaN greatest.
+        isn = jnp.isnan(col.data)
+        nan_part = _flag_part(isn if ascending else ~isn)
+        zero = jnp.zeros((), col.data.dtype)
+        val = jnp.where(isn, zero, col.data)
+        parts = [nan_part, (val if ascending else -val, "f64")]
+        if distinguish_neg_zero:
+            # XLA's sort treats -0.0 == 0.0; Spark orders -0.0 < 0.0.
+            # signbit needs a bitcast, so detect the sign via 1/x.
+            neg_zero = (col.data == zero) & ((jnp.ones(
+                (), col.data.dtype) / col.data) < zero)
+            parts.append(_flag_part(~neg_zero if ascending else neg_zero))
+    elif isinstance(dt, T.BooleanType):
+        parts = [(col.data.astype(jnp.uint64)
+                  if ascending else (~col.data).astype(jnp.uint64), 1)]
+    elif isinstance(dt, T.DecimalType):
+        parts = [_int_part(col.data, 64, ascending)]
+    else:  # integral, date, timestamp
+        parts = [_int_part(col.data, _INT_WIDTH[type(dt)], ascending)]
+    # null part: orders independently of direction: nulls_first ⇒ nulls 0
+    if col.validity is not None:
+        np_ = _flag_part(col.validity if nulls_first else ~col.validity)
+        # also zero data parts of nulls for deterministic grouping
+        parts = [(jnp.where(col.validity, a, jnp.zeros((), a.dtype)), b)
+                 for a, b in parts]
+        parts = [np_] + parts
+    return parts
 
 
 def column_order_keys(col: DeviceColumn, ascending: bool = True,
                       nulls_first: bool = True,
                       distinguish_neg_zero: bool = True
                       ) -> List[jnp.ndarray]:
-    """Encode one column as key limbs (most-significant first).
-
-    Limbs are uint64 except floats, which stay RAW float limbs: XLA's
-    ``lax.sort`` comparator is IEEE total order (-NaN < -inf < … < -0 <
-    +0 < … < +inf < NaN), which matches Java ``Double.compare`` (Spark's
-    ordering) once NaNs are canonicalized to the positive quiet NaN.  Raw
-    floats avoid 64-bit bitcasts, which the TPU x64-rewrite pass cannot
-    compile (f64↔u64 ``bitcast_convert_type`` fails on device — found by
-    probing the real chip; see exec/aggregate.py float min/max for the
-    same constraint).
-    """
-    dt = col.dtype
-    if isinstance(dt, (T.StringType, T.BinaryType)):
-        limbs = _string_limbs(col.data, col.lengths)
-        limbs.append(col.lengths.astype(jnp.int64).astype(jnp.uint64))
-        if not ascending:
-            limbs = [~l for l in limbs]
-    elif isinstance(dt, (T.FloatType, T.DoubleType)):
-        # NaN placement rides its own limb: XLA negation does not flip
-        # NaN's sign, so descending-by-negation alone would sort NaN last
-        # instead of first.  Spark: NaN greatest (last asc, first desc).
-        isn = jnp.isnan(col.data)
-        nan_limb = jnp.where(isn, jnp.uint64(1 if ascending else 0),
-                             jnp.uint64(0 if ascending else 1))
-        zero = jnp.zeros((), col.data.dtype)
-        val = jnp.where(isn, zero, col.data)
-        limbs = [nan_limb, val if ascending else -val]
-        if distinguish_neg_zero:
-            # XLA's sort comparator treats -0.0 == 0.0; Spark (Java
-            # Double.compare) orders -0.0 < 0.0.  signbit needs a bitcast
-            # (unavailable for f64 on TPU), so detect the sign via 1/x.
-            neg_zero = (col.data == zero) & ((jnp.ones(
-                (), col.data.dtype) / col.data) < zero)
-            limbs.append(jnp.where(
-                neg_zero, jnp.uint64(0 if ascending else 1),
-                jnp.uint64(1 if ascending else 0)))
-    elif isinstance(dt, T.BooleanType):
-        limbs = [col.data.astype(jnp.uint64)]
-        if not ascending:
-            limbs = [~l for l in limbs]
-    else:  # integral, date, timestamp, decimal64
-        limbs = [_i_to_u64(col.data)]
-        if not ascending:
-            limbs = [~l for l in limbs]
-    # null limb: orders independently of direction: nulls_first ⇒ nulls 0
-    if col.validity is not None:
-        nl = jnp.where(col.validity,
-                       jnp.uint64(1 if nulls_first else 0),
-                       jnp.uint64(0 if nulls_first else 1))
-        # also zero data limbs of nulls for deterministic grouping
-        limbs = [jnp.where(col.validity, l, jnp.zeros((), l.dtype))
-                 for l in limbs]
-        limbs = [nl] + limbs
-    return limbs
+    """Single-column convenience wrapper: encode + fuse."""
+    return fuse_parts(column_order_parts(
+        col, ascending, nulls_first, distinguish_neg_zero))
 
 
 def limb_neq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -133,13 +205,13 @@ def limb_neq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return a != b
 
 
-def batch_group_keys(cols: List[DeviceColumn]) -> List[jnp.ndarray]:
-    """Key limbs for GROUP BY (direction irrelevant; nulls one group;
+def batch_group_parts(cols: List[DeviceColumn]) -> List[Part]:
+    """Key parts for GROUP BY (direction irrelevant; nulls one group;
     -0.0 and 0.0 one group — Spark normalizes float grouping keys)."""
-    out: List[jnp.ndarray] = []
+    out: List[Part] = []
     for c in cols:
-        out.extend(column_order_keys(c, True, True,
-                                     distinguish_neg_zero=False))
+        out.extend(column_order_parts(c, True, True,
+                                      distinguish_neg_zero=False))
     return out
 
 
