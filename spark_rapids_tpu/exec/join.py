@@ -12,9 +12,17 @@ Pallas hash join second"):
   the output bucket, the analog of cuDF's join output allocation).
 
 Null keys never match (Spark equi-join semantics); rows with null keys
-still surface for outer/anti outputs.  Float keys fall back to CPU for
-now (binary-search equality on raw floats vs NaN is ill-defined and
-64-bit bitcasts don't compile on TPU).
+still surface for outer/anti outputs.
+
+Key encoding is CANONICAL across sides: both sides must emit the exact
+same limb layout or the fused-limb comparison is garbage (a right side
+with no validity mask, a narrower string matrix, or an int32 vs int64 key
+would otherwise encode differently).  So join keys always encode as:
+integral family → 64-bit biased; strings → byte matrix padded to the
+shared max width of both sides; f32 → orderable u32 bits; f64 → NaN flag
++ raw float limb.  Null/dead rows are excluded via the leading exclusion
+flag, not via per-column null limbs.  Float keys follow Spark's
+NormalizeFloatingNumbers semantics (NaN == NaN, -0.0 == 0.0 as keys).
 """
 
 from __future__ import annotations
@@ -207,6 +215,9 @@ def _lex_search(sorted_limbs: List[jnp.ndarray],
     Returns, per query row, the first index i in the sorted table where
     table[i] >= query ('left') or > query ('right').  All limbs uint64.
     """
+    assert len(sorted_limbs) == len(query_limbs), (
+        "join key limb layouts differ between sides: "
+        f"{len(sorted_limbs)} vs {len(query_limbs)}")
     n = int(sorted_limbs[0].shape[0])
     nq = int(query_limbs[0].shape[0])
     lo = jnp.zeros((nq,), jnp.int32)
@@ -246,17 +257,79 @@ def _expand_counts(counts: jnp.ndarray) -> Tuple[int, jnp.ndarray,
     return bucket, i_c, off, total
 
 
-def _key_parts(batch: DeviceBatch, keys: Sequence[Expression]
+_INT_FAMILY = (T.ByteType, T.ShortType, T.IntegerType, T.LongType)
+
+
+def _join_key_family(dt: T.DataType) -> str:
+    """Key-compatibility class: int family members may join each other
+    (both canonicalize to 64-bit); everything else must match exactly."""
+    if isinstance(dt, _INT_FAMILY):
+        return "int"
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return "float" + str(32 if isinstance(dt, T.FloatType) else 64)
+    return dt.simple_name
+
+
+def _canonical_key_parts(c: DeviceColumn, str_width: int
+                         ) -> List["ORD.Part"]:
+    """Equality-key parts with a layout that depends only on the key's
+    family (and the shared string width) — never on validity presence,
+    batch-local string width, or int width.  Null/dead rows are excluded
+    by the caller's exclusion flag, so no null limbs are needed here."""
+    dt = c.dtype
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        data = c.data
+        w = int(data.shape[1])
+        if w < str_width:
+            data = jnp.pad(data, ((0, 0), (0, str_width - w)))
+        return ORD._string_parts(data, c.lengths)
+    if isinstance(dt, T.FloatType):
+        # NaN canonicalized, -0.0 == 0.0 (Spark NormalizeFloatingNumbers)
+        u = ORD._f32_orderable_u32(c.data, normalize_zero=True)
+        return [(u.astype(jnp.uint64), 32)]
+    if isinstance(dt, T.DoubleType):
+        # no 64-bit bitcast on TPU: NaN rides a flag limb, the value
+        # rides a RAW float limb (NaN zeroed; -0.0 == 0.0 holds under
+        # both lax.sort's comparator and the ==/< of the binary search)
+        isn = jnp.isnan(c.data)
+        zero = jnp.zeros((), c.data.dtype)
+        val = jnp.where(isn, zero, c.data)
+        # -0.0 → +0.0: lax.sort's total-order comparator splits the two
+        # zeros while the binary search's IEEE == does not — normalize so
+        # both agree (and Spark joins the zeros as one key anyway)
+        val = jnp.where(val == zero, zero, val)
+        return [ORD._flag_part(isn), (val, "f64")]
+    if isinstance(dt, T.BooleanType):
+        return [(c.data.astype(jnp.uint64), 1)]
+    # integral family, date, timestamp, decimal → 64-bit biased encoding
+    return [ORD._int_part(c.data.astype(jnp.int64), 64, True)]
+
+
+def _key_parts(batch: DeviceBatch, keys: Sequence[Expression],
+               str_widths: Sequence[int]
                ) -> Tuple[List["ORD.Part"], jnp.ndarray]:
-    """(equality key parts, has_null_key) for the join keys of a batch."""
+    """(canonical equality key parts, has_null_key) for a batch's keys."""
     has_null = jnp.zeros((batch.capacity,), jnp.bool_)
     parts: List[ORD.Part] = []
-    for e in keys:
+    for e, w in zip(keys, str_widths):
         c = e.eval_tpu(batch)
         if c.validity is not None:
             has_null = has_null | ~c.validity
-        parts.extend(ORD.column_order_parts(c, True, True))
+        parts.extend(_canonical_key_parts(c, w))
     return parts, has_null
+
+
+def _key_str_width(batch: DeviceBatch, e: Expression) -> int:
+    """Static string width of a key expression's result on this batch.
+
+    Column refs read the width off the batch; other string expressions
+    trace once against a zero-capacity stand-in (shapes only, no data)."""
+    if not isinstance(e.dtype, (T.StringType, T.BinaryType)):
+        return 0
+    if hasattr(e, "index"):
+        return int(batch.columns[e.index].data.shape[1])
+    shape = jax.eval_shape(lambda b: e.eval_tpu(b).data, batch)
+    return int(shape.shape[1])
 
 
 def _gather_col(c: DeviceColumn, idx: jnp.ndarray,
@@ -307,16 +380,21 @@ class TpuSortMergeJoinExec(TpuExec):
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
         left_keys, right_keys = self.left_keys, self.right_keys
+        # shared static string width per key pair: canonical layouts on
+        # the two sides must match even when batch paddings differ
+        widths = tuple(
+            max(_key_str_width(lb, le), _key_str_width(rb, re))
+            for le, re in zip(left_keys, right_keys))
 
         def build():
             def run(lb, rb):
-                r_parts, r_null = _key_parts(rb, right_keys)
+                r_parts, r_null = _key_parts(rb, right_keys, widths)
                 r_excl = (~rb.sel) | r_null
                 sorted_limbs, perm = ORD.sort_by_keys(ORD.fuse_parts(
                     [ORD._flag_part(r_excl)] + r_parts))
-                l_parts, l_null = _key_parts(lb, left_keys)
-                # identical part widths on both sides ⇒ identical fused
-                # limb layout, so fused limbs compare 1:1
+                l_parts, l_null = _key_parts(lb, left_keys, widths)
+                # canonical encoding ⇒ identical part widths on both
+                # sides ⇒ identical fused limb layout, compare 1:1
                 q_zero = ORD._flag_part(
                     jnp.zeros((lb.capacity,), jnp.bool_))
                 q_limbs = ORD.fuse_parts([q_zero] + l_parts)
@@ -329,7 +407,8 @@ class TpuSortMergeJoinExec(TpuExec):
             return run
 
         fn = cached_kernel(
-            ("join_match", fingerprint(left_keys), fingerprint(right_keys),
+            ("join_match", widths, fingerprint(left_keys),
+             fingerprint(right_keys),
              fingerprint(lb.schema), fingerprint(rb.schema)), build)
         return fn(lb, rb)
 
@@ -487,11 +566,21 @@ def _tag_join(meta):
     cpu = meta.cpu
     if cpu.condition is not None:
         meta.will_not_work("join residual conditions not yet on device")
-    for e in list(cpu.left_keys) + list(cpu.right_keys):
-        if isinstance(e.dtype, (T.FloatType, T.DoubleType)):
+    for le, re in zip(cpu.left_keys, cpu.right_keys):
+        lf, rf = _join_key_family(le.dtype), _join_key_family(re.dtype)
+        if lf != rf:
             meta.will_not_work(
-                "float join keys not yet supported on device (no 64-bit "
-                "bitcast on TPU; NaN equality under binary search)")
+                f"join key type mismatch: {le.dtype.simple_name} vs "
+                f"{re.dtype.simple_name} (no implicit cast inserted)")
+        elif (type(le.dtype) is not type(re.dtype)
+              and cpu.join_type in ("right", "full")):
+            # right/full coalesce the two key columns into one output
+            # column typed after the left key — mixed int widths would
+            # smuggle int64 data under an int32 schema
+            meta.will_not_work(
+                "mixed-width int join keys not supported for "
+                f"{cpu.join_type} joins (output key column would mix "
+                f"{le.dtype.simple_name} and {re.dtype.simple_name})")
     from spark_rapids_tpu.plan.overrides import tag_expression
     for e in list(cpu.left_keys) + list(cpu.right_keys):
         tag_expression(e, meta)

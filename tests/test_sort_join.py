@@ -145,14 +145,51 @@ def test_join_empty_side():
             ignore_order=True)
 
 
-def test_join_float_key_falls_back():
+@pytest.mark.parametrize("how", ["inner", "left", "full"])
+def test_join_double_key(how):
+    # Spark NormalizeFloatingNumbers: NaN == NaN, -0.0 == 0.0 as join keys
+    special = [float("nan"), -0.0, 0.0, float("inf"), float("-inf"), None]
     rng = np.random.default_rng(10)
-    l = pa.table({"d": dg.DoubleGen().generate(rng, 50),
-                  "x": dg.IntegerGen().generate(rng, 50)})
-    r = pa.table({"d": dg.DoubleGen().generate(rng, 50),
-                  "y": dg.IntegerGen().generate(rng, 50)})
+    lv = list(rng.integers(-5, 5, 40).astype(float)) + special
+    rv = list(rng.integers(-5, 5, 30).astype(float)) + special
+    l = pa.table({"d": pa.array(lv, type=pa.float64()),
+                  "x": pa.array(list(range(len(lv))))})
+    r = pa.table({"d": pa.array(rv, type=pa.float64()),
+                  "y": pa.array(list(range(len(rv))))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "d", how),
+        ignore_order=True)
+
+
+def test_join_float32_key():
+    special = [float("nan"), -0.0, 0.0, None]
+    rng = np.random.default_rng(12)
+    lv = list(rng.integers(-5, 5, 40).astype(np.float32)) + special
+    rv = list(rng.integers(-5, 5, 30).astype(np.float32)) + special
+    l = pa.table({"f": pa.array(lv, type=pa.float32()),
+                  "x": pa.array(list(range(len(lv))))})
+    r = pa.table({"f": pa.array(rv, type=pa.float32()),
+                  "y": pa.array(list(range(len(rv))))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "f"),
+        ignore_order=True)
+
+
+def test_join_mixed_int_width_key():
+    # int32 key joined against int64 key: canonical 64-bit encoding
+    rng = np.random.default_rng(13)
+    l = pa.table({"k": pa.array(rng.integers(0, 20, 60), type=pa.int32()),
+                  "x": pa.array(list(range(60)))})
+    r = pa.table({"k": pa.array(rng.integers(0, 20, 40), type=pa.int64()),
+                  "y": pa.array(list(range(40)))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k"),
+        ignore_order=True)
+    # right/full would coalesce int32+int64 key data into one column —
+    # stays on CPU
     assert_tpu_fallback_collect(
-        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "d"),
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            "full"),
         "Join", ignore_order=True)
 
 
