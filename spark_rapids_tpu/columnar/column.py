@@ -301,6 +301,15 @@ def _pad_col(c: DeviceColumn, bucket: int) -> DeviceColumn:
     return DeviceColumn(c.dtype, data, validity, lengths)
 
 
+def pad_batch(batch: DeviceBatch, capacity: int) -> DeviceBatch:
+    """Grow a batch's row capacity (pad rows are dead)."""
+    if batch.capacity >= capacity:
+        return batch
+    cols = tuple(_pad_col(c, capacity) for c in batch.columns)
+    sel = jnp.pad(batch.sel, (0, capacity - batch.capacity))
+    return DeviceBatch(batch.schema, cols, sel)
+
+
 def host_to_device(table: pa.Table, bucket: Optional[int] = None,
                    min_bucket: int = 1024) -> DeviceBatch:
     """pyarrow.Table -> padded DeviceBatch."""

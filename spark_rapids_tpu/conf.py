@@ -415,6 +415,10 @@ class RapidsConf:
         return self.get(SHUFFLE_PARTITIONS)
 
     @property
+    def shuffle_mode(self) -> str:
+        return str(self.get(SHUFFLE_MODE)).upper()
+
+    @property
     def ansi_enabled(self) -> bool:
         return self.get(ANSI_ENABLED)
 

@@ -268,26 +268,38 @@ def final_project(fns: Sequence[AggregateFunction],
 # ---------------------------------------------------------------------------
 
 class TpuHashAggregateExec(TpuExec):
-    """Complete-mode aggregate: update per batch → merge partials → final.
+    """Hash-aggregate exec in one of three modes, mirroring the
+    reference's partial/final split [REF: GpuHashAggregateExec]:
 
-    Gathers all child partitions (the single-partition exchange analog)
-    until the distributed exchange lands. [REF: GpuHashAggregateExec]
+    * ``complete`` — update per batch → merge partials → final project
+      (single-partition plans; gathers all child partitions).
+    * ``partial`` — per child partition: update + local merge, emitting
+      buffer-schema batches (feeds a shuffle exchange keyed on k0..kn).
+    * ``final`` — per child partition: merge received buffer batches +
+      final project (downstream of a key-hash exchange, so each
+      partition owns disjoint keys).
     """
 
     def __init__(self, grouping: Sequence[Expression],
                  fns: Sequence[AggregateFunction],
-                 schema: T.StructType, child: TpuExec):
+                 schema: T.StructType, child: TpuExec,
+                 mode: str = "complete"):
         super().__init__(schema, child)
         self.grouping = list(grouping)
         self.fns = list(fns)
+        assert mode in ("complete", "partial", "final")
+        self.mode = mode
 
     def node_string(self):
         keys = ", ".join(str(g) for g in self.grouping)
         aggs = ", ".join(fn.name for fn in self.fns)
-        return f"TpuHashAggregate [keys=[{keys}] aggs=[{aggs}]]"
+        return (f"TpuHashAggregate [{self.mode} keys=[{keys}] "
+                f"aggs=[{aggs}]]")
 
     def num_partitions(self) -> int:
-        return 1
+        if self.mode == "complete":
+            return 1
+        return self.children[0].num_partitions()
 
     def _partial(self, batch: DeviceBatch) -> DeviceBatch:
         from spark_rapids_tpu.runtime.kernel_cache import (
@@ -318,6 +330,9 @@ class TpuHashAggregateExec(TpuExec):
         return T.StructType(tuple(fields))
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        if self.mode != "complete":
+            yield from self._execute_staged(partition)
+            return
         assert partition == 0
         child = self.children[0]
         partials: List[DeviceBatch] = []
@@ -340,6 +355,60 @@ class TpuHashAggregateExec(TpuExec):
                 out = self._merge_final(merged)
         self.metric("numOutputBatches").add(1)
         yield out
+
+    def _execute_staged(self, partition: int) -> Iterator[DeviceBatch]:
+        """partial/final modes: operate on ONE child partition's stream
+        (the stage-local halves of the distributed aggregate)."""
+        from spark_rapids_tpu.columnar.column import compact, empty_batch
+        child = self.children[0]
+        with self.timer():
+            if self.mode == "partial":
+                partials = [self._partial(b)
+                            for b in child.execute(partition)]
+                if not partials:
+                    yield empty_batch(self._buffer_schema())
+                    return
+                if len(partials) == 1:
+                    out = partials[0]
+                else:
+                    merged = concat_device_batches(
+                        self._buffer_schema(),
+                        [compact(p) for p in partials])
+                    out = self._merge_buffers(merged)
+            else:  # final
+                batches = [compact(b) for b in child.execute(partition)]
+                if not batches:
+                    return
+                merged = (batches[0] if len(batches) == 1 else
+                          concat_device_batches(self._buffer_schema(),
+                                                batches))
+                out = self._merge_final(merged)
+        self.metric("numOutputBatches").add(1)
+        yield out
+
+    def _merge_buffers(self, merged: DeviceBatch) -> DeviceBatch:
+        """Merge buffer batches into one buffer batch (no final project):
+        the partial-side local combine."""
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        grouping, fns = self.grouping, self.fns
+        nk = len(grouping)
+        buffer_schema = self._buffer_schema()
+
+        def build():
+            def run(m):
+                keys = list(m.columns[:nk])
+                bufs = list(m.columns[nk:])
+                kinds = merge_kinds(fns)
+                ok, ov, sel = segment_groupby(
+                    keys, m.sel, list(zip(bufs, kinds)))
+                return DeviceBatch(buffer_schema, tuple(ok + ov), sel)
+            return run
+
+        fn = cached_kernel(
+            ("agg_merge_buffers", fingerprint(grouping), fingerprint(fns)),
+            build)
+        return fn(merged)
 
     def _merge_final(self, merged: DeviceBatch) -> DeviceBatch:
         from spark_rapids_tpu.runtime.kernel_cache import (

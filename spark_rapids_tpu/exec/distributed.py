@@ -1,0 +1,148 @@
+"""ICI shuffle exchange exec: the distributed stage boundary.
+
+[REF: GpuShuffleExchangeExecBase.scala + RapidsShuffleManager (UCX mode)]
+— rethought for TPU (SURVEY §5.8): instead of reduce tasks pulling blocks
+point-to-point, the exchange runs ONE SPMD collective program over the
+device mesh (parallel/shuffle.py) and downstream operators then consume
+their partition's received rows locally, exactly like Spark reduce tasks
+after a shuffle fetch.  Stage shape on an N-device mesh:
+
+  upstream partitions → gather+compact → row-shard over mesh
+    → {murmur3 pid → layout → all_to_all} (one jitted program)
+    → N output partitions, each device-local, capacity re-bucketed
+
+Activated by ``spark.rapids.shuffle.mode=ICI`` when the mesh has more
+than one device; the planner then splits aggregates into partial/final
+around this exchange and co-partitions join inputs through it.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import (
+    DeviceBatch, compact, round_up_pow2)
+from spark_rapids_tpu.exec.base import TpuExec
+from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.parallel import shuffle as SH
+from spark_rapids_tpu.parallel.mesh import make_mesh
+
+
+def _gather_child(child: TpuExec) -> Optional[DeviceBatch]:
+    """All child partitions → one compact device batch (None if empty)."""
+    from spark_rapids_tpu.exec.basic import concat_device_batches
+    batches = [compact(b) for p in range(child.num_partitions())
+               for b in child.execute(p)]
+    if not batches:
+        return None
+    if len(batches) == 1:
+        return batches[0]
+    return compact(concat_device_batches(child.schema, batches))
+
+
+class TpuIciShuffleExchangeExec(TpuExec):
+    """Collective shuffle exchange over the ICI mesh.
+
+    ``num_partitions() == mesh size``; ``execute(p)`` yields the rows
+    that hashed to partition p, already on device p's shard.
+    """
+
+    def __init__(self, child: TpuExec, keys: Sequence[Expression],
+                 mesh=None, canon_int64: Sequence[bool] = (),
+                 min_bucket: int = 1024):
+        super().__init__(child.schema, child)
+        self.keys = list(keys)
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.canon_int64 = tuple(canon_int64)
+        self.min_bucket = min_bucket
+        self._result: Optional[DeviceBatch] = None
+        self._empty = False
+
+    @property
+    def nparts(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def node_string(self):
+        ks = ", ".join(str(k) for k in self.keys)
+        return f"TpuIciShuffleExchange [hash({ks}) over {self.nparts}dev]"
+
+    def num_partitions(self) -> int:
+        return self.nparts
+
+    def _materialize(self) -> Optional[DeviceBatch]:
+        if self._result is not None or self._empty:
+            return self._result
+        gathered = _gather_child(self.children[0])
+        if gathered is None:
+            self._empty = True
+            return None
+        d = self.nparts
+        n = gathered.num_rows_host()
+        # local shard capacity: pow-2 bucket of the per-device share
+        local_b = round_up_pow2(max((n + d - 1) // d, 1), self.min_bucket)
+        global_cap = d * local_b
+        if gathered.capacity < global_cap:
+            from spark_rapids_tpu.columnar.column import pad_batch
+            gathered = pad_batch(gathered, global_cap)
+        elif gathered.capacity > global_cap:
+            gathered = SH.slice_batch(gathered, 0, global_cap)
+        sharded = SH.shard_batch(self.mesh, gathered)
+
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        base_key = (self.nparts, self.canon_int64, fingerprint(self.keys),
+                    fingerprint(gathered.schema))
+        with self.timer("partitionTime"):
+            count_fn = cached_kernel(
+                ("ici_count",) + base_key,
+                lambda: SH.build_count_program(
+                    self.mesh, self.keys, d, self.canon_int64))
+            counts = np.asarray(count_fn(sharded))  # [d*d]
+            cap = round_up_pow2(max(int(counts.max()), 1), 8)
+        with self.timer("collectiveTime"):
+            shuffle_fn = cached_kernel(
+                ("ici_shuffle", cap) + base_key,
+                lambda: SH.build_shuffle_program(
+                    self.mesh, self.keys, d, cap, self.canon_int64))
+            self._result = shuffle_fn(sharded)
+        self._cap = cap
+        return self._result
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        result = self._materialize()
+        if result is None:
+            return
+        d = self.nparts
+        per_dev = result.capacity // d
+        block = SH.slice_batch(result, partition * per_dev, per_dev)
+        # stage boundary: compact + re-bucket so downstream operators
+        # work at the partition's size, not the worst-case capacity
+        block = compact(block)
+        n = block.num_rows_host()
+        cap = round_up_pow2(max(n, 1), self.min_bucket)
+        if cap < block.capacity:
+            block = SH.slice_batch(block, 0, cap)
+        self.metric("numOutputRows").add(n)
+        self.metric("numOutputBatches").add(1)
+        yield block
+
+
+def ici_active(conf) -> bool:
+    """ICI shuffle requested and a real mesh exists."""
+    if conf.shuffle_mode != "ICI":
+        return False
+    import jax
+    return jax.device_count() > 1
+
+
+def hashable_on_device(dt: T.DataType) -> bool:
+    try:
+        from spark_rapids_tpu.plan.overrides import is_device_supported_type
+        return is_device_supported_type(dt) is None
+    except ImportError:
+        return False

@@ -170,5 +170,11 @@ def _tag_exchange(meta):
         meta.tag_expressions(meta.cpu.keys)
 
 
-def _convert_exchange(cpu, ch):
+def _convert_exchange(cpu, ch, conf):
+    from spark_rapids_tpu.exec.distributed import (
+        TpuIciShuffleExchangeExec, ici_active)
+    if ici_active(conf) and cpu.keys:
+        import jax
+        if cpu.nparts == jax.device_count():
+            return TpuIciShuffleExchangeExec(ch[0], cpu.keys)
     return TpuShuffleExchangeExec(ch[0], cpu.nparts, cpu.keys)

@@ -47,17 +47,19 @@ from spark_rapids_tpu.ops.expressions import Expression
 # helpers shared by both paths
 # ---------------------------------------------------------------------------
 
-def _gather_all(child, schema, device: bool):
+def _gather_all(child, schema, device: bool, partition=None):
+    """Concat child batches to one batch — all partitions, or just one
+    (the co-partitioned path downstream of a key-hash exchange)."""
+    parts = (range(child.num_partitions()) if partition is None
+             else [partition])
     if device:
-        batches = [compact(b) for p in range(child.num_partitions())
-                   for b in child.execute(p)]
+        batches = [compact(b) for p in parts for b in child.execute(p)]
         if not batches:
             from spark_rapids_tpu.columnar.column import empty_batch
             return empty_batch(schema)
         return concat_device_batches(schema, batches)
     from spark_rapids_tpu.exec.sort import _concat_host
-    batches = [b for p in range(child.num_partitions())
-               for b in child.execute(p)]
+    batches = [b for p in parts for b in child.execute(p)]
     if not batches:
         return H.HostBatch(schema, [
             H.HostCol(f.dtype,
@@ -346,26 +348,36 @@ class TpuSortMergeJoinExec(TpuExec):
     def __init__(self, join_type: str, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
                  condition: Optional[Expression], schema: T.StructType,
-                 left: TpuExec, right: TpuExec):
+                 left: TpuExec, right: TpuExec,
+                 partitioned: bool = False):
         super().__init__(schema, left, right)
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.condition = condition
+        # co-partitioned inputs (both sides exchanged on the same key
+        # hash): join partition-by-partition like Spark reduce tasks
+        self.partitioned = partitioned
 
     def node_string(self):
-        return f"TpuSortMergeJoin [{self.join_type}]"
+        part = " partitioned" if self.partitioned else ""
+        return f"TpuSortMergeJoin [{self.join_type}{part}]"
 
     def num_partitions(self) -> int:
+        if self.partitioned:
+            return self.children[0].num_partitions()
         return 1
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         jt = self.join_type
         if jt == "right":
-            yield from self._execute_swapped()
+            yield from self._execute_swapped(partition)
             return
-        lb = _gather_all(self.children[0], self.children[0].schema, True)
-        rb = _gather_all(self.children[1], self.children[1].schema, True)
+        part = partition if self.partitioned else None
+        lb = _gather_all(self.children[0], self.children[0].schema, True,
+                         part)
+        rb = _gather_all(self.children[1], self.children[1].schema, True,
+                         part)
         with self.timer():
             if jt == "cross":
                 yield self._cross(lb, rb)
@@ -468,11 +480,12 @@ class TpuSortMergeJoinExec(TpuExec):
         yield self._materialize(lb, rb, l_idx, r_idx, l_valid, r_valid,
                                 out_live, jt)
 
-    def _execute_swapped(self):
+    def _execute_swapped(self, partition: int = 0):
         """right outer = left outer with sides swapped, columns remapped."""
         inner = TpuSortMergeJoinExec(
             "left", self.right_keys, self.left_keys, self.condition,
-            self._swapped_schema(), self.children[1], self.children[0])
+            self._swapped_schema(), self.children[1], self.children[0],
+            self.partitioned)
         nk = len(self.left_keys)
         lkey = [e.index for e in self.left_keys]
         rkey = [e.index for e in self.right_keys]
@@ -486,7 +499,7 @@ class TpuSortMergeJoinExec(TpuExec):
         order = (list(range(nk))
                  + [nk + n_r + i for i in range(n_l)]
                  + [nk + i for i in range(n_r)])
-        for b in inner.execute(0):
+        for b in inner.execute(partition):
             cols = tuple(b.columns[i] for i in order)
             yield DeviceBatch(self.schema, cols, b.sel)
 
@@ -586,7 +599,28 @@ def _tag_join(meta):
         tag_expression(e, meta)
 
 
-def _convert_join(cpu, ch):
+def _convert_join(cpu, ch, conf):
+    from spark_rapids_tpu.exec.distributed import ici_active
+    if (ici_active(conf) and cpu.join_type != "cross" and cpu.left_keys):
+        # distributed: co-partition both sides through the ICI exchange
+        # on the key hash, then join partition-by-partition (the
+        # shuffled-hash-join plan shape [REF: GpuShuffledHashJoinExec])
+        from spark_rapids_tpu.exec.distributed import (
+            TpuIciShuffleExchangeExec)
+        # both exchanges must agree on pids: widen int-family keys to 64
+        # bits whenever the pair's widths differ
+        canon = tuple(
+            type(le.dtype) is not type(re.dtype)
+            and isinstance(le.dtype, _INT_FAMILY)
+            for le, re in zip(cpu.left_keys, cpu.right_keys))
+        lex = TpuIciShuffleExchangeExec(ch[0], cpu.left_keys,
+                                        canon_int64=canon)
+        rex = TpuIciShuffleExchangeExec(ch[1], cpu.right_keys,
+                                        canon_int64=canon)
+        return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
+                                    cpu.right_keys, cpu.condition,
+                                    cpu.schema, lex, rex,
+                                    partitioned=True)
     return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
                                 cpu.right_keys, cpu.condition, cpu.schema,
                                 ch[0], ch[1])

@@ -131,5 +131,5 @@ def _tag_sort(meta):
     meta.tag_expressions([o.expr for o in meta.cpu.orders])
 
 
-def _convert_sort(cpu, ch):
+def _convert_sort(cpu, ch, conf):
     return TpuSortExec(cpu.orders, ch[0])

@@ -118,7 +118,7 @@ def _tag_parquet(meta):
     pass
 
 
-def _convert_parquet(cpu: CpuParquetScanExec, ch):
+def _convert_parquet(cpu: CpuParquetScanExec, ch, conf):
     return TpuParquetScanExec(cpu.paths, cpu.schema, cpu.conf, cpu.columns)
 
 

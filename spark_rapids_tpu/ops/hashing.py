@@ -161,7 +161,11 @@ def _mix_h1(h1, k1, xp):
 
 
 def _fmix(h1, length, xp):
-    h1 = h1 ^ np.uint32(length)
+    # length may be a python int OR a per-row array (string lengths) —
+    # np.uint32() on a traced jax array would force a host conversion
+    length = (np.uint32(length) if isinstance(length, (int, np.integer))
+              else length.astype(np.uint32))
+    h1 = h1 ^ length
     h1 = h1 ^ (h1 >> np.uint32(16))
     h1 = h1 * np.uint32(0x85EBCA6B)
     h1 = h1 ^ (h1 >> np.uint32(13))

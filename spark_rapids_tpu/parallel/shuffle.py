@@ -1,0 +1,213 @@
+"""Batch-general ICI shuffle: SPMD repartitioning of whole DeviceBatches.
+
+[REF: sql-plugin/../GpuShuffleExchangeExecBase.scala,
+ RapidsShuffleInternalManagerBase.scala] — the collective inversion of the
+reference's p2p UCX shuffle (SURVEY §2.4/§5.8): one shuffle stage is ONE
+SPMD program over the mesh:
+
+  {bit-exact Spark murmur3 pids → scatter-free partition layout
+   → ``lax.all_to_all`` (ICI on hardware) → flat received batch}
+
+Everything is static-shape and scatter-free (XLA lowers scatter to a
+serial loop on TPU): rows are laid out per destination partition by a
+stable ``lax.sort`` on pid followed by a gather from per-partition start
+offsets (``searchsorted`` over the sorted pids).
+
+Shapes are bucketed in two phases, the TPU-idiom answer to data-dependent
+partition sizes: a cheap *count* program first measures the max rows any
+(device, partition) cell holds; the *shuffle* program is then compiled
+for the pow-2 bucket of that max (re-used across calls with the same
+bucket).  Worst-case skew (every row to one partition) stays correct —
+the bucket just grows.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import (
+    DeviceBatch, DeviceColumn, round_up_pow2)
+from spark_rapids_tpu.ops import hashing as HH
+from spark_rapids_tpu.ops.expressions import Expression
+
+
+def _hash_f64_tpu_safe(data: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
+    """Mix a float64 column into the running hash WITHOUT a 64-bit
+    bitcast (the TPU x64-rewrite cannot compile one — probed on the real
+    chip; ops/ordering.py carries the same constraint).
+
+    The value is canonicalized (NaN → one NaN, -0.0 → 0.0 — Spark
+    normalizes float keys before hash partitioning) and decomposed into
+    f32 hi/lo parts whose u32 bit patterns feed the murmur3 long-mix.
+    NOT bit-exact with Spark's hash of the raw f64 bits — irrelevant for
+    partitioning, which only needs every participant to agree on pids
+    (and f64 on TPU hardware is itself an f32 hi/lo pair, so the
+    original bits don't exist on device anyway)."""
+    isn = jnp.isnan(data)
+    x = jnp.where(isn, jnp.zeros((), data.dtype), data)
+    x = jnp.where(x == 0.0, jnp.zeros((), data.dtype), x)
+    hi = x.astype(jnp.float32)
+    lo = (x - hi.astype(data.dtype)).astype(jnp.float32)
+    hi_b = jnp.where(isn, jnp.uint32(0x7FC00000),
+                     HH.jax_bitcast(hi, jnp.uint32))
+    lo_b = jnp.where(isn, jnp.uint32(0), HH.jax_bitcast(lo, jnp.uint32))
+    h1 = HH._mix_h1(h, HH._mix_k1(lo_b, jnp), jnp)
+    h1 = HH._mix_h1(h1, HH._mix_k1(hi_b, jnp), jnp)
+    return HH._fmix(h1, 8, jnp)
+
+
+def make_pid_fn(keys: Sequence[Expression], nparts: int,
+                canon_int64: Sequence[bool] = ()):
+    """batch → int32 partition ids via the bit-exact Spark murmur3.
+
+    ``canon_int64[i]`` widens key i's int-family column to int64 before
+    hashing — needed when the two sides of a join carry different int
+    widths (murmur3 of int32 and int64 differ for the same value; both
+    exchanges must agree on a pid, Spark-exactness is moot for a
+    mixed-width join Spark itself would cast).
+
+    Float keys are normalized (-0.0 → 0.0, one NaN) before hashing:
+    downstream operators treat the normalized values as one key
+    (NormalizeFloatingNumbers), so equal keys MUST land on one device.
+    """
+    canon = tuple(canon_int64) or (False,) * len(keys)
+
+    def pids(batch: DeviceBatch) -> jnp.ndarray:
+        h = jnp.full((batch.capacity,), HH.SEED, jnp.uint32)
+        for e, widen in zip(keys, canon):
+            c = e.eval_tpu(batch)
+            dt = c.dtype
+            data = c.data
+            valid = c.valid_mask()
+            if widen and not isinstance(dt, T.LongType):
+                data, dt = data.astype(jnp.int64), T.LongT
+            if isinstance(dt, T.DoubleType):
+                h = jnp.where(valid, _hash_f64_tpu_safe(data, h), h)
+                continue
+            if isinstance(dt, T.FloatType):
+                data = jnp.where(data == 0.0,
+                                 jnp.zeros((), data.dtype), data)
+            h = HH.hash_column((data, c.lengths), dt, h, valid, jnp)
+        h_i32 = HH.jax_bitcast(h, jnp.int32)
+        return HH.partition_ids_from_hash(h_i32, nparts, jnp)
+
+    return pids
+
+
+def _sorted_pids(batch: DeviceBatch, pid: jnp.ndarray, nparts: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable sort rows by destination pid (dead rows → overflow bucket).
+
+    Returns (sorted pid, permutation).  One 2-operand ``lax.sort``."""
+    b = batch.capacity
+    pid = jnp.where(batch.sel, pid, nparts).astype(jnp.int32)
+    iota = jnp.arange(b, dtype=jnp.int32)
+    pid_s, perm = jax.lax.sort((pid, iota), num_keys=2)
+    return pid_s, perm
+
+
+def _partition_bounds(pid_s: jnp.ndarray, nparts: int) -> jnp.ndarray:
+    """starts/ends of each pid run in the sorted order: int32[nparts+1]."""
+    probe = jnp.arange(nparts + 1, dtype=jnp.int32)
+    return jnp.searchsorted(pid_s, probe, side="left").astype(jnp.int32)
+
+
+def local_partition_counts(batch: DeviceBatch, pid: jnp.ndarray,
+                           nparts: int) -> jnp.ndarray:
+    """Live-row count per destination partition: int32[nparts]."""
+    pid_s, _ = _sorted_pids(batch, pid, nparts)
+    bounds = _partition_bounds(pid_s, nparts)
+    return bounds[1:] - bounds[:-1]
+
+
+def partition_layout(batch: DeviceBatch, pid: jnp.ndarray, nparts: int,
+                     cap: int) -> DeviceBatch:
+    """Local [B] batch → [nparts*cap] batch: slot (p, c) holds the c-th
+    local row destined for partition p (dead beyond each count).
+
+    Scatter-free: one sort + one gather.  Rows beyond ``cap`` per
+    partition are silently dropped — callers MUST pick cap ≥ the counts
+    (the count program exists for exactly this).
+    """
+    b = batch.capacity
+    pid_s, perm = _sorted_pids(batch, pid, nparts)
+    bounds = _partition_bounds(pid_s, nparts)
+    starts, ends = bounds[:-1], bounds[1:]
+    c_idx = jnp.arange(cap, dtype=jnp.int32)
+    src = starts[:, None] + c_idx[None, :]               # [P, cap]
+    live = src < ends[:, None]
+    src_flat = jnp.clip(src.reshape(-1), 0, b - 1)
+    row_idx = jnp.take(perm, src_flat)
+    cols = tuple(c.gather(row_idx) for c in batch.columns)
+    return DeviceBatch(batch.schema, cols, live.reshape(-1))
+
+
+def exchange_collective(batch_laid: DeviceBatch, axis: str, nparts: int,
+                        cap: int) -> DeviceBatch:
+    """The wire: all_to_all every leaf of a [nparts*cap] laid-out batch.
+
+    Device d's slot block p travels to device p; the result's block p
+    holds rows received FROM device p.  Rides ICI on hardware."""
+    def coll(x):
+        x = x.reshape((nparts, cap) + x.shape[1:])
+        y = jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                               tiled=False)
+        return y.reshape((nparts * cap,) + y.shape[2:])
+
+    return jax.tree.map(coll, batch_laid)
+
+
+def build_count_program(mesh: jax.sharding.Mesh, keys, nparts: int,
+                        canon_int64=()):
+    """Phase-1 SPMD program: per-device per-partition live-row counts."""
+    axis = mesh.axis_names[0]
+    pid_fn = make_pid_fn(keys, nparts, canon_int64)
+
+    def step(batch: DeviceBatch) -> jnp.ndarray:
+        return local_partition_counts(batch, pid_fn(batch), nparts)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec))
+
+
+def build_shuffle_program(mesh: jax.sharding.Mesh, keys, nparts: int,
+                          cap: int, canon_int64=()):
+    """Phase-2 SPMD program: layout → all_to_all → flat received batch."""
+    axis = mesh.axis_names[0]
+    pid_fn = make_pid_fn(keys, nparts, canon_int64)
+
+    def step(batch: DeviceBatch) -> DeviceBatch:
+        laid = partition_layout(batch, pid_fn(batch), nparts, cap)
+        return exchange_collective(laid, axis, nparts, cap)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,),
+                                 out_specs=spec))
+
+
+def shard_batch(mesh: jax.sharding.Mesh, batch: DeviceBatch) -> DeviceBatch:
+    """Place a global batch row-sharded across the mesh (capacity must be
+    divisible by the mesh size)."""
+    axis = mesh.axis_names[0]
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis))
+    return jax.device_put(batch, sharding)
+
+
+def slice_batch(batch: DeviceBatch, lo: int, cap: int) -> DeviceBatch:
+    """Row-slice [lo, lo+cap) of every leaf (static bounds)."""
+    def cut(x):
+        return x[lo:lo + cap]
+
+    cols = tuple(
+        DeviceColumn(c.dtype, cut(c.data),
+                     None if c.validity is None else cut(c.validity),
+                     None if c.lengths is None else cut(c.lengths))
+        for c in batch.columns)
+    return DeviceBatch(batch.schema, cols, cut(batch.sel))

@@ -116,11 +116,15 @@ def tag_expression(e: Expression, meta: ExecMeta):
 # ---------------------------------------------------------------------------
 
 class ExecRule:
-    """One entry of the GpuOverrides execs map."""
+    """One entry of the GpuOverrides execs map.
+
+    ``convert(cpu, tpu_children, conf)`` — conf lets conversions pick
+    distributed variants (e.g. ICI shuffle mode splits aggregates)."""
 
     def __init__(self, name: str,
                  tag: Callable[[ExecMeta], None],
-                 convert: Callable[[CpuExec, List[TpuExec]], TpuExec],
+                 convert: Callable[[CpuExec, List[TpuExec], "RapidsConf"],
+                                   TpuExec],
                  desc: str = ""):
         self.name = name
         self._tag = tag
@@ -146,7 +150,7 @@ def _tag_scan(meta: ExecMeta):
     pass
 
 
-def _convert_scan(cpu: B.CpuScanExec, children):
+def _convert_scan(cpu: B.CpuScanExec, children, conf):
     return B.TpuScanExec(cpu.table, cpu.schema, cpu.num_partitions(),
                          cpu.batch_rows)
 
@@ -158,31 +162,31 @@ EXEC_RULES[B.CpuScanExec] = ExecRule(
 EXEC_RULES[B.CpuProjectExec] = ExecRule(
     "Project",
     lambda m: m.tag_expressions(m.cpu.exprs),
-    lambda cpu, ch: B.TpuProjectExec(cpu.exprs, cpu.schema, ch[0]),
+    lambda cpu, ch, conf: B.TpuProjectExec(cpu.exprs, cpu.schema, ch[0]),
     "columnar projection")
 
 EXEC_RULES[B.CpuFilterExec] = ExecRule(
     "Filter",
     lambda m: m.tag_expressions([m.cpu.condition]),
-    lambda cpu, ch: B.TpuFilterExec(cpu.condition, ch[0]),
+    lambda cpu, ch, conf: B.TpuFilterExec(cpu.condition, ch[0]),
     "columnar filter (predicate folds into the selection mask)")
 
 EXEC_RULES[B.CpuLocalLimitExec] = ExecRule(
     "LocalLimit",
     lambda m: None,
-    lambda cpu, ch: B.TpuLocalLimitExec(cpu.n, ch[0]),
+    lambda cpu, ch, conf: B.TpuLocalLimitExec(cpu.n, ch[0]),
     "limit over live rows")
 
 EXEC_RULES[B.CpuGlobalLimitExec] = ExecRule(
     "GlobalLimit",
     lambda m: None,
-    lambda cpu, ch: B.TpuGlobalLimitExec(cpu.n, ch[0]),
+    lambda cpu, ch, conf: B.TpuGlobalLimitExec(cpu.n, ch[0]),
     "global limit cut across partitions")
 
 EXEC_RULES[B.CpuUnionExec] = ExecRule(
     "Union",
     lambda m: None,
-    lambda cpu, ch: B.TpuUnionExec(ch),
+    lambda cpu, ch, conf: B.TpuUnionExec(ch),
     "union of children partitions")
 
 
@@ -207,8 +211,23 @@ def _tag_aggregate(meta: ExecMeta):
                 "supported on device (string agg buffers)")
 
 
-def _convert_aggregate(cpu, ch):
+def _convert_aggregate(cpu, ch, conf):
     from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
+    from spark_rapids_tpu.exec.distributed import ici_active
+    if ici_active(conf) and cpu.grouping:
+        # distributed: {partial agg → hash exchange on keys → final agg}
+        # — one SPMD all_to_all per shuffle stage (SURVEY §5.8)
+        from spark_rapids_tpu.exec.distributed import (
+            TpuIciShuffleExchangeExec)
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        partial = TpuHashAggregateExec(cpu.grouping, cpu.fns, None, ch[0],
+                                       mode="partial")
+        partial.schema = partial._buffer_schema()
+        keys = [BoundReference(i, g.dtype)
+                for i, g in enumerate(cpu.grouping)]
+        exchange = TpuIciShuffleExchangeExec(partial, keys)
+        return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema,
+                                    exchange, mode="final")
     return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema, ch[0])
 
 
@@ -282,9 +301,16 @@ def wrap(cpu: CpuExec, conf: RapidsConf, all_metas: List[ExecMeta]) -> ExecMeta:
 
 
 def _rebuild_cpu(cpu: CpuExec, new_children: List[ExecNode]) -> CpuExec:
-    """Re-point a CPU exec at (possibly transition-wrapped) children."""
-    cpu._children = tuple(new_children)
-    return cpu
+    """Copy a CPU exec onto (possibly transition-wrapped) children.
+
+    A shallow copy, NOT in-place mutation: the original plan nodes stay
+    pristine so re-planning/re-executing a DataFrame never sees a
+    half-rewritten tree."""
+    import copy
+    clone = copy.copy(cpu)
+    clone._children = tuple(new_children)
+    clone.metrics = {k: type(m)(m.name) for k, m in cpu.metrics.items()}
+    return clone
 
 
 def convert_meta(meta: ExecMeta) -> ExecNode:
@@ -295,7 +321,7 @@ def convert_meta(meta: ExecMeta) -> ExecNode:
             c if isinstance(c, TpuExec) else HostToDeviceExec(c)
             for c in converted
         ]
-        return meta.rule.convert(meta.cpu, tpu_children)
+        return meta.rule.convert(meta.cpu, tpu_children, meta.conf)
     cpu_children = [
         c if isinstance(c, CpuExec) else DeviceToHostExec(c)
         for c in converted

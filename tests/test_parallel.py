@@ -62,6 +62,145 @@ def test_distributed_filter_groupby(ndev):
         assert got[k] == pytest.approx(expect[k], rel=1e-9)
 
 
+# ---------------------------------------------------------------------------
+# distributed execution through the public DataFrame API (ICI shuffle mode)
+# ---------------------------------------------------------------------------
+
+import pyarrow as pa
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect)
+
+ICI_CONF = {"spark.rapids.shuffle.mode": "ICI"}
+
+
+def _dist_tables(seed=0, n=2000):
+    rng = np.random.default_rng(seed)
+    t = pa.table({
+        "g": pa.array([f"grp{i % 13:02d}" if i % 17 else None
+                       for i in range(n)]),
+        "k": pa.array(rng.integers(0, 7, n).astype(np.int32)),
+        "v": pa.array(rng.uniform(-100, 100, n)),
+        "l": pa.array(rng.integers(-50, 50, n)),
+    })
+    r = pa.table({
+        "k": pa.array(rng.integers(0, 9, n // 5).astype(np.int32)),
+        "w": pa.array(rng.integers(0, 1000, n // 5)),
+    })
+    return t, r
+
+
+def _assert_ici_in_plan(df_builder, conf):
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    from spark_rapids_tpu.utils.harness import tpu_session
+    s = tpu_session(dict(conf))
+    rc = s.rapids_conf()
+    tree = apply_overrides(
+        plan_physical(df_builder(s)._plan, rc), rc).plan.tree_string()
+    assert "TpuIciShuffleExchange" in tree, tree
+
+
+def test_distributed_groupby_string_numeric_keys():
+    t, _ = _dist_tables(1)
+
+    def build(s):
+        return (s.createDataFrame(t).filter(F.col("v") > -50)
+                .groupBy("g", "k")
+                .agg(F.sum("l").alias("sl"), F.count("*").alias("c"),
+                     F.min("v").alias("mn"), F.max("v").alias("mx")))
+
+    _assert_ici_in_plan(build, ICI_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True)
+
+
+def test_distributed_groupby_double_sum_approx():
+    # float sums reorder under distribution — compare approximately,
+    # exactly like the reference's variableFloatAgg incompat mode
+    t, _ = _dist_tables(2)
+
+    def build(s):
+        return (s.createDataFrame(t).groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.avg("v").alias("av")))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True, approx_float=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full", "left_anti"])
+def test_distributed_join(how):
+    t, r = _dist_tables(3)
+
+    def build(s):
+        return s.createDataFrame(t).join(s.createDataFrame(r), "k", how)
+
+    _assert_ici_in_plan(build, ICI_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "full"])
+def test_distributed_join_double_key_zero_nan(how):
+    # -0.0/0.0 and NaN/NaN must land on the SAME device (normalized
+    # before hash partitioning) or co-partitioned joins drop matches
+    special = [float("nan"), -0.0, 0.0, None, 1.5]
+    rng = np.random.default_rng(6)
+    lv = list(rng.integers(-3, 3, 40).astype(float)) + special
+    rv = list(rng.integers(-3, 3, 30).astype(float)) + special
+    l = pa.table({"d": pa.array(lv, type=pa.float64()),
+                  "x": pa.array(list(range(len(lv))))})
+    r = pa.table({"d": pa.array(rv, type=pa.float64()),
+                  "y": pa.array(list(range(len(rv))))})
+
+    def build(s):
+        return s.createDataFrame(l).join(s.createDataFrame(r), "d", how)
+
+    _assert_ici_in_plan(build, ICI_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True)
+
+
+def test_distributed_groupby_double_key_zero_nan():
+    vals = [float("nan"), -0.0, 0.0, None, 2.5] * 20
+    t = pa.table({"d": pa.array(vals, type=pa.float64()),
+                  "x": pa.array(list(range(len(vals))))})
+
+    def build(s):
+        return (s.createDataFrame(t).groupBy("d")
+                .agg(F.count("*").alias("c"), F.sum("x").alias("sx")))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True)
+
+
+def test_distributed_join_then_aggregate():
+    t, r = _dist_tables(4)
+
+    def build(s):
+        return (s.createDataFrame(t).join(s.createDataFrame(r), "k")
+                .groupBy("g").agg(F.sum("w").alias("sw"),
+                                  F.count("*").alias("c")))
+
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True)
+
+
+def test_distributed_repartition():
+    t, _ = _dist_tables(5)
+
+    def build(s):
+        import jax
+        return (s.createDataFrame(t)
+                .repartition(jax.device_count(), "k")
+                .groupBy("k").count())
+
+    _assert_ici_in_plan(build, ICI_CONF)
+    assert_tpu_and_cpu_are_equal_collect(
+        build, conf=ICI_CONF, ignore_order=True)
+
+
 def test_graft_entry_contract():
     import importlib.util
     spec = importlib.util.spec_from_file_location(
