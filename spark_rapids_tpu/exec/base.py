@@ -115,3 +115,48 @@ class TpuExec(ExecNode):
 
     [REF: GpuExec.scala :: GpuExec]
     """
+
+    def fusion(self):
+        """(pure batch→batch fn, cache-key) when this operator is a pure
+        per-batch map that may fuse into a downstream consumer's kernel
+        (filter/project), else None.
+
+        THE XLA counterpart of the reference's tiered projection /
+        kernel-launch amortization: a consumer (aggregate, sort, join,
+        transfer) composes upstream map fns into its own jitted kernel,
+        so a {scan → filter → project → agg} pipeline reads HBM once
+        per batch instead of once per operator.
+        """
+        return None
+
+
+def fuse_upstream(node: "TpuExec"):
+    """Walk down through fusible map operators.
+
+    Returns (source_exec, composed_fn, cache_key): pull batches from
+    ``source_exec`` and apply ``composed_fn`` INSIDE the consumer's
+    jitted kernel (cache_key must join the consumer's kernel key).
+    Fused operators get a ``fusedIntoConsumer`` metric so explain output
+    shows why their own row/time metrics stay zero."""
+    fns = []
+    keys = []
+    while isinstance(node, TpuExec):
+        f = node.fusion()
+        if f is None:
+            break
+        fn, key = f
+        fns.append(fn)
+        keys.append(key)
+        node.metric("fusedIntoConsumer").value = 1
+        node = node.children[0]
+    fns.reverse()
+
+    if not fns:
+        return node, (lambda b: b), ()
+
+    def composed(batch):
+        for f in fns:
+            batch = f(batch)
+        return batch
+
+    return node, composed, tuple(reversed(keys))

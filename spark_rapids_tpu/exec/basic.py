@@ -130,8 +130,11 @@ class TpuScanExec(TpuExec):
                 break
             with self.timer():
                 b = host_to_device(chunk, min_bucket=self.min_bucket)
-                b = DeviceBatch(self.schema, b.columns, b.sel)
-            nrows = int(np.sum(np.asarray(b.sel)))
+                b = DeviceBatch(self.schema, b.columns, b.sel,
+                                compacted=True)
+            # row count is known host-side — NEVER sync the device here
+            # (any D2H permanently degrades tunnel dispatch latency)
+            nrows = chunk.num_rows
             self.metric("numOutputRows").add(nrows)
             self.metric("numOutputBatches").add(1)
             out.append((b, nrows))
@@ -169,15 +172,21 @@ class TpuProjectExec(TpuExec):
     def node_string(self):
         return f"TpuProject [{', '.join(str(e) for e in self.exprs)}]"
 
-    def execute(self, partition: int) -> Iterator[DeviceBatch]:
-        from spark_rapids_tpu.runtime.kernel_cache import (
-            cached_kernel, fingerprint)
+    def fusion(self):
+        from spark_rapids_tpu.runtime.kernel_cache import fingerprint
         exprs, schema = self.exprs, self.schema
-        fn = cached_kernel(
-            ("project", fingerprint(exprs), fingerprint(schema)),
-            lambda: (lambda batch: DeviceBatch(
+
+        def run(batch):
+            return DeviceBatch(
                 schema, tuple(e.eval_tpu(batch) for e in exprs),
-                batch.sel)))
+                batch.sel)
+
+        return run, ("project", fingerprint(exprs), fingerprint(schema))
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+        run, key = self.fusion()
+        fn = cached_kernel(key, lambda: run)
         for b in self.children[0].execute(partition):
             with self.timer():
                 out = fn(b)
@@ -224,21 +233,23 @@ class TpuFilterExec(TpuExec):
     def node_string(self):
         return f"TpuFilter [{self.condition}]"
 
-    def execute(self, partition: int) -> Iterator[DeviceBatch]:
-        from spark_rapids_tpu.runtime.kernel_cache import (
-            cached_kernel, fingerprint)
+    def fusion(self):
+        from spark_rapids_tpu.runtime.kernel_cache import fingerprint
         cond = self.condition
 
-        def build():
-            def run(batch):
-                c = cond.eval_tpu(batch)
-                keep = c.data.astype(jnp.bool_)
-                if c.validity is not None:
-                    keep = keep & c.validity
-                return batch.with_sel(batch.sel & keep)
-            return run
+        def run(batch):
+            c = cond.eval_tpu(batch)
+            keep = c.data.astype(jnp.bool_)
+            if c.validity is not None:
+                keep = keep & c.validity
+            return batch.with_sel(batch.sel & keep)
 
-        fn = cached_kernel(("filter", fingerprint(cond)), build)
+        return run, ("filter", fingerprint(cond))
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+        run, key = self.fusion()
+        fn = cached_kernel(key, lambda: run)
         for b in self.children[0].execute(partition):
             with self.timer():
                 out = fn(b)

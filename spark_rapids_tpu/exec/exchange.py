@@ -154,7 +154,10 @@ class TpuShuffleExchangeExec(TpuExec):
             for p in range(child.num_partitions()):
                 for b in child.execute(p):
                     pairs.append((b, self._pids(b, row_base)))
-                    row_base += int(jnp.sum(b.sel.astype(jnp.int32)))
+                    if not self.keys:
+                        # only round-robin needs the running row count
+                        # (a device sync); hash partitioning does not
+                        row_base += int(jnp.sum(b.sel.astype(jnp.int32)))
         self._materialized = pairs
         return pairs
 

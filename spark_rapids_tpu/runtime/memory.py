@@ -1,0 +1,338 @@
+"""HBM budget arbiter + spill store + OOM-retry framework.
+
+[REF: sql-plugin/../GpuDeviceManager.scala, spill/SpillFramework.scala,
+ RmmRapidsRetryIterator.scala :: withRetry / withRetryNoSplit /
+ splitSpillableInHalfByRows; spark-rapids-jni :: RmmSpark (per-thread OOM
+ state machine, forceRetryOOM injection)]
+
+TPU re-design: there is no RMM — XLA/PJRT owns HBM — so the arbiter is an
+*accounting* layer ABOVE the runtime (SURVEY §2.2 N10/N12): operators
+``reserve()`` bytes before materializing batches; registered
+``SpillableBatch``es are the reclaim pool.  When a reservation would
+exceed the budget the arbiter synchronously spills victims
+device→host→disk (host tier capped by
+``spark.rapids.memory.host.spillStorageSize``, disk tier under
+``spark.rapids.tpu.spillPath``), and if still short raises ``RetryOOM``
+for ``with_retry`` to catch: restore-from-checkpoint, halve the input by
+rows (``SplitAndRetryOOM``), re-run the closure per half.
+
+The ``injectOomAtAlloc`` conf forces an OOM at the Nth reservation — the
+test hook that makes the retry/spill path deterministically coverable
+(the RmmSpark.forceRetryOOM analog, SURVEY §4.2).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
+
+
+class RetryOOM(RuntimeError):
+    """Device memory exhausted; caller should free/spill and re-run."""
+
+
+class SplitAndRetryOOM(RetryOOM):
+    """Re-running whole won't fit; caller must halve the input."""
+
+
+class SpillableBatch:
+    """A device batch registered with the arbiter as reclaimable.
+
+    States: device (batch live, bytes counted) → host (numpy copies) →
+    disk (one .npz under spillPath).  ``get()`` restores to device,
+    re-reserving its bytes.  [REF: SpillableColumnarBatch]
+    """
+
+    def __init__(self, batch: DeviceBatch, manager: "DeviceMemoryManager"):
+        self._mgr = manager
+        self._batch: Optional[DeviceBatch] = batch
+        self._host: Optional[list] = None
+        self._disk_path: Optional[str] = None
+        self.schema = batch.schema
+        self.compacted = batch.compacted
+        self.nbytes = batch.nbytes()
+        manager._register(self)
+
+    @property
+    def tier(self) -> str:
+        if self._batch is not None:
+            return "device"
+        if self._host is not None:
+            return "host"
+        return "disk"
+
+    def spill_to_host(self) -> int:
+        """Device → host.  Returns bytes freed on device."""
+        if self._batch is None:
+            return 0
+        import jax
+        b = self._batch
+        leaves, treedef = jax.tree.flatten(b)
+        # one overlapped transfer round trip (see columnar.device_to_host)
+        for x in leaves:
+            try:
+                x.copy_to_host_async()
+            except AttributeError:
+                pass
+        self._host = ([np.asarray(x) for x in leaves], treedef)
+        self._batch = None
+        self._mgr._on_spill(self, self.nbytes)
+        return self.nbytes
+
+    def spill_to_disk(self) -> int:
+        """Host → disk.  Returns host bytes freed."""
+        if self._host is None:
+            return 0
+        leaves, treedef = self._host
+        os.makedirs(self._mgr.spill_path, exist_ok=True)
+        path = os.path.join(self._mgr.spill_path,
+                            f"spill-{uuid.uuid4().hex}.npz")
+        np.savez(path, *leaves)
+        self._disk_path = path
+        self._treedef = treedef
+        freed = sum(x.nbytes for x in leaves)
+        self._host = None
+        self._mgr._on_disk_spill(self, freed)
+        return freed
+
+    def get(self) -> DeviceBatch:
+        """Restore (if needed) and return the device batch."""
+        if self._batch is not None:
+            return self._batch
+        import jax
+        from_host = self._host is not None
+        if not from_host and self._disk_path is not None:
+            # disk staging never touches _host_used accounting
+            with np.load(self._disk_path) as z:
+                leaves = [z[k] for k in z.files]
+            self._host = (leaves, self._treedef)
+            os.unlink(self._disk_path)
+            self._disk_path = None
+        leaves, treedef = self._host
+        self._mgr.reserve(self.nbytes, _restoring=self)
+        self._batch = jax.tree.unflatten(
+            treedef, [jax.numpy.asarray(x) for x in leaves])
+        self._host = None
+        if from_host:
+            self._mgr._on_restore(self)
+        return self._batch
+
+    def close(self):
+        self._mgr._unregister(self)
+        if self._disk_path is not None and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        self._batch = None
+        self._host = None
+
+
+class DeviceMemoryManager:
+    """The budget arbiter [REF: GpuDeviceManager + SpillFramework].
+
+    Budget = ``poolSize`` if set, else ``allocFraction`` × detected HBM
+    (PJRT ``memory_stats().bytes_limit``; 4 GiB fallback when the
+    platform doesn't report, e.g. the virtual CPU mesh).
+    """
+
+    def __init__(self, budget: Optional[int] = None,
+                 alloc_fraction: float = 0.85,
+                 host_limit: int = 4 << 30,
+                 spill_path: str = "/tmp/tpuq-spill",
+                 inject_oom_at: int = -1):
+        self._lock = threading.RLock()
+        self._spillables: Dict[int, SpillableBatch] = {}
+        self._reserved = 0
+        self._host_used = 0
+        self.host_limit = host_limit
+        self.spill_path = spill_path
+        self._alloc_count = 0
+        self._inject_at = inject_oom_at
+        self.metrics = {"spillToHostBytes": 0, "spillToDiskBytes": 0,
+                        "retryOOMs": 0, "splitRetries": 0}
+        self.budget = budget if budget else self._detect_budget(
+            alloc_fraction)
+
+    @staticmethod
+    def _detect_budget(fraction: float) -> int:
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and stats.get("bytes_limit"):
+                return int(stats["bytes_limit"] * fraction)
+        except Exception:
+            pass
+        return int((4 << 30) * fraction)
+
+    # -- accounting ---------------------------------------------------------
+    def reserve(self, nbytes: int, _restoring=None) -> None:
+        """Claim bytes for an upcoming materialization.  Synchronously
+        spills victims if needed; raises RetryOOM when the budget cannot
+        be met (or when fault injection fires)."""
+        with self._lock:
+            self._alloc_count += 1
+            if self._inject_at >= 0 and self._alloc_count == self._inject_at:
+                self.metrics["retryOOMs"] += 1
+                raise RetryOOM(
+                    f"injected OOM at allocation {self._alloc_count}")
+            if nbytes > self.budget:
+                self.metrics["retryOOMs"] += 1
+                raise SplitAndRetryOOM(
+                    f"allocation of {nbytes} B exceeds the whole budget "
+                    f"({self.budget} B) — split required")
+            while self._reserved + nbytes > self.budget:
+                if not self._spill_one(exclude=_restoring):
+                    self.metrics["retryOOMs"] += 1
+                    raise RetryOOM(
+                        f"cannot reserve {nbytes} B: {self._reserved} of "
+                        f"{self.budget} B reserved, nothing left to spill")
+            self._reserved += nbytes
+
+    def release(self, nbytes: int) -> None:
+        with self._lock:
+            self._reserved = max(0, self._reserved - nbytes)
+
+    def _spill_one(self, exclude=None) -> bool:
+        # oldest-registered first (approximate LRU)
+        for s in list(self._spillables.values()):
+            if s is exclude or s.tier != "device":
+                continue
+            s.spill_to_host()
+            return True
+        return False
+
+    # -- spillable registry callbacks --------------------------------------
+    def _register(self, s: SpillableBatch) -> None:
+        with self._lock:
+            self._spillables[id(s)] = s
+
+    def _unregister(self, s: SpillableBatch) -> None:
+        with self._lock:
+            self._spillables.pop(id(s), None)
+            if s.tier == "device":
+                self.release(s.nbytes)
+
+    def _on_spill(self, s: SpillableBatch, nbytes: int) -> None:
+        with self._lock:
+            self.release(nbytes)
+            self._host_used += nbytes
+            self.metrics["spillToHostBytes"] += nbytes
+            while self._host_used > self.host_limit:
+                victim = next(
+                    (v for v in self._spillables.values()
+                     if v.tier == "host" and v is not s), None)
+                if victim is None:
+                    break
+                self._host_used -= victim.spill_to_disk()
+
+    def _on_disk_spill(self, s: SpillableBatch, nbytes: int) -> None:
+        self.metrics["spillToDiskBytes"] += nbytes
+
+    def _on_restore(self, s: SpillableBatch) -> None:
+        with self._lock:
+            self._host_used = max(0, self._host_used - s.nbytes)
+
+
+# ---------------------------------------------------------------------------
+# process-wide manager, configured per session conf
+# ---------------------------------------------------------------------------
+
+_manager: Optional[DeviceMemoryManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_manager(conf=None) -> DeviceMemoryManager:
+    """The process arbiter.  First caller's conf wins; a session with
+    explicit memory confs replaces an unconfigured default."""
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = _build(conf)
+        elif conf is not None:
+            cfg = _build(conf)
+            if (cfg.budget, cfg.host_limit, cfg._inject_at) != (
+                    _manager.budget, _manager.host_limit,
+                    _manager._inject_at):
+                _manager = cfg
+        return _manager
+
+
+def reset_manager() -> None:
+    global _manager
+    with _manager_lock:
+        _manager = None
+
+
+def _build(conf) -> DeviceMemoryManager:
+    if conf is None:
+        return DeviceMemoryManager()
+    from spark_rapids_tpu import conf as C
+    return DeviceMemoryManager(
+        budget=conf.get(C.POOL_SIZE) or None,
+        alloc_fraction=conf.get(C.MEMORY_FRACTION),
+        host_limit=conf.get(C.HOST_SPILL_STORAGE),
+        spill_path=conf.get(C.SPILL_PATH),
+        inject_oom_at=conf.get(C.FAULT_INJECT),
+    )
+
+
+# ---------------------------------------------------------------------------
+# the retry framework [REF: RmmRapidsRetryIterator.scala :: withRetry]
+# ---------------------------------------------------------------------------
+
+def split_batch_in_half(batch: DeviceBatch) -> List[DeviceBatch]:
+    """Halve a batch by row range (the splitSpillableInHalfByRows
+    analog).  Static slicing — each half keeps a pow-2 capacity."""
+    from spark_rapids_tpu.parallel.shuffle import slice_batch
+    cap = batch.capacity
+    if cap <= 1:
+        raise SplitAndRetryOOM("cannot split a 1-row batch")
+    half = cap // 2
+    return [slice_batch(batch, 0, half), slice_batch(batch, half, half)]
+
+
+def with_retry(
+    inputs: Sequence[DeviceBatch],
+    closure: Callable[[DeviceBatch], object],
+    max_attempts: int = 8,
+    manager: Optional[DeviceMemoryManager] = None,
+    allow_split: bool = True,
+):
+    """Run ``closure`` over each input batch with OOM rollback.
+
+    On ``RetryOOM``: spill registered spillables and re-run the same
+    batch.  On ``SplitAndRetryOOM`` (or repeated RetryOOM): split the
+    batch in half by rows and process the halves independently — the
+    caller's closure must be merge-friendly (partial aggregates, sorted
+    runs, ...).  Yields one result per processed (sub-)batch.
+    """
+    mgr = manager or get_manager()
+    work: List[Tuple[DeviceBatch, int]] = [(b, 0) for b in inputs]
+    while work:
+        batch, attempts = work.pop(0)
+        try:
+            yield closure(batch)
+        except SplitAndRetryOOM:
+            if not allow_split:
+                raise
+            mgr.metrics["splitRetries"] += 1
+            halves = split_batch_in_half(batch)
+            work = [(h, attempts + 1) for h in halves] + work
+        except RetryOOM:
+            if attempts + 1 >= max_attempts:
+                raise
+            # free device pressure, then retry the same batch
+            for s in list(mgr._spillables.values()):
+                if s.tier == "device":
+                    s.spill_to_host()
+            if attempts >= 1 and allow_split and batch.capacity > 1:
+                mgr.metrics["splitRetries"] += 1
+                halves = split_batch_in_half(batch)
+                work = [(h, attempts + 1) for h in halves] + work
+            else:
+                work.insert(0, (batch, attempts + 1))
