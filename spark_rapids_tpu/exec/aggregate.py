@@ -412,6 +412,9 @@ class TpuHashAggregateExec(TpuExec):
         filter/project fused into the kernel.  Streamed: one input batch
         held at a time; the single-batch case fuses final projection
         into the same kernel (one dispatch total)."""
+        from spark_rapids_tpu.runtime.memory import (
+            RetryOOM, get_manager, with_retry)
+        mgr = get_manager()
         stream = (b for p in range(src.num_partitions())
                   for b in src.execute(p))
         first = next(stream, None)
@@ -419,19 +422,48 @@ class TpuHashAggregateExec(TpuExec):
             return self._reduce_merge_final([])
         second = next(stream, None)
         if second is None:
-            return self._reduce_batch(first, pre, pre_key, final=True)
-        partials = [self._reduce_batch(first, pre, pre_key),
-                    self._reduce_batch(second, pre, pre_key)]
-        del first, second
-        for b in stream:
-            partials.append(self._reduce_batch(b, pre, pre_key))
+            try:
+                with mgr.transient(first.nbytes()):
+                    return self._reduce_batch(first, pre, pre_key,
+                                              final=True)
+            except RetryOOM:
+                pass  # fall through to the splittable two-phase path
+
+        def closure(b):
+            with mgr.transient(b.nbytes()):
+                return self._reduce_batch(b, pre, pre_key)
+
+        def inputs():
+            yield first
+            if second is not None:
+                yield second
+            yield from stream
+
+        partials = list(with_retry(
+            inputs(), closure, max_attempts=mgr.retry_max_attempts,
+            manager=mgr))
         return self._reduce_merge_final(partials)
 
     def _execute_grouped(self, src, pre, pre_key) -> DeviceBatch:
+        """Update-per-batch under the OOM-retry framework: a RetryOOM
+        spills the arbiter's pool and re-runs the batch; repeated
+        pressure halves it by rows (partials merge regardless — the
+        repartition-fallback-friendly shape [REF: withRetry +
+        GpuAggregateIterator])."""
+        from spark_rapids_tpu.runtime.memory import get_manager, with_retry
+        mgr = get_manager()
+
+        def closure(b):
+            with mgr.transient(b.nbytes()):
+                return self._partial(b, pre, pre_key)
+
         partials: List[DeviceBatch] = []
         for p in range(src.num_partitions()):
-            for b in src.execute(p):
-                partials.append(self._partial(b, pre, pre_key))
+            # lazy: one upstream batch live at a time, so retry spills
+            # actually free HBM instead of fighting a pinned input list
+            partials.extend(with_retry(
+                src.execute(p), closure,
+                max_attempts=mgr.retry_max_attempts, manager=mgr))
         if not partials:
             from spark_rapids_tpu.columnar.column import empty_batch
             partials.append(self._partial(
@@ -449,9 +481,18 @@ class TpuHashAggregateExec(TpuExec):
         child = self.children[0]
         with self.timer():
             if self.mode == "partial":
+                from spark_rapids_tpu.runtime.memory import (
+                    get_manager, with_retry)
+                mgr = get_manager()
                 src, pre, pre_key = fuse_upstream(child)
-                partials = [self._partial(b, pre, pre_key)
-                            for b in src.execute(partition)]
+
+                def closure(b):
+                    with mgr.transient(b.nbytes()):
+                        return self._partial(b, pre, pre_key)
+
+                partials = list(with_retry(
+                    src.execute(partition), closure,
+                    max_attempts=mgr.retry_max_attempts, manager=mgr))
                 if not partials:
                     yield empty_batch(self._buffer_schema())
                     return

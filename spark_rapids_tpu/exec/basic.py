@@ -82,11 +82,26 @@ def _scan_cache_get(table: pa.Table, key):
     return None if ent is None else ent.get(key)
 
 
+def _scan_cache_evict(tid):
+    entries = _scan_cache.pop(tid, None)
+    if entries:
+        for pairs in entries.values():
+            for sp, _ in pairs:
+                sp.close()  # release arbiter accounting + spill files
+
+
+def clear_scan_cache():
+    """Evict every cached scan (e.g. when the budget arbiter is
+    replaced — registrations against the old arbiter would go stale)."""
+    for tid in list(_scan_cache):
+        _scan_cache_evict(tid)
+
+
 def _scan_cache_put(table: pa.Table, key, batches):
     tid = id(table)
     if tid not in _scan_cache:
         try:
-            weakref.finalize(table, _scan_cache.pop, tid, None)
+            weakref.finalize(table, _scan_cache_evict, tid)
         except TypeError:
             return
         _scan_cache[tid] = {}
@@ -113,15 +128,31 @@ class TpuScanExec(TpuExec):
         return self._num_partitions
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.memory import (
+            RetryOOM, SpillableBatch, get_manager)
         key = (self._num_partitions, self.batch_rows, self.min_bucket,
                partition)
         cached = _scan_cache_get(self.table, key)
         if cached is not None:
-            for b, nrows in cached:
+            for sp, nrows in cached:
                 self.metric("numOutputRows").add(nrows)
                 self.metric("numOutputBatches").add(1)
-                yield b
+                try:
+                    # restores the batch if the arbiter spilled it
+                    yield sp.get()
+                except RetryOOM:
+                    # no room to restore: drop the cache and stream the
+                    # partition straight from the arrow table instead
+                    _scan_cache_evict(id(self.table))
+                    yield from self._stream(partition, register=False)
+                    return
             return
+        yield from self._stream(partition, key, register=True)
+
+    def _stream(self, partition: int, key=None, register: bool = False
+                ) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.memory import (
+            RetryOOM, SpillableBatch, get_manager)
         out = []
         part = _slice_table(self.table, self._num_partitions)[partition]
         for lo in range(0, max(part.num_rows, 1), self.batch_rows):
@@ -137,9 +168,21 @@ class TpuScanExec(TpuExec):
             nrows = chunk.num_rows
             self.metric("numOutputRows").add(nrows)
             self.metric("numOutputBatches").add(1)
-            out.append((b, nrows))
+            if register and out is not None:
+                # device-resident cache entries are the arbiter's
+                # reclaim pool: under pressure they spill host-side and
+                # restore transparently on the next scan.  Registration
+                # is best-effort — a full budget (or injected OOM) just
+                # means this scan isn't cached, never a query failure.
+                try:
+                    out.append((SpillableBatch(b, get_manager()), nrows))
+                except RetryOOM:
+                    for sp, _ in out:
+                        sp.close()
+                    out = None
             yield b
-        _scan_cache_put(self.table, key, out)
+        if register and out is not None:
+            _scan_cache_put(self.table, key, out)
 
 
 class CpuProjectExec(CpuExec):

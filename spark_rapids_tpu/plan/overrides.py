@@ -333,6 +333,10 @@ def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     """GpuOverrides.apply + GpuTransitionOverrides in one pass."""
     if not conf.sql_enabled:
         return OverrideResult(cpu_plan, [])
+    # configure the HBM budget arbiter from this query's conf (memory
+    # keys + OOM fault injection) before any device materialization
+    from spark_rapids_tpu.runtime.memory import get_manager
+    get_manager(conf)
     _register_lazy_rules()
     metas: List[ExecMeta] = []
     root = wrap(cpu_plan, conf, metas)

@@ -23,10 +23,11 @@ test hook that makes the retry/spill path deterministically coverable
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import uuid
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -50,7 +51,8 @@ class SpillableBatch:
     re-reserving its bytes.  [REF: SpillableColumnarBatch]
     """
 
-    def __init__(self, batch: DeviceBatch, manager: "DeviceMemoryManager"):
+    def __init__(self, batch: DeviceBatch, manager: "DeviceMemoryManager",
+                 reserve: bool = True):
         self._mgr = manager
         self._batch: Optional[DeviceBatch] = batch
         self._host: Optional[list] = None
@@ -58,6 +60,8 @@ class SpillableBatch:
         self.schema = batch.schema
         self.compacted = batch.compacted
         self.nbytes = batch.nbytes()
+        if reserve:
+            manager.reserve(self.nbytes)
         manager._register(self)
 
     @property
@@ -144,7 +148,9 @@ class DeviceMemoryManager:
                  alloc_fraction: float = 0.85,
                  host_limit: int = 4 << 30,
                  spill_path: str = "/tmp/tpuq-spill",
-                 inject_oom_at: int = -1):
+                 inject_oom_at: int = -1,
+                 retry_max_attempts: int = 8):
+        self.retry_max_attempts = retry_max_attempts
         self._lock = threading.RLock()
         self._spillables: Dict[int, SpillableBatch] = {}
         self._reserved = 0
@@ -196,6 +202,16 @@ class DeviceMemoryManager:
     def release(self, nbytes: int) -> None:
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
+
+    @contextlib.contextmanager
+    def transient(self, nbytes: int):
+        """Reserve for the duration of a device op (operator working-set
+        accounting; released on exit)."""
+        self.reserve(nbytes)
+        try:
+            yield
+        finally:
+            self.release(nbytes)
 
     def _spill_one(self, exclude=None) -> bool:
         # oldest-registered first (approximate LRU)
@@ -255,9 +271,15 @@ def get_manager(conf=None) -> DeviceMemoryManager:
             _manager = _build(conf)
         elif conf is not None:
             cfg = _build(conf)
-            if (cfg.budget, cfg.host_limit, cfg._inject_at) != (
+            if (cfg.budget, cfg.host_limit, cfg._inject_at,
+                    cfg.retry_max_attempts) != (
                     _manager.budget, _manager.host_limit,
-                    _manager._inject_at):
+                    _manager._inject_at, _manager.retry_max_attempts):
+                # a new manager orphans batches registered with the old
+                # one — evict the device-resident scan cache so nothing
+                # keeps accounting against the dead arbiter
+                from spark_rapids_tpu.exec.basic import clear_scan_cache
+                clear_scan_cache()
                 _manager = cfg
         return _manager
 
@@ -278,6 +300,7 @@ def _build(conf) -> DeviceMemoryManager:
         host_limit=conf.get(C.HOST_SPILL_STORAGE),
         spill_path=conf.get(C.SPILL_PATH),
         inject_oom_at=conf.get(C.FAULT_INJECT),
+        retry_max_attempts=conf.get(C.RETRY_MAX),
     )
 
 
@@ -297,7 +320,7 @@ def split_batch_in_half(batch: DeviceBatch) -> List[DeviceBatch]:
 
 
 def with_retry(
-    inputs: Sequence[DeviceBatch],
+    inputs: Iterable[DeviceBatch],
     closure: Callable[[DeviceBatch], object],
     max_attempts: int = 8,
     manager: Optional[DeviceMemoryManager] = None,
@@ -310,11 +333,22 @@ def with_retry(
     batch in half by rows and process the halves independently — the
     caller's closure must be merge-friendly (partial aggregates, sorted
     runs, ...).  Yields one result per processed (sub-)batch.
+
+    ``inputs`` is consumed LAZILY — one upstream batch is live at a
+    time, so spilling actually frees HBM instead of fighting a pinned
+    input list.
     """
     mgr = manager or get_manager()
-    work: List[Tuple[DeviceBatch, int]] = [(b, 0) for b in inputs]
-    while work:
-        batch, attempts = work.pop(0)
+    it = iter(inputs)
+    work: List[Tuple[DeviceBatch, int]] = []  # pending (sub-)batches
+    while True:
+        if work:
+            batch, attempts = work.pop(0)
+        else:
+            batch = next(it, None)
+            if batch is None:
+                return
+            attempts = 0
         try:
             yield closure(batch)
         except SplitAndRetryOOM:

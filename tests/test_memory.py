@@ -1,0 +1,189 @@
+"""HBM budget arbiter / spill / OOM-retry tests.
+
+[REF: tests WithRetrySuite, SpillFrameworkSuite; RmmSpark.forceRetryOOM
+injection pattern — SURVEY §4.2: unit tests inject device OOM at exact
+allocation counts and assert results still match the oracle.]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.column import host_to_device
+from spark_rapids_tpu.runtime import memory as M
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect)
+
+
+@pytest.fixture(autouse=True)
+def fresh_manager():
+    M.reset_manager()
+    yield
+    M.reset_manager()
+
+
+def small_batch(seed=0, n=100):
+    rng = np.random.default_rng(seed)
+    return host_to_device(pa.table({
+        "a": pa.array(rng.integers(0, 50, n)),
+        "b": pa.array(rng.uniform(0, 1, n)),
+    }))
+
+
+# ---------------------------------------------------------------------------
+# spillable lifecycle
+# ---------------------------------------------------------------------------
+
+def test_spill_roundtrip_host(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30,
+                                spill_path=str(tmp_path))
+    b = small_batch()
+    ref = np.asarray(b.columns[0].data).copy()
+    sp = M.SpillableBatch(b, mgr)
+    assert sp.tier == "device" and mgr._reserved == sp.nbytes
+    sp.spill_to_host()
+    assert sp.tier == "host" and mgr._reserved == 0
+    restored = sp.get()
+    assert sp.tier == "device" and mgr._reserved == sp.nbytes
+    assert np.array_equal(np.asarray(restored.columns[0].data), ref)
+    sp.close()
+    assert mgr._reserved == 0
+
+
+def test_spill_roundtrip_disk(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30,
+                                spill_path=str(tmp_path))
+    b = small_batch(1)
+    ref = np.asarray(b.columns[1].data).copy()
+    sp = M.SpillableBatch(b, mgr)
+    sp.spill_to_host()
+    sp.spill_to_disk()
+    assert sp.tier == "disk"
+    assert mgr.metrics["spillToDiskBytes"] > 0
+    out = sp.get()
+    assert np.array_equal(np.asarray(out.columns[1].data), ref)
+    sp.close()
+
+
+def test_budget_pressure_spills_oldest(tmp_path):
+    b = small_batch()
+    size = b.nbytes()
+    mgr = M.DeviceMemoryManager(budget=int(size * 2.5),
+                                spill_path=str(tmp_path))
+    s1 = M.SpillableBatch(small_batch(1), mgr)
+    s2 = M.SpillableBatch(small_batch(2), mgr)
+    s3 = M.SpillableBatch(small_batch(3), mgr)  # forces s1 out
+    assert s1.tier == "host" and s2.tier == "device"
+    assert mgr.metrics["spillToHostBytes"] == size
+
+
+def test_oom_when_nothing_spillable(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1000, spill_path=str(tmp_path))
+    with pytest.raises(M.SplitAndRetryOOM):
+        mgr.reserve(2000)  # bigger than the whole budget
+    mgr.reserve(800)
+    with pytest.raises(M.RetryOOM):
+        mgr.reserve(800)  # nothing registered to spill
+
+
+def test_host_limit_pushes_to_disk(tmp_path):
+    b = small_batch()
+    size = b.nbytes()
+    mgr = M.DeviceMemoryManager(budget=size, host_limit=size,
+                                spill_path=str(tmp_path))
+    s1 = M.SpillableBatch(small_batch(1), mgr)
+    s2 = M.SpillableBatch(small_batch(2), mgr)  # s1 → host
+    s3 = M.SpillableBatch(small_batch(3), mgr)  # s2 → host, s1 → disk
+    assert s1.tier == "disk"
+    assert mgr.metrics["spillToDiskBytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# retry framework
+# ---------------------------------------------------------------------------
+
+def test_with_retry_retries_then_succeeds(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    b = small_batch()
+    fails = {"n": 2}
+
+    def closure(batch):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise M.RetryOOM("transient")
+        return batch.capacity
+
+    out = list(M.with_retry([b], closure, manager=mgr))
+    # second failure triggers a split: two halves processed
+    assert out == [b.capacity // 2, b.capacity // 2]
+
+
+def test_with_retry_split_on_split_oom(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    b = small_batch()
+    calls = {"n": 0}
+
+    def closure(batch):
+        calls["n"] += 1
+        if batch.capacity > b.capacity // 2:
+            raise M.SplitAndRetryOOM("too big")
+        return batch.capacity
+
+    out = list(M.with_retry([b], closure, manager=mgr))
+    assert out == [b.capacity // 2, b.capacity // 2]
+    assert mgr.metrics["splitRetries"] == 1
+
+
+def test_with_retry_exhausts(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+
+    def closure(batch):
+        raise M.RetryOOM("always")
+
+    with pytest.raises(M.RetryOOM):
+        list(M.with_retry([small_batch()], closure, max_attempts=3,
+                          manager=mgr, allow_split=False))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: injection + tiny budget through the DataFrame API
+# ---------------------------------------------------------------------------
+
+def _agg_query(s, t):
+    return (s.createDataFrame(t).groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.count("*").alias("c")))
+
+
+def _table(n=4000):
+    rng = np.random.default_rng(7)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 23, n).astype(np.int32)),
+        "v": pa.array(rng.integers(-100, 100, n)),
+    })
+
+
+def test_injected_oom_forces_retry_results_match():
+    t = _table()
+    conf = {
+        # allocation #2 = the first aggregate working-set reservation
+        # (allocation #1 is the scan batch registration)
+        "spark.rapids.tpu.test.injectOomAtAlloc": 2,
+    }
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _agg_query(s, _table()), conf=conf, ignore_order=True)
+    assert M.get_manager().metrics["retryOOMs"] >= 1
+
+
+def test_tiny_budget_forces_spill_results_match():
+    t = _table()
+    batch_bytes = host_to_device(t).nbytes()
+    conf = {
+        # room for ~1.5 scan batches: the aggregate's transient
+        # reservation must evict the scan cache entry to proceed
+        "spark.rapids.tpu.memory.poolSize": int(batch_bytes * 1.5),
+        "spark.rapids.tpu.batchRows": 4000,
+    }
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _agg_query(s, t), conf=conf, ignore_order=True)
+    assert M.get_manager().metrics["spillToHostBytes"] > 0
