@@ -263,6 +263,84 @@ def resolve_aggregate(u: UExpr, schema: T.StructType
     return fn, alias or f"{kind}({u.children[0]})"
 
 
+def resolve_window(u: UExpr, schema: T.StructType):
+    """Resolve a ``col.over(WindowSpec)`` expression.
+
+    Returns (partition_by, order_by SortOrders, WindowFunctionSpec,
+    default name).  [REF: GpuWindowExpression tagging]
+    """
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.sql.window import Window, WindowSpec
+
+    spec: WindowSpec = u.payload
+    fu = u.children[0]
+    pby = [resolve(p, schema) for p in spec.partition_by]
+    orders = []
+    for o in spec.order_by:
+        asc, nf = True, True
+        if o.op == "sortorder":
+            d, n = o.payload
+            asc, nf = d == "asc", n == "nulls_first"
+            o = o.children[0]
+        orders.append(L.SortOrder(resolve(o, schema), asc, nf))
+    if spec.frame is None:
+        frame = "range_current" if orders else "partition"
+    else:
+        kind, lo, hi = spec.frame
+        if kind == "rows" and lo == Window.unboundedPreceding and hi == 0:
+            frame = "rows_current"
+        elif (kind == "rows" and lo == Window.unboundedPreceding
+              and hi == Window.unboundedFollowing):
+            frame = "partition"
+        else:
+            raise AnalysisException(
+                f"unsupported window frame {spec.frame} (supported: "
+                "unboundedPreceding..currentRow, unbounded..unbounded)")
+
+    if fu.op == "winfn":
+        kind = fu.payload[0]
+        if not orders:
+            raise AnalysisException(f"{kind}() requires an ORDER BY spec")
+        if kind in ("row_number", "rank", "dense_rank"):
+            wf = L.WindowFunctionSpec(kind, None, T.IntegerT, frame=frame)
+            name = f"{kind}()"
+        else:  # lag / lead
+            child = resolve(fu.children[0], schema)
+            wf = L.WindowFunctionSpec(kind, child, child.dtype,
+                                      offset=int(fu.payload[1]),
+                                      frame=frame)
+            name = f"{kind}({fu.children[0]}, {fu.payload[1]})"
+    elif fu.op == "agg":
+        kind = fu.payload
+        if kind == "count_star":
+            child = resolve(UExpr("lit", 1), schema)
+            kind = "count"
+        else:
+            child = resolve(fu.children[0], schema)
+        if kind == "avg":
+            child = cast_to(child, T.DoubleT)
+        if kind == "sum" and isinstance(child.dtype, T.FloatType):
+            child = cast_to(child, T.DoubleT)
+        if kind not in ("sum", "min", "max", "count", "avg", "first"):
+            raise AnalysisException(
+                f"unsupported window aggregate '{kind}'")
+        if kind == "count":
+            dtype = T.LongT
+        elif kind == "avg":
+            dtype = T.DoubleT
+        elif kind == "sum":
+            dtype = A.Sum(child).result_dtype
+        else:
+            dtype = child.dtype
+        wf = L.WindowFunctionSpec(kind, child, dtype, frame=frame)
+        name = f"{kind}({fu.children[0] if fu.children else '1'})"
+    else:
+        raise AnalysisException(
+            f"only window functions and aggregates may be used with "
+            f".over(), got {fu}")
+    return pby, orders, wf, f"{name} OVER (...)"
+
+
 def _parse_type(s: str) -> T.DataType:
     m = {"int": T.IntegerT, "integer": T.IntegerT, "long": T.LongT,
          "bigint": T.LongT, "short": T.ShortT, "byte": T.ByteT,

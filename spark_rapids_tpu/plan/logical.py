@@ -94,6 +94,40 @@ class Aggregate(LogicalPlan):
 
 
 @dataclasses.dataclass
+class WindowFunctionSpec:
+    """One bound window expression [REF: GpuWindowExpression].
+
+    kind: row_number | rank | dense_rank | lag | lead |
+          sum | min | max | count | avg | first
+    frame: 'range_current' (Spark default with ORDER BY: RANGE unbounded
+           preceding..current row, peers included), 'rows_current'
+           (ROWS unbounded preceding..current row), or 'partition'
+           (whole partition — the default without ORDER BY).
+    """
+
+    kind: str
+    child: Optional[Expression]
+    dtype: T.DataType
+    offset: int = 1          # lag/lead
+    frame: str = "partition"
+
+
+@dataclasses.dataclass
+class Window(LogicalPlan):
+    """Appends window-function result columns to the child's output."""
+
+    child: LogicalPlan
+    partition_by: List[Expression]
+    order_by: List[SortOrder]
+    functions: List[WindowFunctionSpec]
+    schema: T.StructType  # child fields + one field per function
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
 class Sort(LogicalPlan):
     child: LogicalPlan
     orders: List[SortOrder]

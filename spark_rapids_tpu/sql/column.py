@@ -150,6 +150,10 @@ class Column:
         return Column(UExpr("casewhen", "closed",
                             u.children + (_to_uexpr(value),)))
 
+    def over(self, window) -> "Column":
+        """Attach a WindowSpec: F.row_number().over(w), F.sum(c).over(w)."""
+        return Column(UExpr("window", window, (self._u,)))
+
     def asc(self) -> "Column":
         return Column(UExpr("sortorder", ("asc", "nulls_first"), (self._u,)))
 

@@ -69,6 +69,9 @@ class DataFrame:
 
     # -- transformations ----------------------------------------------------
     def select(self, *cols) -> "DataFrame":
+        if any(self._window_u(c) is not None for c in cols
+               if not (isinstance(c, str) and c == "*")):
+            return self._select_with_windows(cols)
         exprs = []
         fields = []
         for c in cols:
@@ -85,6 +88,80 @@ class DataFrame:
             fields.append(T.StructField(name, e.dtype))
         schema = T.StructType(tuple(fields))
         return DataFrame(self.session, L.Project(self._plan, exprs, schema))
+
+    @staticmethod
+    def _window_u(c) -> Optional[UExpr]:
+        """The window UExpr under an optional alias, else None."""
+        if isinstance(c, str):
+            return None
+        u = _to_column(c)._u
+        core = u.children[0] if u.op == "alias" else u
+        return core if core.op == "window" else None
+
+    def _select_with_windows(self, cols) -> "DataFrame":
+        """Spark's ExtractWindowExpressions analog: insert Window plan
+        nodes (one per distinct spec) that append the computed columns,
+        then project the requested output."""
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        base_schema = self.schema
+        plan = self._plan
+        appended = {}   # id(col-obj position) → (field index in extended)
+        groups = {}     # spec-key → (pby, orders, [fns], [positions])
+        out_specs = []  # per output col: ("plain", u) | ("win", pos_key)
+        for ci, c in enumerate(cols):
+            wu = self._window_u(c)
+            if wu is None:
+                out_specs.append(("plain", c))
+                continue
+            u = _to_column(c)._u
+            pby, orders, wf, default_name = AN.resolve_window(
+                wu, base_schema)
+            alias = u.payload if u.op == "alias" else None
+            skey = repr((wu.payload.partition_by, wu.payload.order_by,
+                         wu.payload.frame))
+            g = groups.setdefault(skey, (pby, orders, [], []))
+            g[2].append(wf)
+            g[3].append(ci)
+            out_specs.append(("win", (skey, len(g[2]) - 1),
+                             alias or default_name, wf.dtype))
+        # build the Window chain; track where each group's outputs land
+        offsets = {}
+        ext_fields = list(base_schema.fields)
+        wcount = 0
+        for skey, (pby, orders, fns, _) in groups.items():
+            offsets[skey] = len(ext_fields)
+            new_fields = [
+                T.StructField(f"_w{wcount + i}", fn.dtype)
+                for i, fn in enumerate(fns)]
+            wcount += len(fns)
+            ext_fields.extend(new_fields)
+            plan = L.Window(
+                plan, pby, orders, fns,
+                T.StructType(tuple(ext_fields)))
+        ext_schema = T.StructType(tuple(ext_fields))
+        # final projection over the extended schema
+        exprs, fields = [], []
+        for spec in out_specs:
+            if spec[0] == "plain":
+                c = spec[1]
+                if isinstance(c, str) and c == "*":
+                    for i, f in enumerate(base_schema.fields):
+                        exprs.append(BoundReference(i, f.dtype,
+                                                    f.nullable))
+                        fields.append(f)
+                    continue
+                u = _to_column(c)._u
+                e = AN.resolve(u, ext_schema)
+                exprs.append(e)
+                fields.append(T.StructField(self._output_name(u, e),
+                                            e.dtype))
+            else:
+                (skey, j), name, dtype = spec[1], spec[2], spec[3]
+                idx = offsets[skey] + j
+                exprs.append(BoundReference(idx, dtype, True))
+                fields.append(T.StructField(name, dtype))
+        return DataFrame(self.session, L.Project(
+            plan, exprs, T.StructType(tuple(fields))))
 
     @staticmethod
     def _output_name(u: UExpr, e) -> str:

@@ -102,3 +102,25 @@ def count(c) -> Column:
 
 def countDistinct(c) -> Column:
     return Column(UExpr("agg", "count_distinct", (_cu(c),)))
+
+
+# window functions ----------------------------------------------------------
+
+def row_number() -> Column:
+    return Column(UExpr("winfn", ("row_number",)))
+
+
+def rank() -> Column:
+    return Column(UExpr("winfn", ("rank",)))
+
+
+def dense_rank() -> Column:
+    return Column(UExpr("winfn", ("dense_rank",)))
+
+
+def lag(c, offset: int = 1) -> Column:
+    return Column(UExpr("winfn", ("lag", offset), (_cu(c),)))
+
+
+def lead(c, offset: int = 1) -> Column:
+    return Column(UExpr("winfn", ("lead", offset), (_cu(c),)))
