@@ -134,28 +134,35 @@ class TpuScanExec(TpuExec):
                partition)
         cached = _scan_cache_get(self.table, key)
         if cached is not None:
-            for sp, nrows in cached:
-                self.metric("numOutputRows").add(nrows)
-                self.metric("numOutputBatches").add(1)
+            for bi, (sp, nrows) in enumerate(cached):
                 try:
                     # restores the batch if the arbiter spilled it
-                    yield sp.get()
+                    restored = sp.get()
                 except RetryOOM:
                     # no room to restore: drop the cache and stream the
-                    # partition straight from the arrow table instead
+                    # REMAINDER of the partition straight from the arrow
+                    # table (earlier entries were already yielded — never
+                    # restart from batch 0, that duplicates rows)
                     _scan_cache_evict(id(self.table))
-                    yield from self._stream(partition, register=False)
+                    yield from self._stream(partition, register=False,
+                                            start_batch=bi)
                     return
+                self.metric("numOutputRows").add(nrows)
+                self.metric("numOutputBatches").add(1)
+                yield restored
             return
         yield from self._stream(partition, key, register=True)
 
-    def _stream(self, partition: int, key=None, register: bool = False
-                ) -> Iterator[DeviceBatch]:
+    def _stream(self, partition: int, key=None, register: bool = False,
+                start_batch: int = 0) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu.runtime.memory import (
             RetryOOM, SpillableBatch, get_manager)
         out = []
         part = _slice_table(self.table, self._num_partitions)[partition]
-        for lo in range(0, max(part.num_rows, 1), self.batch_rows):
+        start = start_batch * self.batch_rows
+        if start and start >= part.num_rows:
+            return
+        for lo in range(start, max(part.num_rows, 1), self.batch_rows):
             chunk = part.slice(lo, self.batch_rows)
             if chunk.num_rows == 0 and lo > 0:
                 break

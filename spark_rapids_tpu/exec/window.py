@@ -1,0 +1,461 @@
+"""Window-function execs (CPU oracle + TPU segmented-scan kernel).
+
+[REF: sql-plugin/../GpuWindowExec.scala :: GpuWindowExec,
+ GpuWindowExpression.scala, GpuRunningWindowExec] — the reference drives
+cuDF rolling/scan kernels per window expression; here the whole Window
+node is ONE jitted device kernel, TPU-first:
+
+  encode (dead-flag, partition-keys, order-keys) as uint64 limbs
+  (ops/ordering.py) → one stable ``lax.sort`` → partition boundaries
+  (diff over partition limbs) and peer boundaries (diff over all limbs)
+  → every function is a ``segmented_scan`` (log-depth associative scan —
+  the scatter-free groupby primitive from exec/aggregate.py) plus, for
+  range/partition frames, a reversed keep-first scan that broadcasts each
+  segment's final value back over the frame.
+
+Supported frames (plan/analysis.py :: resolve_window):
+  * ``rows_current``   — ROWS unbounded preceding..current row (running)
+  * ``range_current``  — RANGE unbounded preceding..current row (the
+    Spark default with ORDER BY; peers share the frame-end value)
+  * ``partition``      — whole partition (default without ORDER BY)
+
+Output rows are sorted by (partition keys, order keys) — the order the
+reference's sort-requirement produces — identically on the CPU oracle
+and the device path (both sorts are stable over the same key encoding).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar import host as H
+from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn, compact
+from spark_rapids_tpu.exec.aggregate import segmented_scan
+from spark_rapids_tpu.exec.base import CpuExec, TpuExec
+from spark_rapids_tpu.exec.basic import concat_device_batches
+from spark_rapids_tpu.exec.sort import _concat_host
+from spark_rapids_tpu.ops import ordering as ORD
+from spark_rapids_tpu.ops import aggregates as A
+from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.plan import logical as L
+
+
+WINDOW_KINDS = ("row_number", "rank", "dense_rank", "lag", "lead",
+                "sum", "min", "max", "count", "avg", "first")
+
+
+# ---------------------------------------------------------------------------
+# Device kernel pieces
+# ---------------------------------------------------------------------------
+
+def _keep_first(a, _b):
+    return a
+
+
+def broadcast_last(values: jnp.ndarray, boundary: jnp.ndarray) -> jnp.ndarray:
+    """Give every row the value its segment holds at its LAST row.
+
+    ``boundary`` marks segment starts.  Implemented as a keep-first
+    segmented scan over the reversed array (reversed segment starts =
+    original segment ends) — still log-depth, still scatter-free."""
+    is_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    rev = jnp.flip(segmented_scan(_keep_first, jnp.flip(values),
+                                  jnp.flip(is_end)))
+    return rev
+
+
+def _limb_diff(limbs: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """True where any limb differs from the previous row's."""
+    n = limbs[0].shape[0] if limbs else 0
+    d = jnp.zeros((n,), jnp.bool_) if limbs else None
+    for l in limbs:
+        d = d | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
+    return d
+
+
+def _scan_sum(data_s, contrib, pb, acc_dt):
+    masked = jnp.where(contrib, data_s.astype(acc_dt),
+                       jnp.zeros((), acc_dt))
+    return segmented_scan(jnp.add, masked, pb)
+
+
+def _scan_minmax(data_s, contrib, pb, kind, dt):
+    """Running segmented min/max with Spark total-order semantics.
+
+    Returns (raw scan arrays...) to be frame-projected by the caller
+    BEFORE combining — the NaN bookkeeping must ride the same frame
+    projection as the main value (see _eval_agg)."""
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        isn = jnp.isnan(data_s)
+        real = contrib & ~isn
+        n_real = segmented_scan(jnp.add, real.astype(jnp.int32), pb)
+        any_nan = segmented_scan(
+            jnp.add, (contrib & isn).astype(jnp.int32), pb)
+        inf = jnp.asarray(np.inf, data_s.dtype)
+        if kind == "min":
+            agg = segmented_scan(
+                jnp.minimum, jnp.where(real, data_s, inf), pb)
+        else:
+            agg = segmented_scan(
+                jnp.maximum, jnp.where(real, data_s, -inf), pb)
+        return agg, n_real, any_nan
+    from spark_rapids_tpu.exec.aggregate import (
+        decode_orderable, encode_orderable)
+    u = encode_orderable(data_s, dt)
+    sentinel = jnp.uint64(0xFFFFFFFFFFFFFFFF if kind == "min" else 0)
+    masked = jnp.where(contrib, u, sentinel)
+    red = jnp.minimum if kind == "min" else jnp.maximum
+    return segmented_scan(red, masked, pb), None, None
+
+
+def _eval_agg(wf: L.WindowFunctionSpec, data_s, valid_s, live_s, pb,
+              peer_b) -> DeviceColumn:
+    kind, frame = wf.kind, wf.frame
+    contrib = valid_s & live_s
+
+    def proj(x):
+        """Frame projection: running value → frame value per row."""
+        if frame == "rows_current":
+            return x
+        return broadcast_last(x, peer_b if frame == "range_current" else pb)
+
+    n_contrib = proj(segmented_scan(
+        jnp.add, contrib.astype(jnp.int64), pb))
+    if kind == "count":
+        return DeviceColumn(T.LongT, n_contrib, None)
+    if kind == "sum":
+        acc_dt = T.to_numpy_dtype(wf.dtype)
+        s = proj(_scan_sum(data_s, contrib, pb, acc_dt))
+        return DeviceColumn(wf.dtype, s, n_contrib > 0)
+    if kind == "avg":
+        s = proj(_scan_sum(data_s, contrib, pb, jnp.float64))
+        denom = jnp.where(n_contrib > 0, n_contrib, 1)
+        return DeviceColumn(T.DoubleT, s / denom.astype(jnp.float64),
+                            n_contrib > 0)
+    if kind in ("min", "max"):
+        dt = wf.dtype
+        if isinstance(dt, (T.FloatType, T.DoubleType)):
+            agg, n_real, any_nan = _scan_minmax(data_s, contrib, pb, kind,
+                                                dt)
+            agg, n_real, any_nan = proj(agg), proj(n_real), proj(any_nan)
+            nan = jnp.asarray(np.nan, data_s.dtype)
+            if kind == "min":
+                # all-NaN frame → min is NaN (NaN greatest, Spark order)
+                agg = jnp.where((n_real == 0) & (n_contrib > 0), nan, agg)
+            else:
+                agg = jnp.where(any_nan > 0, nan, agg)
+            return DeviceColumn(dt, agg, n_contrib > 0)
+        from spark_rapids_tpu.exec.aggregate import decode_orderable
+        raw, _, _ = _scan_minmax(data_s, contrib, pb, kind, dt)
+        return DeviceColumn(dt, decode_orderable(proj(raw), dt),
+                            n_contrib > 0)
+    if kind == "first":
+        # first row of the partition — identical for all three frames
+        # (every supported frame starts unbounded-preceding)
+        v = segmented_scan(_keep_first, data_s, pb)
+        vv = segmented_scan(_keep_first, valid_s, pb)
+        return DeviceColumn(wf.dtype, v, vv)
+    raise NotImplementedError(f"window aggregate {kind}")
+
+
+def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
+                    perm, live_s, pb, peer_b, rn) -> DeviceColumn:
+    kind = wf.kind
+    if kind == "row_number":
+        return DeviceColumn(wf.dtype, rn, None)
+    if kind == "rank":
+        return DeviceColumn(wf.dtype,
+                            segmented_scan(_keep_first, rn, peer_b), None)
+    if kind == "dense_rank":
+        return DeviceColumn(
+            wf.dtype,
+            segmented_scan(jnp.add, peer_b.astype(jnp.int32), pb), None)
+
+    c = wf.child.eval_tpu(batch)
+    data_s = jnp.take(c.data, perm, axis=0)
+    valid_s = jnp.take(c.valid_mask(), perm)
+    lengths_s = None if c.lengths is None else jnp.take(c.lengths, perm)
+
+    if kind in ("lag", "lead"):
+        k = int(wf.offset)
+        b = int(data_s.shape[0])
+        if k >= b:  # offset beyond the batch: every row's result is null
+            return DeviceColumn(
+                wf.dtype, jnp.zeros_like(data_s),
+                jnp.zeros((b,), jnp.bool_),
+                None if lengths_s is None else jnp.zeros_like(lengths_s))
+        if k == 0:
+            return DeviceColumn(wf.dtype, data_s,
+                                valid_s & live_s, lengths_s)
+        if kind == "lag":
+            def shift(x, fill):
+                pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
+                return jnp.concatenate([pad, x[:-k]], axis=0)
+            in_part = rn > k
+        else:
+            def shift(x, fill):
+                pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
+                return jnp.concatenate([x[k:], pad], axis=0)
+            # target row is in-partition iff its row_number is ours + k
+            # (crossing into the next partition/dead region resets rn to
+            # <= k, so no false positives)
+            in_part = shift(rn, -1) == rn + k
+        sd = shift(data_s, 0)
+        sv = shift(valid_s, False) & in_part
+        sl = None if lengths_s is None else shift(lengths_s, 0)
+        return DeviceColumn(wf.dtype, sd, sv, sl)
+
+    return _eval_agg(wf, data_s, valid_s, live_s, pb, peer_b)
+
+
+def _window_impl(batch: DeviceBatch, pby: Sequence[Expression],
+                 orders: Sequence[L.SortOrder],
+                 fns: Sequence[L.WindowFunctionSpec],
+                 out_schema: T.StructType) -> DeviceBatch:
+    b = batch.capacity
+    pparts = ([ORD._flag_part(~batch.sel)]
+              + ORD.batch_group_parts([e.eval_tpu(batch) for e in pby]))
+    oparts = []
+    for o in orders:
+        c = o.expr.eval_tpu(batch)
+        oparts.extend(ORD.column_order_parts(c, o.ascending, o.nulls_first))
+    limbs_p = ORD.fuse_parts(pparts)
+    limbs_o = ORD.fuse_parts(oparts)
+    n_lp = len(limbs_p)
+    sorted_limbs, perm = ORD.sort_by_keys(limbs_p + limbs_o)
+    live_s = jnp.take(batch.sel, perm)
+
+    pb = _limb_diff(sorted_limbs[:n_lp]).at[0].set(True)
+    peer_b = (pb | (_limb_diff(sorted_limbs[n_lp:])
+                    if n_lp < len(sorted_limbs)
+                    else jnp.zeros((b,), jnp.bool_))).at[0].set(True)
+    rn = segmented_scan(jnp.add, jnp.ones((b,), jnp.int32), pb)
+
+    out_cols: List[DeviceColumn] = [c.gather(perm) for c in batch.columns]
+    for wf in fns:
+        out_cols.append(
+            _eval_window_fn(wf, batch, perm, live_s, pb, peer_b, rn))
+    count = jnp.sum(live_s.astype(jnp.int32))
+    sel = jnp.arange(b, dtype=jnp.int32) < count
+    return DeviceBatch(out_schema, tuple(out_cols), sel, compacted=True)
+
+
+class TpuWindowExec(TpuExec):
+    """[REF: GpuWindowExec] — whole Window node as one jitted kernel."""
+
+    def __init__(self, partition_by: Sequence[Expression],
+                 order_by: Sequence[L.SortOrder],
+                 fns: Sequence[L.WindowFunctionSpec],
+                 schema: T.StructType, child: TpuExec):
+        super().__init__(schema, child)
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.fns = list(fns)
+
+    def node_string(self):
+        parts = ", ".join(str(e) for e in self.partition_by)
+        fns = ", ".join(f.kind for f in self.fns)
+        return f"TpuWindow [partitionBy=[{parts}] fns=[{fns}]]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        from spark_rapids_tpu.runtime.memory import get_manager
+        child = self.children[0]
+        batches = [compact(b) for p in range(child.num_partitions())
+                   for b in child.execute(p)]
+        if not batches:
+            return
+        with self.timer():
+            merged = concat_device_batches(child.schema, batches)
+            pby, orders, fns, schema = (self.partition_by, self.order_by,
+                                        self.fns, self.schema)
+            fn = cached_kernel(
+                ("window", fingerprint(pby), fingerprint(orders),
+                 fingerprint(fns), fingerprint(schema)),
+                lambda: (lambda bt: _window_impl(bt, pby, orders, fns,
+                                                 schema)))
+            with get_manager().transient(2 * merged.nbytes()):
+                out = fn(merged)
+        self.metric("numOutputBatches").add(1)
+        yield out
+
+
+# ---------------------------------------------------------------------------
+# CPU oracle
+# ---------------------------------------------------------------------------
+
+_AGG_CLS = {"sum": A.Sum, "min": A.Min, "max": A.Max, "count": A.Count,
+            "avg": A.Average, "first": A.First}
+
+
+class CpuWindowExec(CpuExec):
+    """Numpy/row-loop oracle: same sort-key encoding as the device path
+    (so output row order matches exactly), segment-by-segment Python
+    evaluation of each function."""
+
+    def __init__(self, partition_by: Sequence[Expression],
+                 order_by: Sequence[L.SortOrder],
+                 fns: Sequence[L.WindowFunctionSpec],
+                 schema: T.StructType, child: CpuExec):
+        super().__init__(schema, child)
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.fns = list(fns)
+
+    def node_string(self):
+        fns = ", ".join(f.kind for f in self.fns)
+        return f"Window [fns=[{fns}]]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[H.HostBatch]:
+        child = self.children[0]
+        batches = [b for p in range(child.num_partitions())
+                   for b in child.execute(p)]
+        if not batches:
+            return
+        merged = _concat_host(child.schema, batches)
+        n = merged.num_rows
+
+        limbs_p: List[np.ndarray] = []
+        for e in self.partition_by:
+            c = e.eval_cpu(merged)
+            data = c.data
+            if isinstance(c.dtype, (T.FloatType, T.DoubleType)):
+                data = data + 0.0  # group semantics: -0.0 == 0.0
+            limbs_p.extend(ORD.np_order_keys(
+                data, c.validity, c.dtype, True, True))
+        limbs_o: List[np.ndarray] = []
+        for o in self.order_by:
+            c = o.expr.eval_cpu(merged)
+            limbs_o.extend(ORD.np_order_keys(
+                c.data, c.validity, c.dtype, o.ascending, o.nulls_first))
+        iota = np.arange(n, dtype=np.int64).view(np.uint64)
+        perm = np.lexsort(list(reversed(limbs_p + limbs_o + [iota])))
+
+        def diff(limbs):
+            d = np.zeros(n, bool)
+            for l in limbs:
+                ls = l[perm]
+                d[1:] |= ls[1:] != ls[:-1]
+            return d
+
+        pb = diff(limbs_p)
+        pb[0] = True
+        peer_b = pb | diff(limbs_o)
+        peer_b[0] = True
+
+        out_cols = [H.HostCol(c.dtype, c.data[perm],
+                              None if c.validity is None
+                              else c.validity[perm])
+                    for c in merged.columns]
+        for wf in self.fns:
+            out_cols.append(self._eval_fn(wf, merged, perm, pb, peer_b))
+        yield H.HostBatch(self.schema, out_cols)
+
+    def _eval_fn(self, wf: L.WindowFunctionSpec, merged: H.HostBatch,
+                 perm, pb, peer_b) -> H.HostCol:
+        from spark_rapids_tpu.exec.aggregate import (
+            _acc_final, _acc_update, _new_acc)
+        n = len(perm)
+        vals: List[object] = [None] * n
+        vc = None
+        if wf.child is not None:
+            c = wf.child.eval_cpu(merged)
+            vc = H.HostCol(c.dtype, c.data[perm],
+                           None if c.validity is None else c.validity[perm])
+        # partition spans
+        starts = list(np.flatnonzero(pb)) + [n]
+        for si in range(len(starts) - 1):
+            lo, hi = starts[si], starts[si + 1]
+            peer_starts = [i for i in range(lo, hi) if peer_b[i] or i == lo]
+            peer_starts.append(hi)
+            if wf.kind == "row_number":
+                for i in range(lo, hi):
+                    vals[i] = i - lo + 1
+            elif wf.kind == "rank":
+                for pi in range(len(peer_starts) - 1):
+                    for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                        vals[i] = peer_starts[pi] - lo + 1
+            elif wf.kind == "dense_rank":
+                for pi in range(len(peer_starts) - 1):
+                    for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                        vals[i] = pi + 1
+            elif wf.kind in ("lag", "lead"):
+                k = wf.offset if wf.kind == "lag" else -wf.offset
+                for i in range(lo, hi):
+                    src = i - k
+                    if lo <= src < hi:
+                        valid = (vc.validity is None
+                                 or bool(vc.validity[src]))
+                        vals[i] = vc.data[src] if valid else None
+            else:  # aggregates
+                fobj = _AGG_CLS[wf.kind](wf.child)
+                acc = _new_acc(fobj)
+                if wf.frame == "rows_current":
+                    for i in range(lo, hi):
+                        _acc_update(acc, fobj, vc, i)
+                        vals[i] = _acc_final(acc, fobj)
+                elif wf.frame == "range_current":
+                    for pi in range(len(peer_starts) - 1):
+                        for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                            _acc_update(acc, fobj, vc, i)
+                        v = _acc_final(acc, fobj)
+                        for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                            vals[i] = v
+                else:  # whole partition
+                    for i in range(lo, hi):
+                        _acc_update(acc, fobj, vc, i)
+                    v = _acc_final(acc, fobj)
+                    for i in range(lo, hi):
+                        vals[i] = v
+        return _vals_to_col(vals, wf.dtype)
+
+
+def _vals_to_col(vals: List[object], dt: T.DataType) -> H.HostCol:
+    validity = np.array([v is not None for v in vals], bool)
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        data = np.array([v if v is not None else "" for v in vals],
+                        dtype=object)
+    else:
+        npdt = T.to_numpy_dtype(dt)
+        data = np.array([v if v is not None else 0 for v in vals])
+        data = data.astype(npdt, copy=False)
+    return H.HostCol(dt, data, None if validity.all() else validity)
+
+
+# ---------------------------------------------------------------------------
+# Overrides rule
+# ---------------------------------------------------------------------------
+
+def _tag_window(meta):
+    cpu: CpuWindowExec = meta.cpu
+    meta.tag_expressions(cpu.partition_by)
+    meta.tag_expressions([o.expr for o in cpu.order_by])
+    for wf in cpu.fns:
+        if wf.kind not in WINDOW_KINDS:
+            meta.will_not_work(
+                f"window function {wf.kind} has no TPU implementation")
+            continue
+        if wf.child is not None:
+            meta.tag_expressions([wf.child])
+            if wf.kind in ("min", "max", "first") and isinstance(
+                    wf.child.dtype, (T.StringType, T.BinaryType)):
+                meta.will_not_work(
+                    f"window {wf.kind} over "
+                    f"{wf.child.dtype.simple_name} input not yet "
+                    "supported on device (string scan buffers)")
+
+
+def _convert_window(cpu: CpuWindowExec, ch, conf):
+    return TpuWindowExec(cpu.partition_by, cpu.order_by, cpu.fns,
+                         cpu.schema, ch[0])

@@ -306,10 +306,15 @@ def resolve_window(u: UExpr, schema: T.StructType):
             name = f"{kind}()"
         else:  # lag / lead
             child = resolve(fu.children[0], schema)
-            wf = L.WindowFunctionSpec(kind, child, child.dtype,
-                                      offset=int(fu.payload[1]),
-                                      frame=frame)
+            offset = int(fu.payload[1])
+            # Spark's default name keeps the user's spelling, even when a
+            # negative offset normalizes lag <-> lead below
             name = f"{kind}({fu.children[0]}, {fu.payload[1]})"
+            if offset < 0:  # Spark: lag(-k) == lead(k) and vice versa
+                kind = "lead" if kind == "lag" else "lag"
+                offset = -offset
+            wf = L.WindowFunctionSpec(kind, child, child.dtype,
+                                      offset=offset, frame=frame)
     elif fu.op == "agg":
         kind = fu.payload
         if kind == "count_star":
@@ -317,13 +322,17 @@ def resolve_window(u: UExpr, schema: T.StructType):
             kind = "count"
         else:
             child = resolve(fu.children[0], schema)
+        if kind not in ("sum", "min", "max", "count", "avg", "first"):
+            raise AnalysisException(
+                f"unsupported window aggregate '{kind}'")
+        if kind in ("sum", "avg") and not T.is_numeric(child.dtype):
+            raise AnalysisException(
+                f"{kind}() over window needs a numeric input, got "
+                f"{child.dtype.simple_name}")
         if kind == "avg":
             child = cast_to(child, T.DoubleT)
         if kind == "sum" and isinstance(child.dtype, T.FloatType):
             child = cast_to(child, T.DoubleT)
-        if kind not in ("sum", "min", "max", "count", "avg", "first"):
-            raise AnalysisException(
-                f"unsupported window aggregate '{kind}'")
         if kind == "count":
             dtype = T.LongT
         elif kind == "avg":

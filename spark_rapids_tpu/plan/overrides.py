@@ -254,6 +254,14 @@ def _register_lazy_rules():
     except ImportError:
         pass
     try:
+        from spark_rapids_tpu.exec.window import (
+            CpuWindowExec, _tag_window, _convert_window)
+        EXEC_RULES.setdefault(CpuWindowExec, ExecRule(
+            "Window", _tag_window, _convert_window,
+            "device window functions (sorted segmented scans)"))
+    except ImportError:
+        pass
+    try:
         from spark_rapids_tpu.exec.exchange import (
             CpuShuffleExchangeExec, _tag_exchange, _convert_exchange)
         EXEC_RULES.setdefault(CpuShuffleExchangeExec, ExecRule(

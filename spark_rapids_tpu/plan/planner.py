@@ -45,6 +45,11 @@ def plan_physical(node: L.LogicalPlan, conf: RapidsConf) -> CpuExec:
                            node.condition, node.schema,
                            plan_physical(node.left, conf),
                            plan_physical(node.right, conf))
+    if isinstance(node, L.Window):
+        from spark_rapids_tpu.exec.window import CpuWindowExec
+        return CpuWindowExec(node.partition_by, node.order_by,
+                             node.functions, node.schema,
+                             plan_physical(node.child, conf))
     if isinstance(node, L.Repartition):
         from spark_rapids_tpu.exec.exchange import CpuShuffleExchangeExec
         return CpuShuffleExchangeExec(

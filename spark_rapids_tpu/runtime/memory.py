@@ -57,6 +57,9 @@ class SpillableBatch:
         self._batch: Optional[DeviceBatch] = batch
         self._host: Optional[list] = None
         self._disk_path: Optional[str] = None
+        # True only while this batch's host copy is counted in the
+        # manager's _host_used (a disk restore staged in _host is NOT)
+        self._host_accounted = False
         self.schema = batch.schema
         self.compacted = batch.compacted
         self.nbytes = batch.nbytes()
@@ -87,6 +90,7 @@ class SpillableBatch:
                 pass
         self._host = ([np.asarray(x) for x in leaves], treedef)
         self._batch = None
+        self._host_accounted = True
         self._mgr._on_spill(self, self.nbytes)
         return self.nbytes
 
@@ -103,6 +107,11 @@ class SpillableBatch:
         self._treedef = treedef
         freed = sum(x.nbytes for x in leaves)
         self._host = None
+        if self._host_accounted:
+            with self._mgr._lock:
+                self._mgr._host_used = max(
+                    0, self._mgr._host_used - freed)
+            self._host_accounted = False
         self._mgr._on_disk_spill(self, freed)
         return freed
 
@@ -124,7 +133,8 @@ class SpillableBatch:
         self._batch = jax.tree.unflatten(
             treedef, [jax.numpy.asarray(x) for x in leaves])
         self._host = None
-        if from_host:
+        if from_host and self._host_accounted:
+            self._host_accounted = False
             self._mgr._on_restore(self)
         return self._batch
 
@@ -232,6 +242,12 @@ class DeviceMemoryManager:
             self._spillables.pop(id(s), None)
             if s.tier == "device":
                 self.release(s.nbytes)
+            elif s._host_accounted:
+                # symmetric with _on_spill: host-tier bytes leave the
+                # host budget when the batch is closed/evicted (staged
+                # disk restores were never counted — skip those)
+                s._host_accounted = False
+                self._host_used = max(0, self._host_used - s.nbytes)
 
     def _on_spill(self, s: SpillableBatch, nbytes: int) -> None:
         with self._lock:
@@ -241,10 +257,11 @@ class DeviceMemoryManager:
             while self._host_used > self.host_limit:
                 victim = next(
                     (v for v in self._spillables.values()
-                     if v.tier == "host" and v is not s), None)
+                     if v.tier == "host" and v._host_accounted
+                     and v is not s), None)
                 if victim is None:
                     break
-                self._host_used -= victim.spill_to_disk()
+                victim.spill_to_disk()  # decrements _host_used itself
 
     def _on_disk_spill(self, s: SpillableBatch, nbytes: int) -> None:
         self.metrics["spillToDiskBytes"] += nbytes
@@ -272,9 +289,10 @@ def get_manager(conf=None) -> DeviceMemoryManager:
         elif conf is not None:
             cfg = _build(conf)
             if (cfg.budget, cfg.host_limit, cfg._inject_at,
-                    cfg.retry_max_attempts) != (
+                    cfg.retry_max_attempts, cfg.spill_path) != (
                     _manager.budget, _manager.host_limit,
-                    _manager._inject_at, _manager.retry_max_attempts):
+                    _manager._inject_at, _manager.retry_max_attempts,
+                    _manager.spill_path):
                 # a new manager orphans batches registered with the old
                 # one — evict the device-resident scan cache so nothing
                 # keeps accounting against the dead arbiter
@@ -360,10 +378,16 @@ def with_retry(
         except RetryOOM:
             if attempts + 1 >= max_attempts:
                 raise
-            # free device pressure, then retry the same batch
+            # free device pressure INCREMENTALLY: spill victims until
+            # roughly this batch's working set is free, not the whole
+            # pool (draining everything evicts the scan cache on the
+            # first transient OOM and forces full re-materialization)
+            freed, target = 0, max(batch.nbytes(), 1)
             for s in list(mgr._spillables.values()):
                 if s.tier == "device":
-                    s.spill_to_host()
+                    freed += s.spill_to_host()
+                    if freed >= target:
+                        break
             if attempts >= 1 and allow_split and batch.capacity > 1:
                 mgr.metrics["splitRetries"] += 1
                 halves = split_batch_in_half(batch)

@@ -160,6 +160,19 @@ class Column:
     def desc(self) -> "Column":
         return Column(UExpr("sortorder", ("desc", "nulls_last"), (self._u,)))
 
+    def asc_nulls_first(self) -> "Column":
+        return self.asc()
+
+    def asc_nulls_last(self) -> "Column":
+        return Column(UExpr("sortorder", ("asc", "nulls_last"), (self._u,)))
+
+    def desc_nulls_first(self) -> "Column":
+        return Column(UExpr("sortorder", ("desc", "nulls_first"),
+                            (self._u,)))
+
+    def desc_nulls_last(self) -> "Column":
+        return self.desc()
+
     def substr(self, start, length) -> "Column":
         return Column(UExpr("substring", (start, length), (self._u,)))
 

@@ -1,0 +1,247 @@
+"""Window-function CPU-vs-TPU oracle tests.
+
+[REF: integration_tests/src/main/python/window_function_test.py]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.plan.analysis import AnalysisException
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.sql.window import Window
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, assert_tpu_fallback_collect)
+
+
+def gen_table(seed=0, n=300):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": dg.IntegerGen(min_val=0, max_val=6).generate(rng, n),
+        "o": dg.IntegerGen(min_val=-20, max_val=20).generate(rng, n),
+        "v": dg.LongGen().generate(rng, n),
+        "d": dg.DoubleGen().generate(rng, n),
+        "s": dg.StringGen().generate(rng, n),
+    })
+
+
+def test_row_number_rank_dense_rank():
+    t = gen_table(0)
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o",
+            F.row_number().over(w).alias("rn"),
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("dr")))
+
+
+def test_rank_with_ties_and_null_keys():
+    # heavy duplication in the order column forces real peer groups;
+    # nullable partition AND order keys
+    t = pa.table({
+        "k": pa.array([1, 1, None, None, 2, 2, 2, 1, None, 2],
+                      type=pa.int32()),
+        "o": pa.array([5, 5, 3, None, 1, 1, None, 5, 3, 2],
+                      type=pa.int32()),
+        "v": pa.array(list(range(10)), type=pa.int64()),
+    })
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.rank().over(w).alias("rk"),
+            F.dense_rank().over(w).alias("dr"),
+            F.row_number().over(w).alias("rn")))
+
+
+def test_window_nan_order_keys():
+    t = pa.table({
+        "k": pa.array([0, 0, 0, 1, 1, 1, 0, 1]),
+        "d": pa.array([1.0, float("nan"), -0.0, 0.0, float("nan"), None,
+                       float("-inf"), 2.5]),
+        "v": pa.array(list(range(8)), type=pa.int64()),
+    })
+    w = Window.partitionBy("k").orderBy("d")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "d",
+            F.rank().over(w).alias("rk"),
+            F.sum("v").over(w).alias("rs")))
+
+
+def test_window_nan_partition_keys():
+    # NaN and -0.0/0.0 normalization in PARTITION keys (one group each)
+    t = pa.table({
+        "k": pa.array([float("nan"), float("nan"), -0.0, 0.0, 1.0, None,
+                       None, 1.0]),
+        "o": pa.array([1, 2, 3, 4, 5, 6, 7, 8], type=pa.int32()),
+        "v": pa.array(list(range(8)), type=pa.int64()),
+    })
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o",
+            F.row_number().over(w).alias("rn"),
+            F.count("v").over(w).alias("c")))
+
+
+def test_running_aggregates_range_frame():
+    # Spark default frame with ORDER BY: range unbounded..current — peers
+    # share the frame-end value (duplicate order keys exercise this)
+    t = gen_table(1)
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.sum("v").over(w).alias("rsum"),
+            F.count("v").over(w).alias("rcnt"),
+            F.min("v").over(w).alias("rmin"),
+            F.max("v").over(w).alias("rmax")))
+
+
+def test_running_aggregates_rows_frame():
+    t = gen_table(2)
+    w = (Window.partitionBy("k").orderBy("o", "v")
+         .rowsBetween(Window.unboundedPreceding, Window.currentRow))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.sum("v").over(w).alias("rsum"),
+            F.avg("v").over(w).alias("ravg"),
+            F.first("v").over(w).alias("rfirst")),
+        approx_float=True)
+
+
+def test_whole_partition_frame():
+    # no ORDER BY → whole-partition frame; also explicit unbounded frame
+    t = gen_table(3)
+    w_unordered = Window.partitionBy("k")
+    w_explicit = (Window.partitionBy("k").orderBy("o")
+                  .rowsBetween(Window.unboundedPreceding,
+                               Window.unboundedFollowing))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "v",
+            F.sum("v").over(w_unordered).alias("total"),
+            F.max("v").over(w_unordered).alias("mx")),
+        ignore_order=True)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.sum("v").over(w_explicit).alias("total")))
+
+
+def test_float_min_max_nan_values():
+    t = pa.table({
+        "k": pa.array([0, 0, 0, 1, 1, 2, 2, 2]),
+        "o": pa.array([1, 2, 3, 1, 2, 1, 2, 3], type=pa.int32()),
+        "d": pa.array([float("nan"), 1.0, -2.0, float("nan"), float("nan"),
+                       None, 3.5, -0.0]),
+    })
+    w = Window.partitionBy("k").orderBy("o")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "d",
+            F.min("d").over(w).alias("mn"),
+            F.max("d").over(w).alias("mx")))
+
+
+def test_lag_lead():
+    t = gen_table(4)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.lag("v").over(w).alias("lag1"),
+            F.lag("v", 3).over(w).alias("lag3"),
+            F.lead("v").over(w).alias("lead1"),
+            F.lead("v", 2).over(w).alias("lead2"),
+            F.lag("v", -1).over(w).alias("neg_lag")))
+
+
+def test_lag_lead_strings():
+    t = gen_table(5, n=80)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "s",
+            F.lag("s").over(w).alias("prev_s"),
+            F.lead("s", 2).over(w).alias("next_s")))
+
+
+def test_multiple_window_specs_one_select():
+    t = gen_table(6)
+    w1 = Window.partitionBy("k").orderBy("o")
+    w2 = Window.partitionBy("o").orderBy(col("v").desc())
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.row_number().over(w1).alias("rn1"),
+            F.sum("v").over(w1).alias("s1"),
+            F.row_number().over(w2).alias("rn2")),
+        ignore_order=True)
+
+
+def test_global_window_no_partition():
+    t = gen_table(7, n=100)
+    w = Window.orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "o", "v",
+            F.row_number().over(w).alias("rn"),
+            F.sum("v").over(w).alias("rs")))
+
+
+def test_window_desc_nulls_order():
+    t = gen_table(8)
+    w = Window.partitionBy("k").orderBy(col("o").desc_nulls_last(), "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.row_number().over(w).alias("rn"),
+            F.lag("v").over(w).alias("lg")))
+
+
+def test_window_over_multi_partition_input():
+    # child has several input partitions; window gathers them
+    t = gen_table(9)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v", F.row_number().over(w).alias("rn")),
+        conf={"spark.default.parallelism": 4})
+
+
+def test_window_avg_double():
+    t = gen_table(10)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "d",
+            F.avg("d").over(w).alias("ra")),
+        approx_float=True)
+
+
+def test_window_unsupported_frame_raises():
+    t = gen_table(11, n=20)
+    w = Window.partitionBy("k").orderBy("o").rowsBetween(-2, 2)
+
+    def build(s):
+        return s.createDataFrame(t).select(
+            F.sum("v").over(w).alias("x"))
+
+    from spark_rapids_tpu.utils.harness import cpu_session
+    with pytest.raises(AnalysisException):
+        build(cpu_session())
+
+
+def test_window_string_minmax_falls_back():
+    t = gen_table(12, n=60)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_fallback_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "s", F.first("s").over(w).alias("fs")),
+        "Window")
