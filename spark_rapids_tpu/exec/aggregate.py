@@ -93,6 +93,7 @@ def segment_groupby(
     key_cols: Sequence[DeviceColumn],
     sel: jnp.ndarray,
     value_cols: Sequence[Tuple[DeviceColumn, str]],
+    has_nans: bool = True,
 ) -> Tuple[List[DeviceColumn], List[DeviceColumn], jnp.ndarray]:
     """Group rows by keys; reduce values by kind ('sum'|'min'|'max'|'first').
 
@@ -146,7 +147,17 @@ def segment_groupby(
             agg = segmented_scan(jnp.add, masked, boundary)
             validity = n_contrib > 0
         elif kind in ("min", "max"):
-            if _is_float(c.dtype):
+            if _is_float(c.dtype) and not has_nans:
+                # spark.rapids.sql.hasNans=false: the user promises no
+                # NaNs, so skip the NaN total-order bookkeeping (three
+                # scans collapse to one)
+                inf = jnp.asarray(np.inf, data_s.dtype)
+                sent = inf if kind == "min" else -inf
+                red = jnp.minimum if kind == "min" else jnp.maximum
+                agg = segmented_scan(
+                    red, jnp.where(contrib, data_s, sent), boundary)
+                validity = n_contrib > 0
+            elif _is_float(c.dtype):
                 # Spark float total order: NaN greatest.  No 64-bit
                 # bitcasts on TPU, so reduce raw floats with NaN masked
                 # out and reinstate NaN per the order semantics.
@@ -194,8 +205,8 @@ def segment_groupby(
 
 
 def _reduce_column(data: jnp.ndarray, valid: jnp.ndarray,
-                   live: jnp.ndarray, kind: str, dt: T.DataType
-                   ) -> DeviceColumn:
+                   live: jnp.ndarray, kind: str, dt: T.DataType,
+                   has_nans: bool = True) -> DeviceColumn:
     """Whole-array masked reduction → 1-element column, honoring the same
     Spark semantics as ``segment_groupby`` (NaN greatest under total
     order, wrap-free sums of valid rows only, 'first' takes the first
@@ -206,7 +217,12 @@ def _reduce_column(data: jnp.ndarray, valid: jnp.ndarray,
         v = jnp.sum(jnp.where(contrib, data, jnp.zeros((), data.dtype)))
         out_v, out_valid = v, got
     elif kind in ("min", "max"):
-        if _is_float(dt):
+        if _is_float(dt) and not has_nans:
+            inf = jnp.asarray(np.inf, data.dtype)
+            sent = inf if kind == "min" else -inf
+            masked = jnp.where(contrib, data, sent)
+            out_v = jnp.min(masked) if kind == "min" else jnp.max(masked)
+        elif _is_float(dt):
             isn = jnp.isnan(data)
             real = contrib & ~isn
             inf = jnp.asarray(np.inf, data.dtype)
@@ -339,12 +355,14 @@ class TpuHashAggregateExec(TpuExec):
     def __init__(self, grouping: Sequence[Expression],
                  fns: Sequence[AggregateFunction],
                  schema: T.StructType, child: TpuExec,
-                 mode: str = "complete"):
+                 mode: str = "complete", has_nans: bool = True):
         super().__init__(schema, child)
         self.grouping = list(grouping)
         self.fns = list(fns)
         assert mode in ("complete", "partial", "final")
         self.mode = mode
+        # spark.rapids.sql.hasNans=false elides NaN total-order handling
+        self.has_nans = has_nans
 
     def node_string(self):
         keys = ", ".join(str(g) for g in self.grouping)
@@ -363,6 +381,7 @@ class TpuHashAggregateExec(TpuExec):
             cached_kernel, fingerprint)
         grouping, fns = self.grouping, self.fns
         buffer_schema = self._buffer_schema()
+        has_nans = self.has_nans
 
         def build():
             def run(b):
@@ -370,13 +389,14 @@ class TpuHashAggregateExec(TpuExec):
                     b = pre(b)
                 keys = [g.eval_tpu(b) for g in grouping]
                 vals = update_value_cols(fns, b)
-                ok, ov, sel = segment_groupby(keys, b.sel, vals)
+                ok, ov, sel = segment_groupby(keys, b.sel, vals,
+                                              has_nans=has_nans)
                 return DeviceBatch(buffer_schema, tuple(ok + ov), sel,
                                    compacted=True)
             return run
 
         fn = cached_kernel(
-            ("agg_partial", pre_key, fingerprint(grouping),
+            ("agg_partial", pre_key, has_nans, fingerprint(grouping),
              fingerprint(fns)), build)
         return fn(batch)
 
@@ -522,6 +542,7 @@ class TpuHashAggregateExec(TpuExec):
         grouping, fns = self.grouping, self.fns
         nk = len(grouping)
         buffer_schema = self._buffer_schema()
+        has_nans = self.has_nans
 
         def build():
             def run(m):
@@ -529,14 +550,15 @@ class TpuHashAggregateExec(TpuExec):
                 bufs = list(m.columns[nk:])
                 kinds = merge_kinds(fns)
                 ok, ov, sel = segment_groupby(
-                    keys, m.sel, list(zip(bufs, kinds)))
+                    keys, m.sel, list(zip(bufs, kinds)),
+                    has_nans=has_nans)
                 return DeviceBatch(buffer_schema, tuple(ok + ov), sel,
                                    compacted=True)
             return run
 
         fn = cached_kernel(
-            ("agg_merge_buffers", fingerprint(grouping), fingerprint(fns)),
-            build)
+            ("agg_merge_buffers", has_nans, fingerprint(grouping),
+             fingerprint(fns)), build)
         return fn(merged)
 
     def _merge_final(self, merged: DeviceBatch) -> DeviceBatch:
@@ -544,6 +566,7 @@ class TpuHashAggregateExec(TpuExec):
             cached_kernel, fingerprint)
         grouping, fns, schema = self.grouping, self.fns, self.schema
         nk = len(grouping)
+        has_nans = self.has_nans
 
         def build():
             def run(m):
@@ -551,15 +574,16 @@ class TpuHashAggregateExec(TpuExec):
                 bufs = list(m.columns[nk:])
                 kinds = merge_kinds(fns)
                 ok, ov, sel = segment_groupby(
-                    keys, m.sel, list(zip(bufs, kinds)))
+                    keys, m.sel, list(zip(bufs, kinds)),
+                    has_nans=has_nans)
                 results = final_project(fns, ov)
                 return DeviceBatch(schema, tuple(ok + results), sel,
                                    compacted=True)
             return run
 
         fn = cached_kernel(
-            ("agg_merge", fingerprint(grouping), fingerprint(fns),
-             fingerprint(schema)), build)
+            ("agg_merge", has_nans, fingerprint(grouping),
+             fingerprint(fns), fingerprint(schema)), build)
         return fn(merged)
 
     def _reduce_batch(self, batch: DeviceBatch, pre=None, pre_key=(),
@@ -574,6 +598,7 @@ class TpuHashAggregateExec(TpuExec):
             cached_kernel, fingerprint)
         fns = self.fns
         out_schema = self.schema if final else self._buffer_schema()
+        has_nans = self.has_nans
 
         def build():
             def run(b):
@@ -582,7 +607,7 @@ class TpuHashAggregateExec(TpuExec):
                 vals = update_value_cols(fns, b)
                 bufs = [
                     _reduce_column(c.data, c.valid_mask(), b.sel, kind,
-                                   c.dtype)
+                                   c.dtype, has_nans=has_nans)
                     for c, kind in vals]
                 if final:
                     bufs = final_project(fns, bufs)
@@ -590,7 +615,7 @@ class TpuHashAggregateExec(TpuExec):
             return run
 
         fn = cached_kernel(
-            ("agg_reduce", final, pre_key, fingerprint(fns),
+            ("agg_reduce", final, pre_key, has_nans, fingerprint(fns),
              fingerprint(out_schema)), build)
         return fn(batch)
 
@@ -605,6 +630,7 @@ class TpuHashAggregateExec(TpuExec):
                 empty_batch(self.children[0].schema))]
         fns, schema = self.fns, self.schema
         kinds = merge_kinds(fns)
+        has_nans = self.has_nans
 
         def build():
             def run(ps):
@@ -615,14 +641,15 @@ class TpuHashAggregateExec(TpuExec):
                     valid = jnp.concatenate(
                         [p.columns[j].valid_mask() for p in ps])
                     bufs.append(_reduce_column(data, valid, sel, kind,
-                                               ps[0].columns[j].dtype))
+                                               ps[0].columns[j].dtype,
+                                               has_nans=has_nans))
                 results = final_project(fns, bufs)
                 return _one_row_batch(schema, results)
             return run
 
         fn = cached_kernel(
-            ("agg_reduce_merge", len(partials), fingerprint(fns),
-             fingerprint(schema)), build)
+            ("agg_reduce_merge", len(partials), has_nans,
+             fingerprint(fns), fingerprint(schema)), build)
         return fn(partials)
 
 

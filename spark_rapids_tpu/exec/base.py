@@ -14,11 +14,26 @@ reference's operator pipelining, SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Dict, Iterator, Tuple
 
 from spark_rapids_tpu.columnar import dtypes as T
 
+# Metric verbosity levels [REF: GpuMetrics.scala :: MetricsLevel] —
+# ESSENTIAL always collected, MODERATE the default, DEBUG opt-in.
+METRIC_LEVELS = ("ESSENTIAL", "MODERATE", "DEBUG")
+_DEFAULT_METRIC_LEVEL = {
+    "numOutputRows": "ESSENTIAL",
+    "numOutputBatches": "ESSENTIAL",
+    "opTime": "MODERATE",
+    "transferTime": "MODERATE",
+    "partitionTime": "MODERATE",
+    "collectiveTime": "MODERATE",
+    "semaphoreWaitTime": "MODERATE",
+    "concatTime": "DEBUG",
+    "fusedIntoConsumer": "DEBUG",
+}
 
 class Metric:
     """One operator metric (opTime, numOutputRows, ...).
@@ -26,14 +41,19 @@ class Metric:
     [REF: sql-plugin/../GpuMetrics.scala :: GpuMetric]
     """
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "level", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, level: str = None):
         self.name = name
         self.value = 0
+        self.level = level or _DEFAULT_METRIC_LEVEL.get(name, "MODERATE")
+        self._lock = threading.Lock()
 
     def add(self, v):
-        self.value += v
+        # partitions pump on a thread pool; += is not atomic.  Per-metric
+        # lock so unrelated nodes' updates never contend.
+        with self._lock:
+            self.value += v
 
 
 class MetricTimer:
@@ -68,9 +88,12 @@ class ExecNode:
         return type(self).__name__
 
     def metric(self, name: str) -> Metric:
-        if name not in self.metrics:
-            self.metrics[name] = Metric(name)
-        return self.metrics[name]
+        m = self.metrics.get(name)
+        if m is None:
+            # setdefault is atomic: racing pool threads converge on one
+            # Metric instead of orphaning each other's counts
+            m = self.metrics.setdefault(name, Metric(name))
+        return m
 
     def timer(self, name: str = "opTime") -> MetricTimer:
         return MetricTimer(self.metric(name))
@@ -98,11 +121,17 @@ class ExecNode:
     def is_tpu(self) -> bool:
         return isinstance(self, TpuExec)
 
-    def collect_metrics(self, out=None):
+    def collect_metrics(self, out=None, level: str = "DEBUG"):
+        """Per-node metric values, filtered by verbosity level
+        (``spark.rapids.sql.metrics.level``): ESSENTIAL ⊂ MODERATE ⊂
+        DEBUG."""
         out = out if out is not None else []
-        out.append((self.name, {k: m.value for k, m in self.metrics.items()}))
+        rank = METRIC_LEVELS.index(level.upper())
+        out.append((self.name,
+                    {k: m.value for k, m in self.metrics.items()
+                     if METRIC_LEVELS.index(m.level) <= rank}))
         for c in self._children:
-            c.collect_metrics(out)
+            c.collect_metrics(out, level)
         return out
 
 

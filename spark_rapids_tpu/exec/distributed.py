@@ -62,6 +62,8 @@ class TpuIciShuffleExchangeExec(TpuExec):
         self.min_bucket = min_bucket
         self._result: Optional[DeviceBatch] = None
         self._empty = False
+        import threading
+        self._mat_lock = threading.Lock()
 
     @property
     def nparts(self) -> int:
@@ -75,6 +77,10 @@ class TpuIciShuffleExchangeExec(TpuExec):
         return self.nparts
 
     def _materialize(self) -> Optional[DeviceBatch]:
+        with self._mat_lock:
+            return self._materialize_locked()
+
+    def _materialize_locked(self) -> Optional[DeviceBatch]:
         if self._result is not None or self._empty:
             return self._result
         gathered = _gather_child(self.children[0])

@@ -3,16 +3,23 @@
 [REF: sql-plugin/../GpuShuffleExchangeExecBase.scala,
  GpuHashPartitioning.scala] — the reference partitions on device with
 cuDF murmur3 ``hash_partition`` + ``contiguous_split`` and moves blocks
-via the shuffle manager.  Here, within one process, the TPU exchange is
-**zero-copy**: partition ids are computed on device with the bit-exact
-Spark murmur3 (ops/hashing.py) and each output partition is the same
-device batch viewed through a different ``sel`` mask — no data movement
-until a real multi-host transport (parallel/distributed.py rides
-``lax.all_to_all`` for the ICI path).
+via the shuffle manager.  Three transports, picked by
+``spark.rapids.shuffle.mode``:
+
+* CACHE_ONLY — this module's in-process device exchange: partition ids
+  computed on device with the bit-exact Spark murmur3 (ops/hashing.py),
+  each output partition the same device batch viewed through a different
+  ``sel`` mask (zero-copy, single process).
+* MULTITHREADED — host-path serialization through shuffle files
+  (shuffle/exchange.py + the native tudo serializer), the
+  works-everywhere default analog.
+* ICI — the SPMD ``lax.all_to_all`` collective over the device mesh
+  (exec/distributed.py + parallel/shuffle.py).
 """
 
 from __future__ import annotations
 
+import threading
 from typing import Iterator, List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -33,6 +40,7 @@ class CpuShuffleExchangeExec(CpuExec):
         self.nparts = num_partitions
         self.keys = list(keys) if keys else None
         self._materialized: Optional[List[List[H.HostBatch]]] = None
+        self._mat_lock = threading.Lock()
 
     def node_string(self):
         kind = "hash" if self.keys else "roundrobin"
@@ -42,6 +50,10 @@ class CpuShuffleExchangeExec(CpuExec):
         return self.nparts
 
     def _materialize(self):
+        with self._mat_lock:
+            return self._materialize_locked()
+
+    def _materialize_locked(self):
         if self._materialized is not None:
             return self._materialized
         child = self.children[0]
@@ -109,6 +121,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.nparts = num_partitions
         self.keys = list(keys) if keys else None
         self._materialized = None
+        self._mat_lock = threading.Lock()
 
     def node_string(self):
         kind = "hash" if self.keys else "roundrobin"
@@ -145,6 +158,10 @@ class TpuShuffleExchangeExec(TpuExec):
         return (live_prefix + row_base) % self.nparts
 
     def _materialize(self):
+        with self._mat_lock:
+            return self._materialize_locked()
+
+    def _materialize_locked(self):
         if self._materialized is not None:
             return self._materialized
         child = self.children[0]
@@ -174,10 +191,19 @@ def _tag_exchange(meta):
 
 
 def _convert_exchange(cpu, ch, conf):
+    from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.distributed import (
         TpuIciShuffleExchangeExec, ici_active)
     if ici_active(conf) and cpu.keys:
         import jax
         if cpu.nparts == jax.device_count():
             return TpuIciShuffleExchangeExec(ch[0], cpu.keys)
+    if conf.shuffle_mode == "MULTITHREADED":
+        from spark_rapids_tpu.shuffle.exchange import (
+            TpuHostShuffleExchangeExec)
+        return TpuHostShuffleExchangeExec(
+            ch[0], cpu.nparts, cpu.keys,
+            nthreads=conf.get(C.SHUFFLE_THREADS),
+            min_bucket=conf.min_bucket_rows)
+    # CACHE_ONLY: in-process device-resident exchange (sel-mask views)
     return TpuShuffleExchangeExec(ch[0], cpu.nparts, cpu.keys)

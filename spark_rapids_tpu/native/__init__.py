@@ -1,0 +1,53 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+SURVEY §2.2: the reference's serializers/runtime are native; the build
+mandate is "tpu-native equivalents in C++, not Python-only wrappers".
+Libraries compile on demand with the baked-in g++ toolchain and cache as
+shared objects next to the sources (or under $SPARK_RAPIDS_TPU_NATIVE_DIR).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_libs = {}
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build_dir() -> str:
+    d = os.environ.get("SPARK_RAPIDS_TPU_NATIVE_DIR")
+    if not d:
+        d = os.path.join(os.path.expanduser("~"), ".cache",
+                         "spark_rapids_tpu", "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_library(name: str) -> Optional[ctypes.CDLL]:
+    """Compile (once) and dlopen lib<name>.so from <name>.cpp.
+
+    Returns None when no C++ toolchain is available — callers must keep a
+    Python fallback path and flag themselves non-accelerated."""
+    with _lock:
+        if name in _libs:
+            return _libs[name]
+        src = os.path.join(_SRC_DIR, f"{name}.cpp")
+        out = os.path.join(_build_dir(), f"lib{name}.so")
+        try:
+            if (not os.path.exists(out)
+                    or os.path.getmtime(out) < os.path.getmtime(src)):
+                cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+                       "-std=c++17", "-pthread", src, "-o", out + ".tmp"]
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(out + ".tmp", out)
+            lib = ctypes.CDLL(out)
+        except (OSError, subprocess.CalledProcessError):
+            lib = None
+        _libs[name] = lib
+        return lib

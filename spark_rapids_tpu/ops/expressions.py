@@ -183,6 +183,10 @@ class Alias(Expression):
 
 @dataclasses.dataclass
 class _BinaryArith(Expression):
+    # device lowering implements NON-ANSI Spark semantics (overflow
+    # wraps, invalid ops null); under spark.sql.ansi.enabled the planner
+    # keeps these on CPU [REF: GpuOverrides ANSI checks]
+    ansi_sensitive = True
     left: Expression
     right: Expression
 
@@ -244,6 +248,8 @@ class Multiply(_BinaryArith):
 class Divide(Expression):
     """Double (or decimal) division; x/0 -> null (non-ANSI Spark)."""
 
+    ansi_sensitive = True
+
     left: Expression
     right: Expression
 
@@ -276,6 +282,8 @@ class Divide(Expression):
 @dataclasses.dataclass
 class IntegralDivide(Expression):
     """``div``: long division truncating toward zero; x div 0 -> null."""
+
+    ansi_sensitive = True
 
     left: Expression
     right: Expression
@@ -310,6 +318,8 @@ class IntegralDivide(Expression):
 @dataclasses.dataclass
 class Remainder(Expression):
     """``%``: sign follows dividend (java); x % 0 -> null."""
+
+    ansi_sensitive = True
 
     left: Expression
     right: Expression
@@ -351,6 +361,7 @@ class Remainder(Expression):
 
 @dataclasses.dataclass
 class UnaryMinus(Expression):
+    ansi_sensitive = True
     child: Expression
 
     @property
@@ -373,6 +384,7 @@ class UnaryMinus(Expression):
 
 @dataclasses.dataclass
 class Abs(Expression):
+    ansi_sensitive = True
     child: Expression
 
     @property
@@ -978,6 +990,7 @@ _INT_RANGES = {
 
 @dataclasses.dataclass
 class Cast(Expression):
+    ansi_sensitive = True
     child: Expression
     dtype: T.DataType
 

@@ -151,6 +151,11 @@ class Length(Expression):
 class _CaseMap(Expression):
     child: Expression
     UPPER = True
+    # per-op incompat gate [REF: GpuOverrides incompat + RapidsConf
+    # isIncompatEnabled]: honest about the device semantics difference —
+    # requires spark.rapids.sql.incompatibleOps.enabled=true
+    incompat = ("ASCII-only case mapping on device; non-ASCII bytes pass "
+                "through unchanged")
 
     @property
     def dtype(self):

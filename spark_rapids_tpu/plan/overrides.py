@@ -96,11 +96,23 @@ class ExecMeta:
 
 
 def tag_expression(e: Expression, meta: ExecMeta):
+    from spark_rapids_tpu import conf as C
     name = type(e).__name__
     if not meta.conf.is_op_enabled("expression", name):
         meta.will_not_work(
             f"expression {name} disabled by "
             f"spark.rapids.sql.expression.{name}=false")
+    incompat = getattr(type(e), "incompat", None)
+    if incompat and not meta.conf.get(C.INCOMPATIBLE_OPS):
+        meta.will_not_work(
+            f"expression {name} is not fully compatible with Spark "
+            f"({incompat}); set "
+            "spark.rapids.sql.incompatibleOps.enabled=true to enable")
+    if meta.conf.ansi_enabled and getattr(type(e), "ansi_sensitive", False):
+        meta.will_not_work(
+            f"expression {name} under spark.sql.ansi.enabled=true: device "
+            "lowering implements non-ANSI semantics (overflow wraps, "
+            "invalid input nulls) — CPU fallback until ANSI kernels exist")
     r = is_device_supported_type(e.dtype)
     if r:
         meta.will_not_work(f"expression {e}: {r}")
@@ -197,6 +209,10 @@ def _tag_aggregate(meta: ExecMeta):
     cpu: CpuAggregateExec = meta.cpu
     meta.tag_expressions(cpu.grouping)
     for fn in cpu.fns:
+        if isinstance(fn, Sum) and meta.conf.ansi_enabled:
+            meta.will_not_work(
+                "sum under spark.sql.ansi.enabled=true: device sum wraps "
+                "on overflow (non-ANSI) — CPU fallback")
         if not isinstance(fn, (Sum, Min, Max, Count, CountStar, Average,
                                First)):
             meta.will_not_work(
@@ -212,8 +228,10 @@ def _tag_aggregate(meta: ExecMeta):
 
 
 def _convert_aggregate(cpu, ch, conf):
+    from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.exec.distributed import ici_active
+    has_nans = bool(conf.get(C.HAS_NANS))
     if ici_active(conf) and cpu.grouping:
         # distributed: {partial agg → hash exchange on keys → final agg}
         # — one SPMD all_to_all per shuffle stage (SURVEY §5.8)
@@ -221,14 +239,16 @@ def _convert_aggregate(cpu, ch, conf):
             TpuIciShuffleExchangeExec)
         from spark_rapids_tpu.ops.expressions import BoundReference
         partial = TpuHashAggregateExec(cpu.grouping, cpu.fns, None, ch[0],
-                                       mode="partial")
+                                       mode="partial", has_nans=has_nans)
         partial.schema = partial._buffer_schema()
         keys = [BoundReference(i, g.dtype)
                 for i, g in enumerate(cpu.grouping)]
         exchange = TpuIciShuffleExchangeExec(partial, keys)
         return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema,
-                                    exchange, mode="final")
-    return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema, ch[0])
+                                    exchange, mode="final",
+                                    has_nans=has_nans)
+    return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema, ch[0],
+                                has_nans=has_nans)
 
 
 def _register_lazy_rules():
@@ -337,6 +357,60 @@ def convert_meta(meta: ExecMeta) -> ExecNode:
     return _rebuild_cpu(meta.cpu, cpu_children)
 
 
+def _estimated_row_bytes(schema: T.StructType) -> int:
+    """Rough bytes/row for batch-size targeting (strings are padded byte
+    matrices — estimate, exactness doesn't matter for a coalesce goal)."""
+    total = 0
+    for f in schema.fields:
+        if isinstance(f.dtype, (T.StringType, T.BinaryType)):
+            total += 40
+        else:
+            total += 8
+        total += 1  # validity
+    return max(total, 1)
+
+
+def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
+    """The GpuTransitionOverrides coalesce pass [REF:
+    GpuTransitionOverrides.scala + GpuCoalesceBatches.scala]:
+
+    * a TargetSize coalesce above every H2D transition (CPU-fallback
+      sources emit small batches; merge them up to
+      ``spark.rapids.sql.batchSizeBytes`` before device operators), and
+    * a RequireSingleBatch coalesce under whole-partition consumers
+      (sort / join / window), making the batching contract a plan node
+      instead of ad-hoc concatenation inside the operator.
+    """
+    from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
+    from spark_rapids_tpu.exec.distributed import TpuIciShuffleExchangeExec
+    from spark_rapids_tpu.exec.join import TpuSortMergeJoinExec
+    from spark_rapids_tpu.exec.sort import TpuSortExec
+    from spark_rapids_tpu.exec.window import TpuWindowExec
+    from spark_rapids_tpu import conf as C
+
+    node._children = tuple(insert_coalesce(c, conf)
+                           for c in node.children)
+    if isinstance(node, HostToDeviceExec):
+        target = max(conf.get(C.BATCH_SIZE_BYTES)
+                     // _estimated_row_bytes(node.schema),
+                     conf.min_bucket_rows)
+        return TpuCoalesceBatchesExec(node, target_rows=target)
+    if isinstance(node, (TpuSortExec, TpuSortMergeJoinExec, TpuWindowExec)):
+        # RequireSingleBatch is only made plan-visible for single-
+        # partition children: there it replaces the operator's internal
+        # concat 1:1.  Multi-partition children keep the operator's own
+        # cross-partition gather (one concat) — a per-partition coalesce
+        # below it would copy every row twice.
+        node._children = tuple(
+            TpuCoalesceBatchesExec(c, require_single=True)
+            if isinstance(c, TpuExec) and c.num_partitions() == 1
+            and not isinstance(
+                c, (TpuCoalesceBatchesExec, TpuIciShuffleExchangeExec))
+            else c
+            for c in node._children)
+    return node
+
+
 def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     """GpuOverrides.apply + GpuTransitionOverrides in one pass."""
     if not conf.sql_enabled:
@@ -351,6 +425,7 @@ def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     plan = convert_meta(root)
     if isinstance(plan, TpuExec):
         plan = DeviceToHostExec(plan)
+    plan = insert_coalesce(plan, conf)
     result = OverrideResult(plan, metas)
 
     explain = conf.explain

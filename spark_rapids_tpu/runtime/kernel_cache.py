@@ -12,11 +12,15 @@ compilation per arithmetic op.
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Callable, Dict, Tuple
 
 import jax
 
 _CACHE: Dict[tuple, Callable] = {}
+# partitions pump on a thread pool: without a lock, racing threads each
+# build their own jit wrapper for the same key and XLA compiles twice
+_CACHE_LOCK = threading.Lock()
 
 
 def fingerprint(v) -> object:
@@ -36,12 +40,16 @@ def fingerprint(v) -> object:
 
 
 def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
-    """Return the jitted kernel for key, building+jitting it on first use."""
-    fn = _CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(builder())
-        _CACHE[key] = fn
-    return fn
+    """Return the jitted kernel for key, building+jitting it on first use.
+
+    jax.jit itself is lazy (tracing happens at first call), so holding the
+    lock across build+insert is cheap."""
+    with _CACHE_LOCK:
+        fn = _CACHE.get(key)
+        if fn is None:
+            fn = jax.jit(builder())
+            _CACHE[key] = fn
+        return fn
 
 
 def cache_stats() -> Tuple[int,]:

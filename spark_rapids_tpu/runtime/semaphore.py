@@ -1,0 +1,92 @@
+"""Device-admission semaphore — the ``GpuSemaphore`` analog.
+
+[REF: sql-plugin/../GpuSemaphore.scala :: GpuSemaphore] — the reference
+gates how many Spark task threads may hold the GPU concurrently
+(``spark.rapids.sql.concurrentGpuTasks``) so device memory working sets
+don't multiply by the executor's task slots.  Same design here: the
+DataFrame partition pump runs partitions on a thread pool (the task-slot
+analog), and each partition's device work must hold a permit.  Cumulative
+wait time is exposed as the ``semaphoreWaitTime`` metric.
+
+One process-wide semaphore object lives for the process (never swapped —
+swapping under a waiter would let two queries admit through different
+instances and break the cap); a conf with a different permit count
+resizes it in place under its own condition variable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Optional
+
+
+class DeviceSemaphore:
+    """Counting semaphore with in-place resize + wait accounting."""
+
+    def __init__(self, permits: int):
+        self._cv = threading.Condition()
+        self.permits = max(1, int(permits))
+        self.holders = 0          # currently admitted tasks
+        self.max_holders = 0      # high-water mark (test observability)
+        self.wait_time = 0.0      # cumulative seconds spent blocked
+
+    def resize(self, permits: int) -> None:
+        with self._cv:
+            self.permits = max(1, int(permits))
+            self._cv.notify_all()
+
+    def acquire(self) -> float:
+        """Block until admitted; returns seconds spent waiting."""
+        t0 = time.perf_counter()
+        with self._cv:
+            while self.holders >= self.permits:
+                self._cv.wait()
+            self.holders += 1
+            self.max_holders = max(self.max_holders, self.holders)
+            waited = time.perf_counter() - t0
+            self.wait_time += waited
+        return waited
+
+    def release(self) -> None:
+        with self._cv:
+            self.holders -= 1
+            self._cv.notify()
+
+    @contextlib.contextmanager
+    def hold(self, waited_out: Optional[list] = None):
+        w = self.acquire()
+        if waited_out is not None:
+            waited_out.append(w)
+        try:
+            yield self
+        finally:
+            self.release()
+
+
+_semaphore: Optional[DeviceSemaphore] = None
+_sem_lock = threading.Lock()
+
+
+def get_semaphore(conf=None) -> DeviceSemaphore:
+    """The process semaphore, sized by
+    ``spark.rapids.sql.concurrentGpuTasks`` (resized in place when a
+    session asks for a different count)."""
+    global _semaphore
+    permits = None
+    if conf is not None:
+        from spark_rapids_tpu import conf as C
+        permits = conf.get(C.CONCURRENT_TASKS)
+    with _sem_lock:
+        if _semaphore is None:
+            _semaphore = DeviceSemaphore(permits or 2)
+        elif permits is not None and permits != _semaphore.permits:
+            _semaphore.resize(permits)
+        return _semaphore
+
+
+def reset_semaphore() -> None:
+    global _semaphore
+    with _sem_lock:
+        _semaphore = None

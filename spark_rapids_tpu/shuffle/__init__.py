@@ -1,0 +1,15 @@
+"""Shuffle subsystem: wire serialization + host-path transport.
+
+[REF: sql-plugin/../rapids/shuffle/, RapidsShuffleInternalManagerBase]
+— three transports behind ``spark.rapids.shuffle.mode``:
+
+* ``serializer``  — the tudo columnar wire format (kudo analog): native
+  C++ partition-scatter writer, zero-copy numpy reader.
+* ``manager``     — shuffle file layout, writer/reader, ShuffleEnv.
+* ``exchange``    — TpuHostShuffleExchangeExec, the MULTITHREADED-mode
+  physical exec.
+
+The ICI collective transport lives in exec/distributed.py +
+parallel/shuffle.py; the CACHE_ONLY in-process exchange in
+exec/exchange.py.
+"""

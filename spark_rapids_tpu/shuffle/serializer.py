@@ -1,0 +1,223 @@
+"""tudo shuffle serialization — Python binding + zero-copy deserializer.
+
+[REF: spark-rapids-jni :: kudo/KudoSerializer, sql-plugin ::
+ GpuColumnarBatchSerializer.scala :: SerializedTableColumn]
+
+The write side is native C++ (native/tudo.cpp): one pass buckets rows by
+partition id (counting sort), a second threaded pass gather-serializes
+each partition into one contiguous buffer.  The wire layout keeps every
+column section a contiguous dtype run, so the read side is pure numpy
+``frombuffer`` views — no native code and no copies until the H2D pad.
+
+A pure-numpy fallback serializer covers toolchain-less hosts (flagged by
+``native_enabled()``); format-identical, so readers never care.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+_MAGIC = 0x30445554  # "TUD0"
+
+
+class HostColView:
+    """One column of a host-side batch, C-layout, ready to serialize.
+
+    ``data``: fixed width → 1-D array; string → 2-D uint8 matrix.
+    """
+
+    __slots__ = ("dtype", "data", "validity", "lengths")
+
+    def __init__(self, dtype: T.DataType, data: np.ndarray,
+                 validity: Optional[np.ndarray],
+                 lengths: Optional[np.ndarray]):
+        self.dtype = dtype
+        self.data = np.ascontiguousarray(data)
+        self.validity = (None if validity is None
+                         else np.ascontiguousarray(
+                             validity.astype(np.uint8, copy=False)))
+        self.lengths = (None if lengths is None
+                        else np.ascontiguousarray(
+                            lengths.astype(np.int32, copy=False)))
+
+    @property
+    def is_string(self) -> bool:
+        return self.lengths is not None
+
+
+class _ColDesc(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p),
+                ("validity", ctypes.c_void_p),
+                ("lengths", ctypes.c_void_p),
+                ("kind", ctypes.c_int32),
+                ("itemsize", ctypes.c_int32)]
+
+
+_lib = None
+_lib_tried = False
+
+
+def _tudo_lib():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        from spark_rapids_tpu.native import load_library
+        _lib = load_library("tudo")
+        _lib_tried = True
+        if _lib is not None:
+            _lib.tudo_bucket_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+            _lib.tudo_partition_sizes.argtypes = [
+                ctypes.c_int, ctypes.POINTER(_ColDesc), ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p]
+            _lib.tudo_partition_write.argtypes = [
+                ctypes.c_int, ctypes.POINTER(_ColDesc), ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int32]
+    return _lib
+
+
+def native_enabled() -> bool:
+    return _tudo_lib() is not None
+
+
+def _ptr(a: Optional[np.ndarray]):
+    return None if a is None else a.ctypes.data_as(ctypes.c_void_p)
+
+
+def _descs(cols: Sequence[HostColView]):
+    arr = (_ColDesc * len(cols))()
+    keepalive = []
+    for i, c in enumerate(cols):
+        if c.is_string:
+            kind, isz = 1, int(c.data.shape[1]) if c.data.ndim == 2 else 1
+        else:
+            kind, isz = 0, int(c.data.dtype.itemsize)
+        arr[i] = _ColDesc(
+            c.data.ctypes.data, None if c.validity is None
+            else c.validity.ctypes.data,
+            None if c.lengths is None else c.lengths.ctypes.data,
+            kind, isz)
+        keepalive.append(c)
+    return arr, keepalive
+
+
+def serialize_partitions(
+    cols: Sequence[HostColView], pids: np.ndarray,
+    live: Optional[np.ndarray], nparts: int, nthreads: int = 4,
+) -> List[memoryview]:
+    """Bucket rows by pid and serialize each partition: one tudo buffer
+    per partition (dead rows dropped)."""
+    n = int(pids.shape[0])
+    pids = np.ascontiguousarray(pids.astype(np.int32, copy=False))
+    live8 = (None if live is None else
+             np.ascontiguousarray(live.astype(np.uint8, copy=False)))
+    lib = _tudo_lib()
+    if lib is None:
+        return _py_serialize_partitions(cols, pids, live8, nparts)
+    idx = np.empty(n, np.int32)
+    starts = np.empty(nparts + 1, np.int64)
+    lib.tudo_bucket_rows(_ptr(pids), _ptr(live8), n, nparts,
+                         _ptr(idx), _ptr(starts))
+    descs, keep = _descs(cols)
+    sizes = np.empty(nparts, np.int64)
+    lib.tudo_partition_sizes(len(cols), descs, _ptr(idx), _ptr(starts),
+                             nparts, _ptr(sizes))
+    offsets = np.zeros(nparts, np.int64)
+    np.cumsum(sizes[:-1], out=offsets[1:])
+    out = np.empty(int(sizes.sum()), np.uint8)
+    lib.tudo_partition_write(len(cols), descs, _ptr(idx), _ptr(starts),
+                             nparts, _ptr(out), _ptr(offsets),
+                             int(nthreads))
+    mv = memoryview(out)
+    return [mv[int(offsets[p]):int(offsets[p] + sizes[p])]
+            for p in range(nparts)]
+
+
+def _py_serialize_partitions(cols, pids, live8, nparts) -> List[memoryview]:
+    """Format-identical numpy fallback (no C++ toolchain)."""
+    keep = np.ones(pids.shape[0], bool) if live8 is None else live8.astype(
+        bool)
+    out = []
+    for p in range(nparts):
+        idx = np.nonzero(keep & (pids == p))[0].astype(np.int32)
+        out.append(memoryview(_py_serialize_one(cols, idx)))
+    return out
+
+
+def _py_serialize_one(cols, idx: np.ndarray) -> bytes:
+    n = len(idx)
+    parts = [struct.pack("<IIqI", _MAGIC, 1, n, len(cols))]
+    for c in cols:
+        if c.is_string:
+            kind, isz = 1, int(c.data.shape[1]) if c.data.ndim == 2 else 1
+        else:
+            kind, isz = 0, int(c.data.dtype.itemsize)
+        parts.append(struct.pack("<BBH", kind, 1 if c.validity is not None
+                                 else 0, isz))
+    for c in cols:
+        if c.is_string:
+            lens = c.lengths[idx]
+            parts.append(lens.astype(np.int32).tobytes())
+            if n:
+                mat = c.data[idx]
+                ii = np.repeat(np.arange(n), lens)
+                jj = (np.arange(int(lens.sum()))
+                      - np.repeat(np.cumsum(lens) - lens, lens))
+                parts.append(mat[ii, jj].tobytes())
+        else:
+            parts.append(c.data[idx].tobytes())
+        if c.validity is not None:
+            parts.append(c.validity[idx].tobytes())
+    return b"".join(parts)
+
+
+def deserialize(buf, schema: T.StructType
+                ) -> Tuple[int, List[HostColView]]:
+    """Zero-copy numpy views over one tudo buffer → (nrows, columns).
+
+    String sections unpack to a padded byte matrix (vectorized)."""
+    b = np.frombuffer(buf, np.uint8)
+    magic, ver, nrows, ncols = struct.unpack_from("<IIqI", b, 0)
+    assert magic == _MAGIC and ver == 1, "bad tudo buffer"
+    assert ncols == len(schema.fields), (ncols, len(schema.fields))
+    off = 20
+    metas = []
+    for _ in range(ncols):
+        kind, hasv, isz = struct.unpack_from("<BBH", b, off)
+        off += 4
+        metas.append((kind, hasv, isz))
+    cols: List[HostColView] = []
+    for f, (kind, hasv, isz) in zip(schema.fields, metas):
+        if kind == 1:
+            lengths = np.frombuffer(buf, np.int32, nrows, off)
+            off += nrows * 4
+            total = int(lengths.sum())
+            packed = np.frombuffer(buf, np.uint8, total, off)
+            off += total
+            width = max(int(lengths.max()) if nrows else 1, 1)
+            mat = np.zeros((nrows, width), np.uint8)
+            if total:
+                ii = np.repeat(np.arange(nrows), lengths)
+                jj = (np.arange(total)
+                      - np.repeat(np.cumsum(lengths) - lengths, lengths))
+                mat[ii, jj] = packed
+            data, lens = mat, lengths
+        else:
+            npdt = np.dtype(T.to_numpy_dtype(f.dtype))
+            assert npdt.itemsize == isz, (f.name, npdt, isz)
+            data = np.frombuffer(buf, npdt, nrows, off)
+            off += nrows * isz
+            lens = None
+        validity = None
+        if hasv:
+            validity = np.frombuffer(buf, np.uint8, nrows, off)
+            off += nrows
+        cols.append(HostColView(f.dtype, data, validity, lens))
+    return nrows, cols

@@ -309,16 +309,70 @@ class DataFrame:
         return result.plan
 
     def toArrow(self) -> pa.Table:
+        conf = self.session.rapids_conf()
         plan = self._execute_plan()
-        tables = []
-        for p in range(plan.num_partitions()):
-            for batch in plan.execute(p):
-                tables.append(H.to_arrow_table(batch))
+        self._last_plan = plan
+        tables = self._pump_partitions(plan, conf)
         if not tables:
             return pa.table(
                 {f.name: pa.array([], type=T.to_arrow(f.dtype))
                  for f in self.schema.fields})
         return pa.concat_tables(tables)
+
+    @staticmethod
+    def _pump_partitions(plan, conf) -> List[pa.Table]:
+        """Execute every partition; partitions run on a thread pool (the
+        Spark-task-slot analog) and device-touching plans must hold the
+        admission semaphore [REF: GpuSemaphore.scala] — permits =
+        ``spark.rapids.sql.concurrentGpuTasks``."""
+        from spark_rapids_tpu.exec.base import TpuExec
+
+        def has_device_work(node) -> bool:
+            return isinstance(node, TpuExec) or any(
+                has_device_work(c) for c in node.children)
+
+        nparts = plan.num_partitions()
+        on_device = has_device_work(plan)
+
+        def pump(p: int) -> List[pa.Table]:
+            return [H.to_arrow_table(b) for b in plan.execute(p)]
+
+        if not on_device:
+            out = []
+            for p in range(nparts):
+                out.extend(pump(p))
+            return out
+
+        from spark_rapids_tpu.runtime.semaphore import get_semaphore
+        sem = get_semaphore(conf)
+        waits: List[float] = []  # this query's waits only
+
+        def task(p: int) -> List[pa.Table]:
+            with sem.hold(waited_out=waits):
+                return pump(p)
+
+        if nparts <= 1:
+            # single task still holds a permit — a 1-partition query must
+            # count against the concurrency cap like any other
+            chunks = [task(p) for p in range(nparts)]
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+            workers = min(nparts, max(sem.permits * 2, 4))
+            with ThreadPoolExecutor(max_workers=workers) as pool:
+                chunks = list(pool.map(task, range(nparts)))
+        plan.metric("semaphoreWaitTime").add(sum(waits))
+        return [t for chunk in chunks for t in chunk]
+
+    def metrics(self, level: Optional[str] = None):
+        """Operator metrics of the last execution, filtered by
+        ``spark.rapids.sql.metrics.level`` (or an explicit level)."""
+        plan = getattr(self, "_last_plan", None)
+        if plan is None:
+            raise RuntimeError("no execution yet — run collect()/toArrow()")
+        if level is None:
+            from spark_rapids_tpu import conf as C
+            level = self.session.rapids_conf().get(C.METRICS_LEVEL)
+        return plan.collect_metrics(level=str(level))
 
     def collect(self) -> List[Row]:
         tbl = self.toArrow()

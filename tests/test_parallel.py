@@ -11,7 +11,6 @@ import pytest
 import jax
 import jax.numpy as jnp
 
-from spark_rapids_tpu.parallel.distributed import distributed_filter_groupby
 from spark_rapids_tpu.parallel.mesh import all_to_all_shuffle, make_mesh
 
 
@@ -33,33 +32,6 @@ def test_all_to_all_shuffle_roundtrip():
     for dst in range(d):
         for src in range(d):
             assert out[dst, src] == 100 * src + dst
-
-
-@pytest.mark.parametrize("ndev", [2, 4, 8])
-def test_distributed_filter_groupby(ndev):
-    mesh = make_mesh(ndev)
-    n = 64 * ndev
-    rng = np.random.default_rng(3)
-    keys = rng.integers(0, 23, n).astype(np.int64)
-    values = rng.uniform(-50, 100, n)
-    sel = rng.random(n) > 0.1
-
-    gk, gs, gl = (np.asarray(x) for x in distributed_filter_groupby(
-        mesh, keys, values, sel, threshold=0.0))
-
-    mask = sel & (values > 0.0)
-    expect = {}
-    for k, v in zip(keys[mask], values[mask]):
-        expect[int(k)] = expect.get(int(k), 0.0) + float(v)
-    got = {}
-    for dd in range(gk.shape[0]):
-        for k, s, live in zip(gk[dd], gs[dd], gl[dd]):
-            if live:
-                assert int(k) not in got, "same key landed on two devices"
-                got[int(k)] = float(s)
-    assert set(got) == set(expect)
-    for k in expect:
-        assert got[k] == pytest.approx(expect[k], rel=1e-9)
 
 
 # ---------------------------------------------------------------------------
