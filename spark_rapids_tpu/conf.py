@@ -322,6 +322,24 @@ HAS_NANS = (
     .create_with_default(True)
 )
 
+CAST_STRING_TO_FLOAT = (
+    conf("spark.rapids.sql.castStringToFloat.enabled")
+    .doc("Allow device string→float/double casts. Results can differ "
+         "from Java's parseDouble by 1 ulp beyond 15 significant digits "
+         "(same caveat as the reference's flag of this name).")
+    .boolean()
+    .create_with_default(False)
+)
+
+BROADCAST_THRESHOLD = (
+    conf("spark.sql.autoBroadcastJoinThreshold")
+    .doc("Max estimated size of a join side to broadcast it (gathered "
+         "once, reused per stream partition — no exchange). -1 or 0 "
+         "disables broadcast joins. Spark core key, honored here.")
+    .bytes()
+    .create_with_default(10 << 20)
+)
+
 ANSI_ENABLED = (
     conf("spark.sql.ansi.enabled")
     .doc("ANSI mode: arithmetic overflow and invalid casts raise instead "

@@ -54,6 +54,9 @@ class CpuScanExec(CpuExec):
     def num_partitions(self) -> int:
         return self._num_partitions
 
+    def estimated_size_bytes(self):
+        return self.table.nbytes
+
     def execute(self, partition: int) -> Iterator[H.HostBatch]:
         part = _slice_table(self.table, self._num_partitions)[partition]
         for lo in range(0, max(part.num_rows, 1), self.batch_rows):
@@ -497,21 +500,45 @@ class TpuCoalesceBatchesExec(TpuExec):
 
 
 def concat_device_batches(schema: T.StructType,
-                          batches: List[DeviceBatch]) -> DeviceBatch:
-    """Concatenate compacted device batches into one bucketed batch."""
-    if len(batches) == 1:
+                          batches: List[DeviceBatch],
+                          counts: Optional[List[int]] = None,
+                          bucket: Optional[int] = None,
+                          min_width: int = 0,
+                          force_validity: Optional[Sequence[bool]] = None
+                          ) -> DeviceBatch:
+    """Concatenate compacted device batches into one bucketed batch.
+
+    ``counts`` (live rows per batch) may be passed by callers that track
+    them host-side — skips one device sync per input batch.  ``bucket``
+    forces the output capacity (≥ total rows); ``min_width`` forces a
+    minimum string-matrix width and ``force_validity`` a per-column
+    validity presence (shard-uniformity: every shard of one global
+    sharded array must carry identical leaf structure).
+    """
+    if (len(batches) == 1 and bucket is None and min_width == 0
+            and force_validity is None):
         return batches[0]
-    counts = [int(jnp.sum(b.sel.astype(jnp.int32))) for b in batches]
+    if counts is None:
+        counts = [int(jnp.sum(b.sel.astype(jnp.int32))) for b in batches]
     total = sum(counts)
-    bucket = round_up_pow2(max(total, 1))
+    if bucket is None:
+        bucket = round_up_pow2(max(total, 1))
+    assert bucket >= total, (bucket, total)
     cols = []
     for ci, f in enumerate(schema.fields):
         parts_data = []
         parts_val = []
         parts_len = []
-        any_val = any(b.columns[ci].validity is not None for b in batches)
+        any_val = (force_validity[ci] if force_validity is not None
+                   else any(b.columns[ci].validity is not None
+                            for b in batches))
         is_str = batches[0].columns[ci].is_string
-        width = max(b.columns[ci].data.shape[1] for b in batches) if is_str else 0
+        # min_width may be per-column (sequence) — a global min would pad
+        # every string column to the schema's widest one
+        mw = (min_width[ci] if isinstance(min_width, (list, tuple))
+              else min_width)
+        width = max(max(b.columns[ci].data.shape[1] for b in batches),
+                    mw) if is_str else 0
         for b, n in zip(batches, counts):
             c = b.columns[ci]
             if is_str:

@@ -78,15 +78,17 @@ class CpuJoinExec(CpuExec):
     def __init__(self, join_type: str, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
                  condition: Optional[Expression], schema: T.StructType,
-                 left: CpuExec, right: CpuExec):
+                 left: CpuExec, right: CpuExec, using: bool = True):
         super().__init__(schema, left, right)
         self.join_type = join_type
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
         self.condition = condition
+        self.using = using
 
     def node_string(self):
-        return f"Join [{self.join_type}]"
+        cond = f" cond={self.condition}" if self.condition else ""
+        return f"Join [{self.join_type}{cond}]"
 
     def num_partitions(self) -> int:
         return 1
@@ -113,39 +115,77 @@ class CpuJoinExec(CpuExec):
                 out.append(v)
             return tuple(out)
 
-        lk = [e.eval_cpu(lb) for e in self.left_keys]
-        rk = [e.eval_cpu(rb) for e in self.right_keys]
-
-        pairs: List[Tuple[int, int]] = []  # (-1 = null side)
-        if jt == "cross":
-            pairs = [(i, j) for i in range(nl) for j in range(nr)]
+        # 1. candidate pairs from equi keys (or the full cross space)
+        if jt == "cross" or not self.left_keys:
+            cl = np.repeat(np.arange(nl, dtype=np.int64), nr)
+            cr = np.tile(np.arange(nr, dtype=np.int64), nl)
         else:
+            lk = [e.eval_cpu(lb) for e in self.left_keys]
+            rk = [e.eval_cpu(rb) for e in self.right_keys]
             index = {}
             for j in range(nr):
                 k = key_tuple(rk, j)
                 if k is not None:
                     index.setdefault(k, []).append(j)
-            matched_r = np.zeros(nr, dtype=bool)
+            cl_list, cr_list = [], []
             for i in range(nl):
                 k = key_tuple(lk, i)
-                matches = index.get(k, []) if k is not None else []
-                if jt == "left_semi":
-                    if matches:
-                        pairs.append((i, -1))
-                elif jt == "left_anti":
-                    if not matches:
-                        pairs.append((i, -1))
-                elif matches:
-                    for j in matches:
-                        matched_r[j] = True
-                        pairs.append((i, j))
-                elif jt in ("left", "full"):
-                    pairs.append((i, -1))
+                for j in (index.get(k, []) if k is not None else []):
+                    cl_list.append(i)
+                    cr_list.append(j)
+            cl = np.array(cl_list, dtype=np.int64)
+            cr = np.array(cr_list, dtype=np.int64)
+
+        # 2. residual condition filters candidates (null → drop), eval'd
+        #    vectorized over the candidate pair batch in the
+        #    left++right layout its refs were bound against
+        if self.condition is not None and len(cl):
+            pair_fields = tuple(self.children[0].schema.fields) + tuple(
+                self.children[1].schema.fields)
+            pair_cols = []
+            for c in lb.columns:
+                pair_cols.append(H.HostCol(
+                    c.dtype, c.data[cl],
+                    None if c.validity is None else c.validity[cl]))
+            for c in rb.columns:
+                pair_cols.append(H.HostCol(
+                    c.dtype, c.data[cr],
+                    None if c.validity is None else c.validity[cr]))
+            pb = H.HostBatch(T.StructType(pair_fields), pair_cols)
+            cv = self.condition.eval_cpu(pb)
+            keep = cv.data.astype(bool)
+            if cv.validity is not None:
+                keep &= cv.validity
+            cl, cr = cl[keep], cr[keep]
+
+        # 3. join-type semantics over surviving pairs
+        pairs: List[Tuple[int, int]] = []
+        matched_l = np.zeros(nl, dtype=bool)
+        matched_r = np.zeros(nr, dtype=bool)
+        matched_l[cl] = True
+        matched_r[cr] = True
+        if jt == "left_semi":
+            pairs = [(i, -1) for i in range(nl) if matched_l[i]]
+        elif jt == "left_anti":
+            pairs = [(i, -1) for i in range(nl) if not matched_l[i]]
+        else:
+            pairs = list(zip(cl.tolist(), cr.tolist()))
+            if jt in ("left", "full"):
+                # preserve left-row grouping order like the loop did
+                extra = [(i, -1) for i in range(nl) if not matched_l[i]]
+                merged: List[Tuple[int, int]] = []
+                gi = 0
+                ei = 0
+                for i in range(nl):
+                    while gi < len(pairs) and pairs[gi][0] == i:
+                        merged.append(pairs[gi])
+                        gi += 1
+                    if not matched_l[i]:
+                        merged.append((i, -1))
+                pairs = merged + pairs[gi:]
             if jt == "right":
-                # right-preserving: keep matched pairs + unmatched right
-                keep = [(i, j) for (i, j) in pairs if j >= 0]
-                keep += [(-1, j) for j in range(nr) if not matched_r[j]]
-                pairs = keep
+                pairs = [(i, j) for (i, j) in pairs if j >= 0]
+                pairs += [(-1, j) for j in range(nr) if not matched_r[j]]
             elif jt == "full":
                 pairs += [(-1, j) for j in range(nr) if not matched_r[j]]
 
@@ -157,7 +197,7 @@ class CpuJoinExec(CpuExec):
         lkey_idx = [e.index for e in self.left_keys]
         rkey_idx = [e.index for e in self.right_keys]
         semi = self.join_type in ("left_semi", "left_anti")
-        cross = self.join_type == "cross"
+        cross = self.join_type == "cross" or not self.using
         cols: List[H.HostCol] = []
         out_i = 0
 
@@ -341,15 +381,47 @@ def _gather_col(c: DeviceColumn, idx: jnp.ndarray,
     return DeviceColumn(c.dtype, g.data, base & valid_out, g.lengths)
 
 
+class TpuBroadcastExchangeExec(TpuExec):
+    """Gather the (small) child once; every stream partition reuses it.
+
+    [REF: GpuBroadcastExchangeExec — host-serialized broadcast there;
+    here the table is a single-process engine so the broadcast is the
+    cached device batch itself]"""
+
+    def __init__(self, child: TpuExec):
+        super().__init__(child.schema, child)
+        import threading
+        self._lock = threading.Lock()
+        self._cached: Optional[DeviceBatch] = None
+
+    def node_string(self):
+        return "TpuBroadcastExchange"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        with self._lock:
+            if self._cached is None:
+                with self.timer("broadcastTime"):
+                    self._cached = _gather_all(
+                        self.children[0], self.schema, True)
+                self.metric("numOutputBatches").add(1)
+        yield self._cached
+
+
 class TpuSortMergeJoinExec(TpuExec):
     """[REF: GpuShuffledHashJoinExec — same plan position, sort-merge
-    algorithm per SURVEY §7]"""
+    algorithm per SURVEY §7; GpuBroadcastHashJoinExec when ``broadcast``
+    is set; residual conditions = join-gather + fused mask (SURVEY N7 —
+    no AST interpreter needed, XLA fuses the expression)]"""
 
     def __init__(self, join_type: str, left_keys: Sequence[Expression],
                  right_keys: Sequence[Expression],
                  condition: Optional[Expression], schema: T.StructType,
                  left: TpuExec, right: TpuExec,
-                 partitioned: bool = False):
+                 partitioned: bool = False, using: bool = True,
+                 broadcast: Optional[str] = None):
         super().__init__(schema, left, right)
         self.join_type = join_type
         self.left_keys = list(left_keys)
@@ -358,12 +430,22 @@ class TpuSortMergeJoinExec(TpuExec):
         # co-partitioned inputs (both sides exchanged on the same key
         # hash): join partition-by-partition like Spark reduce tasks
         self.partitioned = partitioned
+        self.using = using
+        # "right"/"left": that side is a TpuBroadcastExchangeExec; the
+        # OTHER side streams partition-by-partition
+        self.broadcast = broadcast
 
     def node_string(self):
         part = " partitioned" if self.partitioned else ""
-        return f"TpuSortMergeJoin [{self.join_type}{part}]"
+        bc = f" broadcast={self.broadcast}" if self.broadcast else ""
+        cond = f" cond={self.condition}" if self.condition else ""
+        return f"TpuSortMergeJoin [{self.join_type}{part}{bc}{cond}]"
 
     def num_partitions(self) -> int:
+        if self.broadcast == "right":
+            return self.children[0].num_partitions()
+        if self.broadcast == "left":
+            return self.children[1].num_partitions()
         if self.partitioned:
             return self.children[0].num_partitions()
         return 1
@@ -373,16 +455,46 @@ class TpuSortMergeJoinExec(TpuExec):
         if jt == "right":
             yield from self._execute_swapped(partition)
             return
-        part = partition if self.partitioned else None
+        if self.broadcast == "right":
+            lpart, rpart = partition, None
+        elif self.broadcast == "left":
+            lpart, rpart = None, partition
+        elif self.partitioned:
+            lpart = rpart = partition
+        else:
+            lpart = rpart = None
         lb = _gather_all(self.children[0], self.children[0].schema, True,
-                         part)
+                         lpart)
         rb = _gather_all(self.children[1], self.children[1].schema, True,
-                         part)
+                         rpart)
         with self.timer():
-            if jt == "cross":
-                yield self._cross(lb, rb)
+            if jt == "cross" or (jt == "inner" and not self.left_keys):
+                yield self._apply_condition(self._cross(lb, rb))
                 return
             yield from self._merge_join(lb, rb, jt)
+
+    def _apply_condition(self, batch: DeviceBatch) -> DeviceBatch:
+        """Residual condition as a fused mask over the join output (its
+        refs were bound against the left++right layout = self.schema)."""
+        if self.condition is None:
+            return batch
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        cond = self.condition
+
+        def build():
+            def run(b):
+                c = cond.eval_tpu(b)
+                keep = c.data.astype(jnp.bool_)
+                if c.validity is not None:
+                    keep = keep & c.validity
+                return b.with_sel(b.sel & keep)
+            return run
+
+        fn = cached_kernel(
+            ("join_residual", fingerprint(cond),
+             fingerprint(batch.schema)), build)
+        return fn(batch)
 
     # -- core ---------------------------------------------------------------
     def _match_ranges(self, lb, rb):
@@ -477,33 +589,45 @@ class TpuSortMergeJoinExec(TpuExec):
             out_live = out_live | in_extra
             total += n_extra
 
-        yield self._materialize(lb, rb, l_idx, r_idx, l_valid, r_valid,
+        out = self._materialize(lb, rb, l_idx, r_idx, l_valid, r_valid,
                                 out_live, jt)
+        if jt == "inner":
+            out = self._apply_condition(out)
+        yield out
 
     def _execute_swapped(self, partition: int = 0):
         """right outer = left outer with sides swapped, columns remapped."""
         inner = TpuSortMergeJoinExec(
             "left", self.right_keys, self.left_keys, self.condition,
             self._swapped_schema(), self.children[1], self.children[0],
-            self.partitioned)
-        nk = len(self.left_keys)
-        lkey = [e.index for e in self.left_keys]
-        rkey = [e.index for e in self.right_keys]
-        l_rest = [i for i in range(len(self.children[0].schema))
-                  if i not in lkey]
-        r_rest = [i for i in range(len(self.children[1].schema))
-                  if i not in rkey]
-        # swapped output: [keys, right_rest, left_rest] → want
-        # [keys, left_rest, right_rest]
-        n_r, n_l = len(r_rest), len(l_rest)
-        order = (list(range(nk))
-                 + [nk + n_r + i for i in range(n_l)]
-                 + [nk + i for i in range(n_r)])
+            self.partitioned, using=self.using)
+        n_lc = len(self.children[0].schema)
+        n_rc = len(self.children[1].schema)
+        if not self.using:
+            # swapped output: all_right ++ all_left → want left ++ right
+            order = ([n_rc + i for i in range(n_lc)]
+                     + [i for i in range(n_rc)])
+        else:
+            nk = len(self.left_keys)
+            lkey = [e.index for e in self.left_keys]
+            rkey = [e.index for e in self.right_keys]
+            l_rest = [i for i in range(n_lc) if i not in lkey]
+            r_rest = [i for i in range(n_rc) if i not in rkey]
+            # swapped output: [keys, right_rest, left_rest] → want
+            # [keys, left_rest, right_rest]
+            n_r, n_l = len(r_rest), len(l_rest)
+            order = (list(range(nk))
+                     + [nk + n_r + i for i in range(n_l)]
+                     + [nk + i for i in range(n_r)])
         for b in inner.execute(partition):
             cols = tuple(b.columns[i] for i in order)
             yield DeviceBatch(self.schema, cols, b.sel)
 
     def _swapped_schema(self) -> T.StructType:
+        if not self.using:
+            return T.StructType(
+                tuple(self.children[1].schema.fields)
+                + tuple(self.children[0].schema.fields))
         nk = len(self.left_keys)
         rkey = [e.index for e in self.right_keys]
         lkey = [e.index for e in self.left_keys]
@@ -527,7 +651,10 @@ class TpuSortMergeJoinExec(TpuExec):
                                  out_live, "cross")
 
     def _project_semi(self, lb: DeviceBatch) -> DeviceBatch:
-        """semi/anti output: [keys, left-rest] column order."""
+        """semi/anti output: [keys, left-rest] for USING joins,
+        original left order for expression joins."""
+        if not self.using:
+            return DeviceBatch(self.schema, lb.columns, lb.sel)
         lkey = [e.index for e in self.left_keys]
         order = lkey + [i for i in range(len(lb.columns)) if i not in lkey]
         cols = tuple(lb.columns[i] for i in order)
@@ -538,7 +665,7 @@ class TpuSortMergeJoinExec(TpuExec):
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
         fn = cached_kernel(
-            ("join_mat", jt, fingerprint(self.left_keys),
+            ("join_mat", jt, self.using, fingerprint(self.left_keys),
              fingerprint(self.right_keys), fingerprint(self.schema),
              fingerprint(lb.schema), fingerprint(rb.schema)),
             lambda: (lambda *a: self._materialize_impl(*a, jt)))
@@ -548,7 +675,9 @@ class TpuSortMergeJoinExec(TpuExec):
                           out_live, jt) -> DeviceBatch:
         lkey = [e.index for e in self.left_keys]
         rkey = [e.index for e in self.right_keys]
-        cross = jt == "cross"
+        # expression joins emit ALL left ++ ALL right columns (no key
+        # coalescing) — same layout the residual condition binds to
+        cross = jt == "cross" or not self.using
         cols: List[DeviceColumn] = []
         if not cross:
             for ki in range(len(lkey)):
@@ -576,9 +705,15 @@ class TpuSortMergeJoinExec(TpuExec):
 
 
 def _tag_join(meta):
+    from spark_rapids_tpu.plan.overrides import tag_expression as _tag_e
     cpu = meta.cpu
     if cpu.condition is not None:
-        meta.will_not_work("join residual conditions not yet on device")
+        if cpu.join_type not in ("inner", "cross"):
+            meta.will_not_work(
+                f"residual join conditions on {cpu.join_type} joins not "
+                "yet on device (inner/cross only)")
+        else:
+            _tag_e(cpu.condition, meta)
     for le, re in zip(cpu.left_keys, cpu.right_keys):
         lf, rf = _join_key_family(le.dtype), _join_key_family(re.dtype)
         if lf != rf:
@@ -600,8 +735,29 @@ def _tag_join(meta):
 
 
 def _convert_join(cpu, ch, conf):
+    from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.distributed import ici_active
-    if (ici_active(conf) and cpu.join_type != "cross" and cpu.left_keys):
+    jt = cpu.join_type
+    # broadcast the small side when stats say it fits [REF:
+    # GpuBroadcastHashJoinExec; Spark's JoinSelection] — no exchange on
+    # either side, build side gathered once and reused per partition
+    thresh = conf.get(C.BROADCAST_THRESHOLD)
+    if thresh and thresh > 0:
+        rsize = cpu.children[1].estimated_size_bytes()
+        lsize = cpu.children[0].estimated_size_bytes()
+        if (rsize is not None and rsize <= thresh
+                and jt in ("inner", "left", "left_semi", "left_anti",
+                           "cross")):
+            return TpuSortMergeJoinExec(
+                jt, cpu.left_keys, cpu.right_keys, cpu.condition,
+                cpu.schema, ch[0], TpuBroadcastExchangeExec(ch[1]),
+                using=cpu.using, broadcast="right")
+        if lsize is not None and lsize <= thresh and jt == "inner":
+            return TpuSortMergeJoinExec(
+                jt, cpu.left_keys, cpu.right_keys, cpu.condition,
+                cpu.schema, TpuBroadcastExchangeExec(ch[0]), ch[1],
+                using=cpu.using, broadcast="left")
+    if (ici_active(conf) and jt != "cross" and cpu.left_keys):
         # distributed: co-partition both sides through the ICI exchange
         # on the key hash, then join partition-by-partition (the
         # shuffled-hash-join plan shape [REF: GpuShuffledHashJoinExec])
@@ -614,13 +770,13 @@ def _convert_join(cpu, ch, conf):
             and isinstance(le.dtype, _INT_FAMILY)
             for le, re in zip(cpu.left_keys, cpu.right_keys))
         lex = TpuIciShuffleExchangeExec(ch[0], cpu.left_keys,
-                                        canon_int64=canon)
+                                       canon_int64=canon)
         rex = TpuIciShuffleExchangeExec(ch[1], cpu.right_keys,
-                                        canon_int64=canon)
+                                       canon_int64=canon)
         return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
                                     cpu.right_keys, cpu.condition,
                                     cpu.schema, lex, rex,
-                                    partitioned=True)
+                                    partitioned=True, using=cpu.using)
     return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
                                 cpu.right_keys, cpu.condition, cpu.schema,
-                                ch[0], ch[1])
+                                ch[0], ch[1], using=cpu.using)

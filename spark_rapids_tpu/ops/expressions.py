@@ -1016,11 +1016,48 @@ class Cast(Expression):
             return data.astype(npdt)  # java narrowing wraps
         return data.astype(npdt)
 
+    def device_support_reason(self, conf):
+        """Per-combination device support (tagging hook).  None = ok."""
+        from spark_rapids_tpu import conf as C
+        src, dst = self.child.dtype, self.dtype
+        src_s = isinstance(src, T.StringType)
+        dst_s = isinstance(dst, T.StringType)
+        if not (src_s or dst_s):
+            return None
+        if src_s and (T.is_integral(dst) or isinstance(dst, T.BooleanType)):
+            return None
+        if src_s and isinstance(dst, (T.FloatType, T.DoubleType)):
+            if conf.get(C.CAST_STRING_TO_FLOAT):
+                return None
+            return ("cast string→floating can differ from Java by 1 ulp "
+                    "beyond 15 significant digits; set spark.rapids.sql."
+                    "castStringToFloat.enabled=true to run on device")
+        if dst_s and (T.is_integral(src) or isinstance(src, T.BooleanType)):
+            return None
+        if dst_s and isinstance(src, (T.FloatType, T.DoubleType)):
+            return ("cast floating→string not on device (Java "
+                    "shortest-round-trip formatting)")
+        return (f"cast {src.simple_name}→{dst.simple_name} not yet on "
+                "device")
+
     def eval_tpu(self, batch):
+        from spark_rapids_tpu.ops import strings as S
         c = self.child.eval_tpu(batch)
-        if isinstance(self.dtype, (T.StringType,)) or isinstance(
-                self.child.dtype, (T.StringType,)):
-            raise NotImplementedError("string casts on TPU (strings.py)")
+        src, dst = self.child.dtype, self.dtype
+        if isinstance(dst, T.StringType):
+            if isinstance(src, T.BooleanType):
+                return S.cast_bool_to_string_device(c)
+            if T.is_integral(src):
+                return S.cast_int_to_string_device(c)
+            raise NotImplementedError(f"cast {src}→string on device")
+        if isinstance(src, T.StringType):
+            if isinstance(dst, T.BooleanType):
+                return S.cast_string_to_bool_device(c)
+            if T.is_integral(dst):
+                return S.cast_string_to_int_device(c, dst)
+            if isinstance(dst, (T.FloatType, T.DoubleType)):
+                return S.cast_string_to_float_device(c, dst)
+            raise NotImplementedError(f"cast string→{dst} on device")
         return DeviceColumn(self.dtype, self._cast(c.data, jnp), c.validity)
 
     def eval_cpu(self, batch):
@@ -1045,19 +1082,50 @@ class Cast(Expression):
                 else:
                     out[i] = str(v)
             return HostCol(dst, out, c.validity)
-        # string -> numeric: invalid -> null (non-ANSI)
+        # string -> numeric: invalid -> null (non-ANSI).  Integral casts
+        # accept decimal strings truncated toward zero ('3.7' -> 3) and
+        # null out-of-range values, matching Spark (and the device
+        # kernels in ops/strings.py).
+        import re as _re
         data = np.zeros(n, T.to_numpy_dtype(dst))
         validity = c.valid_mask().copy()
+        int_pat = _re.compile(r"^([+-]?)(\d*)(?:\.(\d*))?$")
+        lo_hi = _INT_RANGES.get(type(dst))
+        if isinstance(dst, T.BooleanType):
+            for i in range(n):
+                if not validity[i]:
+                    continue
+                s = str(c.data[i]).strip().lower()
+                if s in ("true", "t", "yes", "y", "1"):
+                    data[i] = True
+                elif s in ("false", "f", "no", "n", "0"):
+                    data[i] = False
+                else:
+                    validity[i] = False
+            return HostCol(dst, data, validity)
         for i in range(n):
             if not validity[i]:
                 continue
             s = str(c.data[i]).strip()
             try:
                 if T.is_integral(dst):
-                    data[i] = int(s)
+                    m = int_pat.match(s)
+                    if (not m or not (m.group(2) or m.group(3))):
+                        validity[i] = False
+                        continue
+                    v = int(m.group(2) or "0")
+                    if m.group(1) == "-":
+                        v = -v
+                    if not (lo_hi[0] <= v <= lo_hi[1]):
+                        validity[i] = False
+                        continue
+                    data[i] = v
                 else:
+                    if "_" in s:  # Python float() accepts these; Java no
+                        validity[i] = False
+                        continue
                     data[i] = float(s)
-            except ValueError:
+            except (ValueError, OverflowError):
                 validity[i] = False
         return HostCol(dst, data, validity)
 

@@ -320,6 +320,351 @@ def string_predicate(op, l, r) -> Expression:
 
 
 @dataclasses.dataclass
+class Trim(Expression):
+    """trim/ltrim/rtrim — space (0x20) removal, Spark defaults.
+
+    [REF: stringFunctions.scala :: GpuStringTrim/TrimLeft/TrimRight]
+    Device: shift-gather like substring, start/length from leading and
+    trailing space counts — no data-dependent shapes."""
+
+    child: Expression
+    side: str = "both"  # both | leading | trailing
+
+    @property
+    def name(self):
+        return {"both": "StringTrim", "leading": "StringTrimLeft",
+                "trailing": "StringTrimRight"}[self.side]
+
+    @property
+    def dtype(self):
+        return T.StringT
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        b, w = c.data.shape
+        pos = jnp.arange(w)[None, :]
+        in_str = pos < c.lengths[:, None]
+        is_sp = (c.data == 0x20) & in_str
+        nonsp = in_str & ~is_sp
+        any_nonsp = nonsp.any(axis=1)
+        # all-spaces rows: first = length, last = 0 → empty result
+        first = jnp.where(any_nonsp, jnp.argmax(nonsp, axis=1),
+                          c.lengths).astype(jnp.int32)
+        last = jnp.where(
+            any_nonsp,
+            w - jnp.argmax(jnp.flip(nonsp, axis=1), axis=1), 0
+        ).astype(jnp.int32)
+        if self.side == "both":
+            start, end = first, last
+        elif self.side == "leading":
+            start, end = first, c.lengths
+        else:
+            start, end = jnp.zeros_like(first), last
+        out_len = jnp.maximum(end - start, 0).astype(jnp.int32)
+        idx = start[:, None] + jnp.arange(w)[None, :]
+        g = jnp.take_along_axis(c.data, jnp.clip(idx, 0, w - 1), axis=1)
+        mask = jnp.arange(w)[None, :] < out_len[:, None]
+        return DeviceColumn(T.StringT,
+                            jnp.where(mask, g, 0).astype(jnp.uint8),
+                            c.validity, out_len)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        f = {"both": lambda s: s.strip(" "),
+             "leading": lambda s: s.lstrip(" "),
+             "trailing": lambda s: s.rstrip(" ")}[self.side]
+        data = np.array([f(s) for s in c.data], object)
+        return HostCol(T.StringT, data, c.validity)
+
+
+@dataclasses.dataclass
+class StringLocate(Expression):
+    """locate(substr, str, pos) / instr — 1-based first occurrence, 0 if
+    absent, null pattern/input → null.  [REF: GpuStringLocate]"""
+
+    substr: Expression  # literal on the device path
+    child: Expression
+    start: int = 1
+    dtype: T.DataType = dataclasses.field(default_factory=T.IntegerType)
+
+    @property
+    def children(self):
+        return (self.substr, self.child)
+
+    def _pattern(self) -> bytes:
+        from spark_rapids_tpu.ops.expressions import Literal
+        if not isinstance(self.substr, Literal):
+            raise NotImplementedError("locate on TPU needs literal substr")
+        return str(self.substr.value).encode()
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        pat = self._pattern()
+        p = len(pat)
+        b, w = c.data.shape
+        validity = merge_validity_d(
+            c.validity, self.substr.eval_tpu(batch).validity)
+        if self.start < 1:
+            # Spark: pos < 1 → 0 (no match semantics)
+            return DeviceColumn(self.dtype, jnp.zeros((b,), jnp.int32),
+                                validity)
+        if p == 0:
+            # Spark: empty needle → pos (when pos <= len+1), else 0
+            data = jnp.where(jnp.int32(self.start) <= c.lengths + 1,
+                             jnp.int32(self.start), 0)
+            return DeviceColumn(self.dtype, data.astype(jnp.int32),
+                                validity)
+        pv = jnp.asarray(np.frombuffer(pat, np.uint8))
+        hits = jnp.zeros((b, max(w - p + 1, 1)), jnp.bool_)
+        if p <= w:
+            cols = []
+            for s in range(w - p + 1):
+                m = (c.data[:, s:s + p] == pv[None, :]).all(axis=1)
+                cols.append(m & (c.lengths >= s + p)
+                            & (s >= self.start - 1))
+            hits = jnp.stack(cols, axis=1)
+        found = hits.any(axis=1)
+        first = jnp.argmax(hits, axis=1)
+        data = jnp.where(found, first + 1, 0).astype(jnp.int32)
+        return DeviceColumn(self.dtype, data, validity)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        s_ = self.substr.eval_cpu(batch)
+        out = np.zeros(len(c.data), np.int32)
+        for i in range(len(c.data)):
+            if self.start < 1:
+                out[i] = 0
+                continue
+            out[i] = str(c.data[i]).find(str(s_.data[i]),
+                                         self.start - 1) + 1
+        return HostCol(self.dtype, out,
+                       merge_validity_h(c.validity, s_.validity))
+
+
+def _parse_like(pattern: str, escape: str = "\\"):
+    """LIKE pattern → list of segments; a segment is a list of
+    (byte | None) where None = '_' (any byte).  Segments are the literal
+    runs between '%'s."""
+    segs: List[List] = [[]]
+    i = 0
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == escape and i + 1 < len(pattern):
+            for by in pattern[i + 1].encode():
+                segs[-1].append(by)
+            i += 2
+            continue
+        if ch == "%":
+            segs.append([])
+        elif ch == "_":
+            segs[-1].append(None)
+        else:
+            for by in ch.encode():
+                segs[-1].append(by)
+        i += 1
+    return segs
+
+
+@dataclasses.dataclass
+class Like(Expression):
+    """SQL LIKE with literal pattern ('%', '_', backslash escape).
+
+    [REF: GpuLike] — device matching is greedy leftmost per '%'-separated
+    segment: anchored head, searched middles, end-anchored tail; each
+    segment's match-at-shift matrix is one vectorized compare.  Byte-wise
+    ('_' matches one BYTE, exact for ASCII; the reference's cuDF like is
+    byte-wise too)."""
+
+    child: Expression
+    pattern: str
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def _seg_match(self, c: DeviceColumn, seg) -> jnp.ndarray:
+        """[B, w+1] — segment matches starting at shift s (s ≤ len-p)."""
+        b, w = c.data.shape
+        p = len(seg)
+        if p == 0:
+            return jnp.arange(w + 1)[None, :] <= c.lengths[:, None]
+        if p > w:
+            return jnp.zeros((b, w + 1), jnp.bool_)
+        fixed = np.array([by if by is not None else 0 for by in seg],
+                         np.uint8)
+        wild = np.array([by is None for by in seg])
+        pv = jnp.asarray(fixed)
+        wv = jnp.asarray(wild)
+        cols = []
+        for s in range(w + 1):
+            if s + p <= w:
+                m = ((c.data[:, s:s + p] == pv[None, :]) | wv[None, :]
+                     ).all(axis=1) & (c.lengths >= s + p)
+            else:
+                m = jnp.zeros((b,), jnp.bool_)
+            cols.append(m)
+        return jnp.stack(cols, axis=1)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        b, w = c.data.shape
+        segs = _parse_like(self.pattern)
+        shifts = jnp.arange(w + 1)[None, :]
+        if len(segs) == 1:
+            seg = segs[0]
+            m = self._seg_match(c, seg)
+            data = m[:, 0] & (c.lengths == len(seg))
+            return DeviceColumn(self.dtype, data, c.validity)
+        head, mids, tail = segs[0], segs[1:-1], segs[-1]
+        ok = jnp.ones((b,), jnp.bool_)
+        pos = jnp.zeros((b,), jnp.int32)
+        if head:
+            m = self._seg_match(c, head)
+            ok = ok & m[:, 0]
+            pos = jnp.full((b,), len(head), jnp.int32)
+        for seg in mids:
+            if not seg:
+                continue
+            m = self._seg_match(c, seg) & (shifts >= pos[:, None])
+            found = m.any(axis=1)
+            ok = ok & found
+            pos = jnp.where(found,
+                            jnp.argmax(m, axis=1).astype(jnp.int32)
+                            + len(seg), pos)
+        end_shift = c.lengths - len(tail)
+        if tail:
+            m = self._seg_match(c, tail)
+            at_end = jnp.take_along_axis(
+                m, jnp.clip(end_shift, 0, w)[:, None].astype(jnp.int32),
+                axis=1)[:, 0]
+            ok = ok & at_end & (end_shift >= pos)
+        return DeviceColumn(self.dtype, ok, c.validity)
+
+    def eval_cpu(self, batch):
+        import re as _re
+        c = self.child.eval_cpu(batch)
+        # translate LIKE → regex (escape-aware)
+        rx = ""
+        i = 0
+        pat = self.pattern
+        while i < len(pat):
+            ch = pat[i]
+            if ch == "\\" and i + 1 < len(pat):
+                rx += _re.escape(pat[i + 1])
+                i += 2
+                continue
+            if ch == "%":
+                rx += "(?s:.*)"
+            elif ch == "_":
+                rx += "(?s:.)"
+            else:
+                rx += _re.escape(ch)
+            i += 1
+        prog = _re.compile(rx)
+        data = np.array([prog.fullmatch(str(s)) is not None
+                         for s in c.data])
+        return HostCol(self.dtype, data, c.validity)
+
+
+@dataclasses.dataclass
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search/replace.
+
+    [REF: GpuStringReplace] — device algorithm, scatter-free:
+    greedy non-overlapping match selection (one static pass over the
+    width carrying a 'next free position' vector), per-input-byte emit
+    counts, exclusive scan for output offsets, then every OUTPUT byte
+    binary-searches its source segment (vmapped searchsorted)."""
+
+    child: Expression
+    search: str
+    replace: str
+
+    @property
+    def dtype(self):
+        return T.StringT
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        import jax
+        c = self.child.eval_tpu(batch)
+        b, w = c.data.shape
+        sb = self.search.encode()
+        rb = self.replace.encode()
+        ls, lr = len(sb), len(rb)
+        if ls == 0 or ls > w:
+            return c
+        pv = jnp.asarray(np.frombuffer(sb, np.uint8))
+        rv = (jnp.asarray(np.frombuffer(rb, np.uint8)) if lr
+              else jnp.zeros((1,), jnp.uint8))
+        # match-at-shift
+        mats = []
+        for s in range(w):
+            if s + ls <= w:
+                m = (c.data[:, s:s + ls] == pv[None, :]).all(axis=1) & (
+                    c.lengths >= s + ls)
+            else:
+                m = jnp.zeros((b,), jnp.bool_)
+            mats.append(m)
+        match = jnp.stack(mats, axis=1)  # [B, w]
+        # greedy leftmost non-overlapping selection
+        chosen_cols = []
+        next_free = jnp.zeros((b,), jnp.int32)
+        for j in range(w):
+            ch = match[:, j] & (next_free <= j)
+            next_free = jnp.where(ch, j + ls, next_free)
+            chosen_cols.append(ch)
+        chosen = jnp.stack(chosen_cols, axis=1)  # [B, w]
+        # covered = inside a chosen span but not its start
+        cover_cols = []
+        cov_until = jnp.zeros((b,), jnp.int32)
+        for j in range(w):
+            cov_until = jnp.where(chosen[:, j], j + ls, cov_until)
+            cover_cols.append((cov_until > j) & ~chosen[:, j])
+        covered = jnp.stack(cover_cols, axis=1)
+        in_str = jnp.arange(w)[None, :] < c.lengths[:, None]
+        emit = jnp.where(chosen, lr,
+                         jnp.where(covered | ~in_str, 0, 1)
+                         ).astype(jnp.int32)
+        off = jnp.cumsum(emit, axis=1) - emit  # exclusive
+        out_len = (off[:, -1] + emit[:, -1]).astype(jnp.int32)
+        wout = round_up_pow2(
+            max(w if lr <= ls else w + (w // ls) * (lr - ls), 1), 8)
+        ks = jnp.arange(wout, dtype=jnp.int32)
+
+        def row(off_r, emit_r, chosen_r, data_r, n_r):
+            j = jnp.searchsorted(off_r + emit_r, ks, side="right")
+            j = jnp.clip(j, 0, w - 1).astype(jnp.int32)
+            is_rep = jnp.take(chosen_r, j)
+            rel = ks - jnp.take(off_r, j)
+            rep_byte = jnp.take(rv, jnp.clip(rel, 0, max(lr - 1, 0)))
+            src_byte = jnp.take(data_r, j)
+            by = jnp.where(is_rep, rep_byte, src_byte)
+            return jnp.where(ks < n_r, by, 0)
+
+        out = jax.vmap(row)(off, emit, chosen, c.data, out_len)
+        return DeviceColumn(T.StringT, out.astype(jnp.uint8),
+                            c.validity, out_len)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        if not self.search:
+            return HostCol(T.StringT, c.data, c.validity)
+        data = np.array([str(s).replace(self.search, self.replace)
+                         for s in c.data], object)
+        return HostCol(T.StringT, data, c.validity)
+
+
+@dataclasses.dataclass
 class Concat(Expression):
     exprs: List[Expression]
 
@@ -360,3 +705,252 @@ class Concat(Expression):
                          for i in range(n)], object)
         return HostCol(T.StringT, data,
                        merge_validity_h(*[c.validity for c in cols]))
+
+
+# ---------------------------------------------------------------------------
+# Device string casts [REF: GpuCast.scala — castToString / castStringToInt /
+# castStringToBool / castStringToFloat]
+# ---------------------------------------------------------------------------
+
+_LONG_STR_W = 24  # "-9223372036854775808" fits with room, pow-2-ish pad
+
+
+def cast_int_to_string_device(c: DeviceColumn) -> DeviceColumn:
+    """int family → decimal string (exact, device-side digit extraction)."""
+    v = c.data.astype(jnp.int64)
+    neg = v < 0
+    # |Long.MIN| overflows int64: compute magnitude in uint64
+    mag = jnp.where(neg, (-(v + 1)).astype(jnp.uint64) + jnp.uint64(1),
+                    v.astype(jnp.uint64))
+    digs = []
+    m = mag
+    for _ in range(19):
+        digs.append((m % jnp.uint64(10)).astype(jnp.uint8))
+        m = m // jnp.uint64(10)
+    dig = jnp.stack(digs[::-1], axis=1)  # [B,19] most-significant first
+    nz = dig != 0
+    any_nz = nz.any(axis=1)
+    lead = jnp.where(any_nz, jnp.argmax(nz, axis=1), 18).astype(jnp.int32)
+    ndig = 19 - lead
+    out_len = (ndig + neg.astype(jnp.int32)).astype(jnp.int32)
+    w = _LONG_STR_W
+    posn = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = lead[:, None] + posn - neg.astype(jnp.int32)[:, None]
+    ch = jnp.take_along_axis(dig, jnp.clip(src, 0, 18), axis=1) + ord("0")
+    out = jnp.where(neg[:, None] & (posn == 0), ord("-"), ch)
+    out = jnp.where(posn < out_len[:, None], out, 0)
+    return DeviceColumn(T.StringT, out.astype(jnp.uint8), c.validity,
+                        out_len)
+
+
+def cast_bool_to_string_device(c: DeviceColumn) -> DeviceColumn:
+    tv = np.zeros((1, 8), np.uint8)
+    tv[0, :4] = np.frombuffer(b"true", np.uint8)
+    fv = np.zeros((1, 8), np.uint8)
+    fv[0, :5] = np.frombuffer(b"false", np.uint8)
+    cond = c.data.astype(jnp.bool_)[:, None]
+    out = jnp.where(cond, jnp.asarray(tv), jnp.asarray(fv))
+    lengths = jnp.where(c.data.astype(jnp.bool_), 4, 5).astype(jnp.int32)
+    return DeviceColumn(T.StringT, out.astype(jnp.uint8), c.validity,
+                        lengths)
+
+
+def _trim_bounds(c: DeviceColumn):
+    """(start, end) of the whitespace-trimmed span per row."""
+    b, w = c.data.shape
+    pos = jnp.arange(w)[None, :]
+    in_str = pos < c.lengths[:, None]
+    # Spark trims ASCII control+space like Java trim (chars <= 0x20)
+    is_sp = (c.data <= 0x20) & in_str
+    nonsp = in_str & ~is_sp
+    any_nonsp = nonsp.any(axis=1)
+    first = jnp.where(any_nonsp, jnp.argmax(nonsp, axis=1),
+                      c.lengths).astype(jnp.int32)
+    last = jnp.where(any_nonsp,
+                     w - jnp.argmax(jnp.flip(nonsp, axis=1), axis=1),
+                     0).astype(jnp.int32)
+    return first, last
+
+
+_INT_DST_RANGE = {
+    "byte": (-(1 << 7), (1 << 7) - 1),
+    "short": (-(1 << 15), (1 << 15) - 1),
+    "int": (-(1 << 31), (1 << 31) - 1),
+    "long": (-(1 << 63), (1 << 63) - 1),
+}
+
+
+def cast_string_to_int_device(c: DeviceColumn, dst: T.DataType
+                              ) -> DeviceColumn:
+    """string → integral (Spark non-ANSI): trim, [+-], digits, optional
+    fraction truncated toward zero; invalid/overflow → null."""
+    b, w = c.data.shape
+    start, end = _trim_bounds(c)
+    acc = jnp.zeros((b,), jnp.uint64)
+    neg = jnp.zeros((b,), jnp.bool_)
+    seen_digit = jnp.zeros((b,), jnp.bool_)
+    seen_dot = jnp.zeros((b,), jnp.bool_)
+    bad = jnp.zeros((b,), jnp.bool_)
+    overflow = jnp.zeros((b,), jnp.bool_)
+    lim = jnp.uint64((1 << 64) - 1) // jnp.uint64(10)
+    for j in range(w):
+        by = c.data[:, j].astype(jnp.int32)
+        active = (jnp.int32(j) >= start) & (jnp.int32(j) < end) & ~bad
+        is_digit = (by >= ord("0")) & (by <= ord("9"))
+        is_sign = ((by == ord("+")) | (by == ord("-"))) & (
+            jnp.int32(j) == start)
+        is_dot = (by == ord(".")) & ~seen_dot
+        d = (by - ord("0")).astype(jnp.uint64)
+        do_acc = active & is_digit & ~seen_dot
+        # 2^64-1 = lim*10 + 5: acc == lim with digit > 5 also overflows
+        overflow = overflow | (do_acc & (
+            (acc > lim) | ((acc == lim) & (d > jnp.uint64(5)))))
+        acc = jnp.where(do_acc, acc * jnp.uint64(10) + d, acc)
+        seen_digit = seen_digit | (active & is_digit)
+        neg = jnp.where(active & is_sign & (by == ord("-")), True, neg)
+        seen_dot = seen_dot | (active & is_dot)
+        bad = bad | (active & ~(is_digit | is_sign | is_dot))
+    # 2^63 magnitude allowed only for Long.MIN
+    max_mag = jnp.where(neg, jnp.uint64(1) << jnp.uint64(63),
+                        (jnp.uint64(1) << jnp.uint64(63))
+                        - jnp.uint64(1))
+    overflow = overflow | (acc > max_mag)
+    signed = jnp.where(
+        neg, (~acc + jnp.uint64(1)).astype(jnp.int64),
+        acc.astype(jnp.int64))
+    lo, hi = _INT_DST_RANGE[dst.simple_name.replace("integer", "int")
+                            if dst.simple_name == "integer"
+                            else dst.simple_name]
+    in_range = (signed >= lo) & (signed <= hi)
+    valid = seen_digit & ~bad & ~overflow & in_range
+    validity = (valid if c.validity is None else (c.validity & valid))
+    npdt = T.to_numpy_dtype(dst)
+    return DeviceColumn(dst, signed.astype(npdt), validity)
+
+
+_TRUE_WORDS = [b"true", b"t", b"yes", b"y", b"1"]
+_FALSE_WORDS = [b"false", b"f", b"no", b"n", b"0"]
+
+
+def cast_string_to_bool_device(c: DeviceColumn) -> DeviceColumn:
+    b, w = c.data.shape
+    start, end = _trim_bounds(c)
+    tlen = end - start
+    # lowercase a shifted copy
+    idx = start[:, None] + jnp.arange(w)[None, :]
+    g = jnp.take_along_axis(c.data, jnp.clip(idx, 0, w - 1), axis=1)
+    in_t = jnp.arange(w)[None, :] < tlen[:, None]
+    low = jnp.where((g >= ord("A")) & (g <= ord("Z")), g + 32, g)
+    low = jnp.where(in_t, low, 0)
+
+    def match(word: bytes) -> jnp.ndarray:
+        p = len(word)
+        if p > w:
+            return jnp.zeros((b,), jnp.bool_)
+        pv = jnp.asarray(np.frombuffer(word, np.uint8))
+        return (low[:, :p] == pv[None, :]).all(axis=1) & (tlen == p)
+
+    is_true = jnp.zeros((b,), jnp.bool_)
+    for word in _TRUE_WORDS:
+        is_true = is_true | match(word)
+    is_false = jnp.zeros((b,), jnp.bool_)
+    for word in _FALSE_WORDS:
+        is_false = is_false | match(word)
+    valid = is_true | is_false
+    validity = valid if c.validity is None else (c.validity & valid)
+    return DeviceColumn(T.BooleanT, is_true, validity)
+
+
+def cast_string_to_float_device(c: DeviceColumn, dst: T.DataType
+                                ) -> DeviceColumn:
+    """string → float/double: sign, digits, '.', digits, [eE][sign]digits,
+    'inf'/'infinity'/'nan' (case-insensitive).
+
+    Correctly rounded when the mantissa fits 2^53 and |10-exponent| ≤ 22
+    (exact f64 intermediate); beyond that may differ from Java's
+    parseDouble by 1 ulp — gated by
+    spark.rapids.sql.castStringToFloat.enabled, like the reference."""
+    b, w = c.data.shape
+    start, end = _trim_bounds(c)
+    idx = start[:, None] + jnp.arange(w)[None, :]
+    g = jnp.take_along_axis(c.data, jnp.clip(idx, 0, w - 1), axis=1)
+    tlen = end - start
+    in_t = jnp.arange(w)[None, :] < tlen[:, None]
+    low = jnp.where((g >= ord("A")) & (g <= ord("Z")), g + 32, g)
+    low = jnp.where(in_t, low, 0).astype(jnp.int32)
+
+    def word_eq(word: bytes, off_sign: bool):
+        p = len(word)
+        if p > w:
+            return jnp.zeros((b,), jnp.bool_)
+        pv = jnp.asarray(np.frombuffer(word, np.uint8), dtype=jnp.int32)
+        base = (low[:, :p] == pv[None, :]).all(axis=1) & (tlen == p)
+        return base
+
+    is_nan = word_eq(b"nan", False)
+    inf_pat = jnp.zeros((b,), jnp.bool_)
+    sign_inf = jnp.zeros((b,), jnp.bool_)
+    for word in (b"inf", b"infinity", b"+inf", b"-inf", b"+infinity",
+                 b"-infinity"):
+        m = word_eq(word, False)
+        inf_pat = inf_pat | m
+        if word[0:1] == b"-":
+            sign_inf = sign_inf | m
+    # general numeric parse
+    mant = jnp.zeros((b,), jnp.float64)
+    frac_digits = jnp.zeros((b,), jnp.int32)
+    exp_acc = jnp.zeros((b,), jnp.int32)
+    neg = jnp.zeros((b,), jnp.bool_)
+    eneg = jnp.zeros((b,), jnp.bool_)
+    seen_digit = jnp.zeros((b,), jnp.bool_)
+    seen_dot = jnp.zeros((b,), jnp.bool_)
+    in_exp = jnp.zeros((b,), jnp.bool_)
+    seen_edigit = jnp.zeros((b,), jnp.bool_)
+    seen_esign = jnp.zeros((b,), jnp.bool_)
+    bad = jnp.zeros((b,), jnp.bool_)
+    for j in range(w):
+        by = low[:, j]
+        active = (jnp.int32(j) < tlen) & ~bad
+        is_digit = (by >= ord("0")) & (by <= ord("9"))
+        d = (by - ord("0")).astype(jnp.float64)
+        at_start = jnp.int32(j) == 0
+        # ONE sign allowed, only directly after 'e'
+        after_e = in_exp & ~seen_edigit & ~seen_esign
+        is_sign = (by == ord("+")) | (by == ord("-"))
+        sign_ok = is_sign & (at_start | after_e)
+        seen_esign = seen_esign | (active & is_sign & after_e)
+        is_dot = (by == ord(".")) & ~seen_dot & ~in_exp
+        is_e = (by == ord("e")) & seen_digit & ~in_exp
+        mant_step = active & is_digit & ~in_exp
+        mant = jnp.where(mant_step, mant * 10.0 + d, mant)
+        frac_digits = jnp.where(mant_step & seen_dot, frac_digits + 1,
+                                frac_digits)
+        exp_step = active & is_digit & in_exp
+        exp_acc = jnp.where(
+            exp_step,
+            jnp.minimum(exp_acc * 10 + (by - ord("0")), 9999), exp_acc)
+        seen_edigit = seen_edigit | exp_step
+        seen_digit = seen_digit | (active & is_digit & ~in_exp)
+        neg = jnp.where(active & sign_ok & at_start & (by == ord("-")),
+                        True, neg)
+        eneg = jnp.where(active & sign_ok & ~at_start & (by == ord("-")),
+                         True, eneg)
+        seen_dot = seen_dot | (active & is_dot)
+        in_exp = in_exp | (active & is_e)
+        bad = bad | (active & ~(is_digit | sign_ok | is_dot | is_e))
+    exp = jnp.where(eneg, -exp_acc, exp_acc) - frac_digits
+    # 10^exp via exact split: 10^|e| is exact for |e| ≤ 22
+    ae = jnp.clip(jnp.abs(exp), 0, 350)
+    p1 = jnp.power(10.0, jnp.minimum(ae, 22).astype(jnp.float64))
+    p2 = jnp.power(10.0, jnp.maximum(ae - 22, 0).astype(jnp.float64))
+    val = jnp.where(exp >= 0, mant * p1 * p2, mant / p1 / p2)
+    val = jnp.where(neg, -val, val)
+    ok_num = seen_digit & ~bad & (~in_exp | seen_edigit)
+    val = jnp.where(is_nan, jnp.float64(np.nan), val)
+    val = jnp.where(inf_pat,
+                    jnp.where(sign_inf, -jnp.float64(np.inf),
+                              jnp.float64(np.inf)), val)
+    valid = ok_num | is_nan | inf_pat
+    validity = valid if c.validity is None else (c.validity & valid)
+    npdt = T.to_numpy_dtype(dst)
+    return DeviceColumn(dst, val.astype(npdt), validity)

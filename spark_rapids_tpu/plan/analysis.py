@@ -214,6 +214,28 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
                           resolve(u.children[1], schema))
     if op in ("upper", "lower", "length"):
         return S.string_unary(op, resolve(u.children[0], schema))
+    if op in ("trim", "ltrim", "rtrim"):
+        side = {"trim": "both", "ltrim": "leading",
+                "rtrim": "trailing"}[op]
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.StringType):
+            raise AnalysisException(f"{op} needs a string operand")
+        return S.Trim(child, side)
+    if op == "replace":
+        search, repl = u.payload
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.StringType):
+            raise AnalysisException("replace needs a string operand")
+        return S.StringReplace(child, search, repl)
+    if op == "locate":
+        substr = resolve(u.children[0], schema)
+        child = resolve(u.children[1], schema)
+        return S.StringLocate(substr, child, u.payload)
+    if op == "like":
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.StringType):
+            raise AnalysisException("like needs a string operand")
+        return S.Like(child, u.payload)
     if op == "substring":
         pos, ln = u.payload
         return S.Substring(resolve(u.children[0], schema), pos, ln)

@@ -163,8 +163,11 @@ class Join(LogicalPlan):
     join_type: str  # inner, left, right, full, left_semi, left_anti, cross
     left_keys: List[Expression]
     right_keys: List[Expression]
-    condition: Optional[Expression]
+    condition: Optional[Expression]  # residual; refs bound to left++right
     schema: T.StructType
+    # USING join (key columns coalesced once in the output) vs
+    # expression join (all left cols ++ all right cols, Spark semantics)
+    using: bool = True
 
     @property
     def children(self):

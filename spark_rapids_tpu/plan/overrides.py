@@ -113,6 +113,11 @@ def tag_expression(e: Expression, meta: ExecMeta):
             f"expression {name} under spark.sql.ansi.enabled=true: device "
             "lowering implements non-ANSI semantics (overflow wraps, "
             "invalid input nulls) — CPU fallback until ANSI kernels exist")
+    hook = getattr(e, "device_support_reason", None)
+    if hook is not None:
+        r = hook(meta.conf)
+        if r:
+            meta.will_not_work(f"expression {name}: {r}")
     r = is_device_supported_type(e.dtype)
     if r:
         meta.will_not_work(f"expression {e}: {r}")
@@ -357,13 +362,16 @@ def convert_meta(meta: ExecMeta) -> ExecNode:
     return _rebuild_cpu(meta.cpu, cpu_children)
 
 
-def _estimated_row_bytes(schema: T.StructType) -> int:
-    """Rough bytes/row for batch-size targeting (strings are padded byte
-    matrices — estimate, exactness doesn't matter for a coalesce goal)."""
+def _estimated_row_bytes(schema: T.StructType,
+                         str_width: Optional[int] = None) -> int:
+    """Rough bytes/row for batch-size targeting and working-set
+    accounting.  ``str_width``: known string-matrix width (the ICI
+    exchange passes it); default is a 40-byte planning-time guess."""
     total = 0
     for f in schema.fields:
         if isinstance(f.dtype, (T.StringType, T.BinaryType)):
-            total += 40
+            total += (max(str_width, 8) + 4) if str_width is not None \
+                else 40
         else:
             total += 8
         total += 1  # validity
@@ -383,7 +391,8 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
     """
     from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
     from spark_rapids_tpu.exec.distributed import TpuIciShuffleExchangeExec
-    from spark_rapids_tpu.exec.join import TpuSortMergeJoinExec
+    from spark_rapids_tpu.exec.join import (
+        TpuBroadcastExchangeExec, TpuSortMergeJoinExec)
     from spark_rapids_tpu.exec.sort import TpuSortExec
     from spark_rapids_tpu.exec.window import TpuWindowExec
     from spark_rapids_tpu import conf as C
@@ -405,7 +414,8 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
             TpuCoalesceBatchesExec(c, require_single=True)
             if isinstance(c, TpuExec) and c.num_partitions() == 1
             and not isinstance(
-                c, (TpuCoalesceBatchesExec, TpuIciShuffleExchangeExec))
+                c, (TpuCoalesceBatchesExec, TpuIciShuffleExchangeExec,
+                    TpuBroadcastExchangeExec))
             else c
             for c in node._children)
     return node

@@ -44,7 +44,8 @@ def plan_physical(node: L.LogicalPlan, conf: RapidsConf) -> CpuExec:
         return CpuJoinExec(node.join_type, node.left_keys, node.right_keys,
                            node.condition, node.schema,
                            plan_physical(node.left, conf),
-                           plan_physical(node.right, conf))
+                           plan_physical(node.right, conf),
+                           using=node.using)
     if isinstance(node, L.Window):
         from spark_rapids_tpu.exec.window import CpuWindowExec
         return CpuWindowExec(node.partition_by, node.order_by,

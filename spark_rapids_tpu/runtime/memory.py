@@ -170,7 +170,8 @@ class DeviceMemoryManager:
         self._alloc_count = 0
         self._inject_at = inject_oom_at
         self.metrics = {"spillToHostBytes": 0, "spillToDiskBytes": 0,
-                        "retryOOMs": 0, "splitRetries": 0}
+                        "retryOOMs": 0, "splitRetries": 0,
+                        "peakReserved": 0}
         self.budget = budget if budget else self._detect_budget(
             alloc_fraction)
 
@@ -208,6 +209,8 @@ class DeviceMemoryManager:
                         f"cannot reserve {nbytes} B: {self._reserved} of "
                         f"{self.budget} B reserved, nothing left to spill")
             self._reserved += nbytes
+            self.metrics["peakReserved"] = max(
+                self.metrics["peakReserved"], self._reserved)
 
     def release(self, nbytes: int) -> None:
         with self._lock:

@@ -185,6 +185,10 @@ class Column:
     def contains(self, o) -> "Column":
         return self._bin("contains", o)
 
+    def like(self, pattern: str) -> "Column":
+        """SQL LIKE ('%', '_', backslash escape), literal pattern."""
+        return Column(UExpr("like", pattern, (self._u,)))
+
     def __str__(self):
         return str(self._u)
 

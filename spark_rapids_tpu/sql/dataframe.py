@@ -265,17 +265,18 @@ class DataFrame:
             on = []
         if isinstance(on, str):
             on = [on]
+        if isinstance(on, Column):
+            return self._expression_join(other, on, how)
         left_keys, right_keys = [], []
         using = all(isinstance(c, str) for c in on)
-        if using:
-            for name in on:
-                left_keys.append(AN.resolve(UExpr("attr", name), self.schema))
-                right_keys.append(AN.resolve(UExpr("attr", name),
-                                             other.schema))
-        else:
-            raise NotImplementedError(
-                "join on Column expressions not yet supported; use column "
-                "name lists")
+        if not using:
+            raise AN.AnalysisException(
+                "join 'on' must be a column-name list or a single Column "
+                "condition")
+        for name in on:
+            left_keys.append(AN.resolve(UExpr("attr", name), self.schema))
+            right_keys.append(AN.resolve(UExpr("attr", name),
+                                         other.schema))
         # output schema: USING semantics — join cols once (from left), then
         # remaining left cols, then remaining right cols
         fields: List[T.StructField] = []
@@ -300,6 +301,71 @@ class DataFrame:
 
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         return self.join(other, on=[], how="cross")
+
+    def _expression_join(self, other: "DataFrame", on: Column, how: str
+                         ) -> "DataFrame":
+        """Spark's ExtractEquiJoinKeys analog: resolve the condition
+        against left++right, pull `left.col == right.col` conjuncts out
+        as equi keys, keep the rest as a residual condition evaluated
+        over the join output."""
+        from spark_rapids_tpu.ops import expressions as E
+        nl = len(self.schema)
+        combined = T.StructType(tuple(self.schema.fields)
+                                + tuple(other.schema.fields))
+        cond = AN.resolve(on._u, combined)
+        if not isinstance(cond.dtype, (T.BooleanType, T.NullType)):
+            raise AN.AnalysisException(
+                f"join condition must be boolean, got {cond.dtype}")
+
+        conjuncts: List = []
+
+        def split(e):
+            if isinstance(e, E.And):
+                split(e.left)
+                split(e.right)
+            else:
+                conjuncts.append(e)
+
+        split(cond)
+        left_keys, right_keys, residual = [], [], []
+        for c in conjuncts:
+            sides = None
+            if (isinstance(c, E.EqualTo)
+                    and isinstance(c.left, E.BoundReference)
+                    and isinstance(c.right, E.BoundReference)):
+                li, ri = c.left.index, c.right.index
+                if li < nl <= ri:
+                    sides = (li, ri - nl)
+                elif ri < nl <= li:
+                    sides = (ri, li - nl)
+            if sides is None:
+                residual.append(c)
+                continue
+            li, ri = sides
+            lf = self.schema.fields[li]
+            rf = other.schema.fields[ri]
+            left_keys.append(E.BoundReference(li, lf.dtype, lf.nullable))
+            right_keys.append(E.BoundReference(ri, rf.dtype, rf.nullable))
+        res = None
+        for c in residual:
+            res = c if res is None else E.And(res, c)
+        if not left_keys and how not in ("inner", "cross"):
+            raise AN.AnalysisException(
+                f"{how} join requires at least one equi-join conjunct "
+                "(left.col == right.col); got only a non-equi condition")
+        # expression-join output: ALL left cols ++ ALL right cols
+        semi = how in ("left_semi", "left_anti")
+        fields: List[T.StructField] = []
+        for f in self.schema.fields:
+            nullable = f.nullable or how in ("right", "full")
+            fields.append(T.StructField(f.name, f.dtype, nullable))
+        if not semi:
+            for f in other.schema.fields:
+                nullable = f.nullable or how in ("left", "full")
+                fields.append(T.StructField(f.name, f.dtype, nullable))
+        return DataFrame(self.session, L.Join(
+            self._plan, other._plan, how, left_keys, right_keys, res,
+            T.StructType(tuple(fields)), using=False))
 
     # -- actions ------------------------------------------------------------
     def _execute_plan(self):

@@ -40,6 +40,9 @@ upper = _unary("upper")
 lower = _unary("lower")
 length = _unary("length")
 isnan = _unary("isnan")
+trim = _unary("trim")
+ltrim = _unary("ltrim")
+rtrim = _unary("rtrim")
 
 pow = _binary("pow")  # noqa: A001
 date_add = _binary("date_add")
@@ -75,6 +78,20 @@ concat = concat_impl
 def hash(*cols) -> Column:  # noqa: A001
     """Spark murmur3 hash (seed 42)."""
     return Column(UExpr("hash", None, tuple(_cu(c) for c in cols)))
+
+
+def replace(c, search: str, replacement: str) -> Column:
+    """replace(str, search, replace) with literal search/replace."""
+    return Column(UExpr("replace", (search, replacement), (_cu(c),)))
+
+
+def instr(c, substr: str) -> Column:
+    """1-based position of the first occurrence; 0 if absent."""
+    return Column(UExpr("locate", 1, (UExpr("lit", substr), _cu(c))))
+
+
+def locate(substr: str, c, pos: int = 1) -> Column:
+    return Column(UExpr("locate", pos, (UExpr("lit", substr), _cu(c))))
 
 
 # aggregate functions -------------------------------------------------------

@@ -1,0 +1,192 @@
+"""Expression joins, residual conditions, broadcast joins.
+
+[REF: integration_tests join_test.py; GpuBroadcastHashJoinExec, AstUtil]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, cpu_session, tpu_session)
+
+
+def _tables(seed=0, n=2000, m=300):
+    rng = np.random.default_rng(seed)
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+        "v": pa.array(rng.uniform(-100, 100, n)),
+        "tag": pa.array([f"L{i % 7}" for i in range(n)]),
+    })
+    right = pa.table({
+        "rk": pa.array(rng.integers(0, 60, m).astype(np.int64)),
+        "w": pa.array(rng.integers(-50, 50, m).astype(np.int64)),
+        "name": pa.array([None if i % 11 == 0 else f"R{i % 5}"
+                          for i in range(m)]),
+    })
+    return left, right
+
+
+def _plan_tree(df, s):
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    rc = s.rapids_conf()
+    return apply_overrides(plan_physical(df._plan, rc), rc).plan.tree_string()
+
+
+# -- expression equi joins (all column layout) -------------------------------
+
+@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
+                                 "left_semi", "left_anti"])
+def test_expression_equi_join(how):
+    l, r = _tables(1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r), col("k") == col("rk"), how),
+        ignore_order=True,
+        conf={"spark.sql.autoBroadcastJoinThreshold": 0})
+
+
+# -- residual conditions -----------------------------------------------------
+
+def test_inner_join_with_residual_condition():
+    l, r = _tables(2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r),
+            (col("k") == col("rk")) & (col("v") > col("w")), "inner"),
+        ignore_order=True,
+        conf={"spark.sql.autoBroadcastJoinThreshold": 0})
+
+
+def test_inner_join_residual_on_device_no_fallback():
+    l, r = _tables(3)
+    s = tpu_session({"spark.sql.autoBroadcastJoinThreshold": 0})
+    df = s.createDataFrame(l).join(
+        s.createDataFrame(r),
+        (col("k") == col("rk")) & (col("v") > col("w")), "inner")
+    tree = _plan_tree(df, s)
+    assert "TpuSortMergeJoin" in tree, tree
+    assert "Join [" not in tree.replace("TpuSortMergeJoin [", ""), tree
+
+
+def test_pure_nonequi_inner_join():
+    """No equi conjunct at all → device nested-loop (cross + mask)."""
+    l, r = _tables(4, n=300, m=80)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r), col("v") > col("w"), "inner"),
+        ignore_order=True,
+        conf={"spark.sql.autoBroadcastJoinThreshold": 0})
+
+
+def test_residual_on_left_join_falls_back():
+    l, r = _tables(5, n=400, m=100)
+    s = tpu_session({"spark.rapids.sql.test.enabled": False,
+                     "spark.sql.autoBroadcastJoinThreshold": 0})
+    df = s.createDataFrame(l).join(
+        s.createDataFrame(r),
+        (col("k") == col("rk")) & (col("v") > col("w")), "left")
+    tree = _plan_tree(df, s)
+    assert "TpuSortMergeJoin" not in tree, tree
+    # CPU fallback still produces oracle-correct results
+    c = cpu_session().createDataFrame(l).join(
+        cpu_session().createDataFrame(r),
+        (col("k") == col("rk")) & (col("v") > col("w")), "left")
+    a = sorted(map(repr, df.toArrow().to_pylist()))
+    b = sorted(map(repr, c.toArrow().to_pylist()))
+    assert a == b
+
+
+def test_nonequi_outer_join_rejected():
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    l, r = _tables(6, n=50, m=20)
+    s = tpu_session({})
+    with pytest.raises(AnalysisException):
+        s.createDataFrame(l).join(
+            s.createDataFrame(r), col("v") > col("w"), "left")
+
+
+# -- broadcast joins ---------------------------------------------------------
+
+def test_broadcast_right_side_in_plan_and_correct():
+    l, r = _tables(7)
+    s = tpu_session({"spark.default.parallelism": 4})
+    df = s.createDataFrame(l).join(s.createDataFrame(r),
+                                   col("k") == col("rk"), "inner")
+    tree = _plan_tree(df, s)
+    assert "TpuBroadcastExchange" in tree, tree
+    assert "broadcast=right" in tree, tree
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r), col("k") == col("rk"), "inner"),
+        ignore_order=True,
+        conf={"spark.default.parallelism": 4})
+
+
+@pytest.mark.parametrize("how", ["left", "left_semi", "left_anti"])
+def test_broadcast_right_outer_types(how):
+    l, r = _tables(8)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r), col("k") == col("rk"), how),
+        ignore_order=True, conf={"spark.default.parallelism": 3})
+
+
+def test_broadcast_respects_threshold():
+    l, r = _tables(9)
+    s = tpu_session({"spark.sql.autoBroadcastJoinThreshold": 16})
+    df = s.createDataFrame(l).join(s.createDataFrame(r),
+                                   col("k") == col("rk"), "inner")
+    tree = _plan_tree(df, s)
+    assert "TpuBroadcastExchange" not in tree, tree
+
+
+def test_broadcast_with_residual_condition():
+    l, r = _tables(10)
+    s = tpu_session({"spark.default.parallelism": 3})
+    df = s.createDataFrame(l).join(
+        s.createDataFrame(r),
+        (col("k") == col("rk")) & (col("v") > col("w")), "inner")
+    tree = _plan_tree(df, s)
+    assert "TpuBroadcastExchange" in tree, tree
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(
+            s.createDataFrame(r),
+            (col("k") == col("rk")) & (col("v") > col("w")), "inner"),
+        ignore_order=True, conf={"spark.default.parallelism": 3})
+
+
+def test_broadcast_build_gathered_once():
+    l, r = _tables(11)
+    s = tpu_session({"spark.default.parallelism": 5})
+    df = s.createDataFrame(l).join(s.createDataFrame(r),
+                                   col("k") == col("rk"), "inner")
+    out = df.toArrow()
+    assert out.num_rows > 0
+
+    def find(node, name):
+        if type(node).__name__ == name:
+            return node
+        for c in node.children:
+            got = find(c, name)
+            if got is not None:
+                return got
+        return None
+
+    bex = find(df._last_plan, "TpuBroadcastExchangeExec")
+    assert bex is not None
+    # gathered exactly once despite 5 stream partitions
+    assert bex.metric("numOutputBatches").value == 1
+
+
+def test_using_join_unchanged():
+    """Name-list joins keep USING semantics (key columns once)."""
+    l, r = _tables(12)
+    r2 = r.rename_columns(["k", "w", "name"])
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r2), "k",
+                                            "inner"),
+        ignore_order=True)

@@ -1,0 +1,197 @@
+"""String long tail (trim/replace/locate/like) + string casts.
+
+[REF: integration_tests string_test.py, cast_test.py]
+Expression-level checks (eval_both) + end-to-end oracle queries.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops import expressions as E
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+from tests.test_expressions import check, eval_both, ref
+
+
+STRS = ["  hello  ", "world", "", "   ", "a b a b", "aaa", None,
+        "x" * 30, " lead", "trail ", "no-spaces", "ab_ab%ab"]
+
+
+def _tbl(values=STRS):
+    return pa.table({"s": pa.array(values)})
+
+
+# -- trim --------------------------------------------------------------------
+
+@pytest.mark.parametrize("side", ["both", "leading", "trailing"])
+def test_trim_sides(side):
+    check(S.Trim(ref(_tbl(), 0), side), _tbl())
+
+
+def test_trim_random():
+    t = dg.gen_table([dg.StringGen(max_len=10)], 300, seed=44)
+    for side in ("both", "leading", "trailing"):
+        check(S.Trim(ref(t, 0), side), t)
+
+
+# -- replace -----------------------------------------------------------------
+
+@pytest.mark.parametrize("search,repl", [
+    ("a", "XY"), ("ab", ""), ("ab", "Z"), ("aa", "b"), (" ", "_"),
+    ("hello", "hi"), ("zzz", "q"), ("a b", "AB")])
+def test_replace(search, repl):
+    check(S.StringReplace(ref(_tbl(), 0), search, repl), _tbl())
+
+
+def test_replace_overlapping_greedy():
+    t = _tbl(["aaaa", "aaa", "aa", "a", ""])
+    check(S.StringReplace(ref(t, 0), "aa", "b"), t)
+
+
+# -- locate/instr ------------------------------------------------------------
+
+@pytest.mark.parametrize("sub,pos", [
+    ("a", 1), ("b", 1), ("ab", 2), ("", 1), ("", 3), ("hello", 1),
+    ("a", 4), ("zzz", 1)])
+def test_locate(sub, pos):
+    t = _tbl()
+    e = S.StringLocate(E.Literal(sub, T.StringT), ref(t, 0), pos)
+    check(e, t)
+
+
+def test_instr_e2e():
+    t = _tbl()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.instr(F.col("s"), "a").alias("i"),
+            F.locate("b", F.col("s"), 2).alias("l")))
+
+
+# -- like --------------------------------------------------------------------
+
+@pytest.mark.parametrize("pattern", [
+    "hello", "%o%", "a%", "%b", "a_b", "%a_b%", "", "%", "%%", "___",
+    "a%b%a", "x%", "ab\\_ab%", "%\\%ab"])
+def test_like(pattern):
+    check(S.Like(ref(_tbl(), 0), pattern), _tbl())
+
+
+def test_like_e2e_no_fallback():
+    t = _tbl([v for v in STRS if v is not None])
+    s = tpu_session({})
+    df = s.createDataFrame(t).filter(col("s").like("%a%"))
+    got = sorted(df.toArrow().column("s").to_pylist())
+    assert got == sorted(v for v in STRS
+                         if v is not None and "a" in v)
+
+
+# -- string casts ------------------------------------------------------------
+
+INTS = [0, 1, -1, 127, -128, 32767, 2147483647, -2147483648,
+        9223372036854775807, -9223372036854775808, 42, -999, None]
+
+
+def test_cast_long_to_string():
+    t = pa.table({"v": pa.array(INTS, pa.int64())})
+    check(E.Cast(ref(t, 0), T.StringT), t)
+
+
+def test_cast_int_to_string_e2e():
+    t = pa.table({"v": pa.array([5, -3, None, 1000], pa.int32())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.col("v").cast("string").alias("s")))
+
+
+def test_cast_bool_to_string():
+    t = pa.table({"v": pa.array([True, False, None])})
+    check(E.Cast(ref(t, 0), T.StringT), t)
+
+
+STR_INTS = ["0", "1", "-1", "+5", " 42 ", "3.7", "-3.7", ".5", "-",
+            "abc", "", "  ", "127", "128", "-128", "-129",
+            "9223372036854775807", "9223372036854775808",
+            "-9223372036854775808", "-9223372036854775809",
+            "00012", "1.", None]
+
+
+@pytest.mark.parametrize("dst", [T.ByteT, T.ShortT, T.IntegerT, T.LongT])
+def test_cast_string_to_int_family(dst):
+    t = pa.table({"s": pa.array(STR_INTS)})
+    check(E.Cast(ref(t, 0), dst), t)
+
+
+def test_cast_string_to_bool():
+    t = pa.table({"s": pa.array(["true", "FALSE", "t", "f", "yes", "no",
+                                 "y", "N", "1", "0", " true ", "x", "",
+                                 None])})
+    check(E.Cast(ref(t, 0), T.BooleanT), t)
+
+
+def test_cast_string_to_long_uint64_boundary():
+    """Regression: 20-digit magnitudes near 2^64 must null, not wrap."""
+    t = pa.table({"s": pa.array([
+        "18446744073709551616",   # 2^64: wrapped to 0 before the fix
+        "18446744073709551615",   # 2^64-1
+        "18446744073709551617", "99999999999999999999",
+        "9223372036854775807", "-9223372036854775808"])})
+    check(E.Cast(ref(t, 0), T.LongT), t)
+
+
+STR_FLOATS = ["0", "1.5", "-2.25", "1e3", "-1.5E2", "3.14159", ".5",
+              "5.", "inf", "-inf", "Infinity", "NaN", "nan", " 2.5 ",
+              "abc", "1e", "", "1.2.3", "--5", "1e400",
+              "1e+-5", "1e++5", "1e--5", "1e+5", "1e-5", "1_000", None]
+
+
+def test_cast_string_to_double_device():
+    t = pa.table({"s": pa.array(STR_FLOATS)})
+    check(E.Cast(ref(t, 0), T.DoubleT), t)
+
+
+def test_cast_string_to_float_gated():
+    """Falls back unless castStringToFloat.enabled, like the reference."""
+    t = pa.table({"s": pa.array(["1.5", "abc"])})
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    df = s.createDataFrame(t).select(
+        F.col("s").cast("double").alias("d"))
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    rc = s.rapids_conf()
+    tree = apply_overrides(plan_physical(df._plan, rc),
+                           rc).plan.tree_string()
+    assert "TpuProject" not in tree, tree
+    assert df.toArrow().column("d").to_pylist() == [1.5, None]
+    # enabled: runs on device
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.col("s").cast("double").alias("d")),
+        conf={"spark.rapids.sql.castStringToFloat.enabled": True})
+
+
+def test_cast_float_to_string_always_falls_back():
+    t = pa.table({"v": pa.array([1.5, 2.25])})
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    df = s.createDataFrame(t).select(F.col("v").cast("string").alias("s"))
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    rc = s.rapids_conf()
+    tree = apply_overrides(plan_physical(df._plan, rc),
+                           rc).plan.tree_string()
+    assert "TpuProject" not in tree, tree
+
+
+def test_string_roundtrip_cast_e2e():
+    """int → string → int survives, on device end-to-end."""
+    rng = np.random.default_rng(7)
+    t = pa.table({"v": pa.array(rng.integers(-10**12, 10**12, 500))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.col("v").cast("string").cast("long").alias("r")))
