@@ -364,6 +364,29 @@ def device_to_host(batch: DeviceBatch, already_compact: bool = False) -> pa.Tabl
         validity = None
         if c.validity is not None:
             validity = np.asarray(c.validity)[:n]
+        if isinstance(f.dtype, T.ArrayType):
+            # padded element matrix [B, L] + lengths → arrow list array
+            mat = np.asarray(c.data)[:n]
+            lengths = np.asarray(c.lengths)[:n].astype(np.int64)
+            offsets = np.zeros(n + 1, np.int32)
+            np.cumsum(lengths, out=offsets[1:])
+            total = int(offsets[-1])
+            if total:
+                ii = np.repeat(np.arange(n), lengths)
+                jj = (np.arange(total)
+                      - np.repeat(offsets[:-1].astype(np.int64), lengths))
+                values = mat[ii, jj]
+            else:
+                values = np.zeros(0, mat.dtype)
+            elem = pa.array(values,
+                            type=T.to_arrow(f.dtype.element_type))
+            arr = pa.ListArray.from_arrays(pa.array(offsets), elem)
+            if validity is not None and not validity.all():
+                arr = pa.ListArray.from_arrays(
+                    pa.array(offsets), elem,
+                    mask=pa.array(~validity))
+            arrays.append(arr)
+            continue
         if c.is_string:
             mat = np.asarray(c.data)[:n]
             lengths = np.asarray(c.lengths)[:n]

@@ -57,6 +57,11 @@ def from_arrow_column(col, dt: T.DataType) -> HostCol:
         col = col.combine_chunks()
     nulls = np.asarray(col.is_null())
     validity = ~nulls if nulls.any() else None
+    if isinstance(dt, T.ArrayType):
+        data = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col.to_pylist()):
+            data[i] = v if v is not None else []
+        return HostCol(dt, data, validity)
     if isinstance(dt, (T.StringType, T.BinaryType)):
         data = np.array(
             ["" if v is None else v for v in col.to_pylist()], dtype=object)
@@ -89,6 +94,10 @@ def to_arrow_column(c: HostCol) -> pa.Array:
     mask = None
     if c.validity is not None:
         mask = ~c.validity
+    if isinstance(c.dtype, T.ArrayType):
+        vals = [None if (mask is not None and mask[i]) else list(c.data[i])
+                for i in range(n)]
+        return pa.array(vals, type=T.to_arrow(c.dtype))
     if isinstance(c.dtype, (T.StringType, T.BinaryType)):
         vals = [None if (mask is not None and mask[i]) else c.data[i]
                 for i in range(n)]

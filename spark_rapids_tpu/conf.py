@@ -348,6 +348,49 @@ ANSI_ENABLED = (
     .create_with_default(False)
 )
 
+LORE_TAG = (
+    conf("spark.rapids.sql.lore.tag")
+    .doc("Exec class name (e.g. TpuSortMergeJoinExec) whose INPUT batches "
+         "are dumped for offline replay [REF: GpuLore]. Empty disables.")
+    .category("test")
+    .string()
+    .create_with_default("")
+)
+
+LORE_DUMP_PATH = (
+    conf("spark.rapids.sql.lore.dumpPath")
+    .doc("Directory for LORE dumps (parquet batches + meta).")
+    .category("test")
+    .string()
+    .create_with_default("/tmp/tpuq-lore")
+)
+
+MEMORY_DEBUG = (
+    conf("spark.rapids.memory.gpu.debug")
+    .doc("NONE or STDOUT: track every spillable registration with its "
+         "creation stack and report LEAK DETECTED for batches never "
+         "closed [REF: cudf MemoryCleaner refcount debugging].")
+    .category("memory")
+    .string()
+    .check(lambda v: v.upper() in ("NONE", "STDOUT"), "NONE or STDOUT")
+    .create_with_default("NONE")
+)
+
+PROFILE_ENABLED = (
+    conf("spark.rapids.profile.enabled")
+    .doc("Capture a per-query device profile (jax/xplane trace, viewable "
+         "in TensorBoard/XProf) [REF: spark-rapids-jni profiler].")
+    .boolean()
+    .create_with_default(False)
+)
+
+PROFILE_PATH = (
+    conf("spark.rapids.profile.path")
+    .doc("Directory for profile captures.")
+    .string()
+    .create_with_default("/tmp/tpuq-profile")
+)
+
 FAULT_INJECT = (
     conf("spark.rapids.tpu.test.injectOomAtAlloc")
     .doc("Force an OOM at the Nth device allocation (test hook, mirrors "

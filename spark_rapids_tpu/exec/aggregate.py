@@ -34,7 +34,8 @@ from spark_rapids_tpu.exec.base import CpuExec, TpuExec
 from spark_rapids_tpu.exec.basic import concat_device_batches
 from spark_rapids_tpu.ops import ordering as ORD
 from spark_rapids_tpu.ops.aggregates import (
-    AggregateFunction, Average, Count, CountStar, First, Max, Min, Sum)
+    AggregateFunction, Average, CollectList, Count, CountStar, First,
+    Max, Min, Sum, _VarianceBase)
 from spark_rapids_tpu.ops.expressions import Expression
 from spark_rapids_tpu.plan import logical as L
 
@@ -204,6 +205,77 @@ def segment_groupby(
     return out_keys, out_vals, out_sel
 
 
+def _keep_first(a, bb):
+    return a
+
+
+def segment_max_group_count(key_cols, sel, contribs) -> jnp.ndarray:
+    """Max per-group contrib count over any contrib mask — the collect
+    matrix width probe (phase-1 kernel, one host sync at the call site,
+    same pattern as the exchange's count program)."""
+    b = int(sel.shape[0])
+    parts = [ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols))
+    limbs = ORD.fuse_parts(parts)
+    sorted_limbs, perm = ORD.sort_by_keys(limbs)
+    diff = jnp.zeros((b,), jnp.bool_)
+    for l in sorted_limbs:
+        diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
+    boundary = diff.at[0].set(True)
+    out = jnp.zeros((), jnp.int32)
+    for contrib in contribs:
+        cs = jnp.take(contrib & sel, perm)
+        n = segmented_scan(jnp.add, cs.astype(jnp.int32), boundary)
+        out = jnp.maximum(out, jnp.max(n))
+    return out
+
+
+def segment_collect(key_cols, sel, value_col: DeviceColumn, cap: int
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """collect_list over sorted groups → (matrix [B, cap], lengths [B])
+    in the SAME compacted group order as ``segment_groupby``.
+
+    Scatter-free: a stable sort on (exclusion, keys, value-invalid)
+    makes each group's valid values contiguous from its group start, so
+    list g is one shifted gather.  Null values are skipped (Spark
+    collect_list semantics)."""
+    b = int(sel.shape[0])
+    contrib = sel & value_col.valid_mask()
+    parts = ([ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols))
+             + [ORD._flag_part(~contrib)])
+    limbs = ORD.fuse_parts(parts)
+    sorted_limbs, perm = ORD.sort_by_keys(limbs)
+    live_sorted = jnp.take(sel, perm)
+    # boundaries over the KEY limbs only (exclusion flag shares limb 0's
+    # top bit; the trailing contrib flag must NOT split groups) — rebuild
+    # boundary from the key-only limb fusion evaluated in sorted order
+    key_limbs = ORD.fuse_parts(
+        [ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols)))
+    key_sorted = [jnp.take(l, perm) for l in key_limbs]
+    diff = jnp.zeros((b,), jnp.bool_)
+    for l in key_sorted:
+        diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
+    boundary = diff.at[0].set(True)
+    is_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    rank = (~(is_end & live_sorted)).astype(jnp.uint8)
+    _, perm2 = ORD.sort_by_keys([rank])
+
+    iota = jnp.arange(b, dtype=jnp.int32)
+    start_scan = segmented_scan(_keep_first, iota, boundary)
+    contrib_sorted = jnp.take(contrib, perm)
+    n_contrib = segmented_scan(jnp.add, contrib_sorted.astype(jnp.int32),
+                               boundary)
+    starts_g = jnp.take(start_scan, perm2)
+    counts_g = jnp.take(n_contrib, perm2)
+    values_sorted = jnp.take(value_col.data, perm, axis=0)
+    idx = starts_g[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
+    mat = jnp.take(values_sorted, jnp.clip(idx, 0, b - 1).reshape(-1),
+                   axis=0).reshape((b, cap) + values_sorted.shape[1:])
+    mask = jnp.arange(cap, dtype=jnp.int32)[None, :] < counts_g[:, None]
+    zero = jnp.zeros((), values_sorted.dtype)
+    mat = jnp.where(mask, mat, zero)
+    return mat, counts_g.astype(jnp.int32)
+
+
 def _reduce_column(data: jnp.ndarray, valid: jnp.ndarray,
                    live: jnp.ndarray, kind: str, dt: T.DataType,
                    has_nans: bool = True) -> DeviceColumn:
@@ -298,6 +370,12 @@ def update_value_cols(fns: Sequence[AggregateFunction], batch: DeviceBatch
             out.append((c, "min" if isinstance(fn, Min) else "max"))
         elif isinstance(fn, First):
             out.append((c, "first"))
+        elif isinstance(fn, _VarianceBase):
+            x = c.data.astype(jnp.float64)
+            out.append((DeviceColumn(T.DoubleT, x, c.validity), "sum"))
+            out.append((DeviceColumn(T.DoubleT, x * x, c.validity), "sum"))
+            out.append((DeviceColumn(
+                T.LongT, valid.astype(jnp.int64)), "sum"))
         else:
             raise NotImplementedError(f"TPU aggregate {fn.name}")
     return out
@@ -330,6 +408,18 @@ def final_project(fns: Sequence[AggregateFunction],
             out.append(DeviceColumn(
                 T.DoubleT, s.data / denom.astype(jnp.float64),
                 cnt.data > 0))
+        elif isinstance(fn, _VarianceBase):
+            s1, s2, cnt = mine
+            n = cnt.data.astype(jnp.float64)
+            nsafe = jnp.where(cnt.data > 0, n, 1.0)
+            # Σ(x-mean)² = Σx² - (Σx)²/n, clamped (cancellation)
+            m2 = jnp.maximum(s2.data - s1.data * s1.data / nsafe, 0.0)
+            denom = n - fn.ddof
+            var = jnp.where(denom > 0, m2 / jnp.where(denom > 0, denom,
+                                                      1.0),
+                            jnp.float64(np.nan))  # var_samp(1 row) = NaN
+            v = jnp.sqrt(var) if fn.sqrt_final else var
+            out.append(DeviceColumn(T.DoubleT, v, cnt.data > 0))
         else:  # Min/Max/First: buffer is the result
             out.append(mine[0])
     return out
@@ -410,6 +500,10 @@ class TpuHashAggregateExec(TpuExec):
                 j += 1
         return T.StructType(tuple(fields))
 
+    @property
+    def _has_collect(self) -> bool:
+        return any(isinstance(f, CollectList) for f in self.fns)
+
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         if self.mode != "complete":
             yield from self._execute_staged(partition)
@@ -418,12 +512,79 @@ class TpuHashAggregateExec(TpuExec):
         from spark_rapids_tpu.exec.base import fuse_upstream
         src, pre, pre_key = fuse_upstream(self.children[0])
         with self.timer():
-            if not self.grouping:
+            if self._has_collect:
+                out = self._execute_collect(src, pre, pre_key)
+            elif not self.grouping:
                 out = self._execute_global(src, pre, pre_key)
             else:
                 out = self._execute_grouped(src, pre, pre_key)
         self.metric("numOutputBatches").add(1)
         yield out
+
+    def _execute_collect(self, src, pre, pre_key) -> DeviceBatch:
+        """collect_list path: single kernel over the gathered input
+        (variable-length buffers don't ride the partial/merge protocol —
+        see CollectList docstring).  Two-phase like the exchange: a
+        count kernel probes the largest group for the static matrix
+        width, the main kernel groups + collects."""
+        from spark_rapids_tpu.columnar.column import compact, empty_batch
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        from spark_rapids_tpu.runtime.memory import get_manager
+        grouping, fns, schema = self.grouping, self.fns, self.schema
+        has_nans = self.has_nans
+        batches = [compact(b) for p in range(src.num_partitions())
+                   for b in src.execute(p)]
+        if not batches:
+            batches = [empty_batch(src.schema)]
+        merged = concat_device_batches(src.schema, batches)
+        with get_manager().transient(2 * merged.nbytes()):
+            base_key = (pre_key, has_nans, fingerprint(grouping),
+                        fingerprint(fns), fingerprint(schema))
+
+            def build_count():
+                def run(m):
+                    if pre is not None:
+                        m = pre(m)
+                    keys = [g.eval_tpu(m) for g in grouping]
+                    contribs = [
+                        f.child.eval_tpu(m).valid_mask()
+                        for f in fns if isinstance(f, CollectList)]
+                    return segment_max_group_count(keys, m.sel, contribs)
+                return run
+
+            cnt_fn = cached_kernel(("agg_collect_count",) + base_key,
+                                   build_count)
+            cap = int(np.asarray(cnt_fn(merged)))
+            cap = max(1, 1 << (cap - 1).bit_length() if cap > 1 else 1)
+
+            def build_main():
+                def run(m):
+                    if pre is not None:
+                        m = pre(m)
+                    keys = [g.eval_tpu(m) for g in grouping]
+                    normal = [f for f in fns
+                              if not isinstance(f, CollectList)]
+                    vals = update_value_cols(normal, m)
+                    ok, ov, sel = segment_groupby(keys, m.sel, vals,
+                                                  has_nans=has_nans)
+                    normal_res = iter(final_project(normal, ov))
+                    cols = list(ok)
+                    for f in fns:
+                        if isinstance(f, CollectList):
+                            mat, lens = segment_collect(
+                                keys, m.sel, f.child.eval_tpu(m), cap)
+                            cols.append(DeviceColumn(
+                                f.result_dtype, mat, None, lens))
+                        else:
+                            cols.append(next(normal_res))
+                    return DeviceBatch(schema, tuple(cols), sel,
+                                       compacted=True)
+                return run
+
+            fn = cached_kernel(("agg_collect", cap) + base_key,
+                               build_main)
+            return fn(merged)
 
     def _execute_global(self, src, pre, pre_key) -> DeviceBatch:
         """Global aggregate: per-batch masked REDUCTION (no sort — the
@@ -704,7 +865,11 @@ class CpuAggregateExec(CpuExec):
         for vals, f in zip(cols, self.schema.fields):
             vals = list(vals)
             validity = np.array([v is not None for v in vals], bool)
-            if isinstance(f.dtype, (T.StringType, T.BinaryType)):
+            if isinstance(f.dtype, T.ArrayType):
+                data = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    data[i] = v if v is not None else []
+            elif isinstance(f.dtype, (T.StringType, T.BinaryType)):
                 data = np.array([v if v is not None else "" for v in vals],
                                 dtype=object)
             else:
@@ -733,7 +898,7 @@ def _norm_key(v, dt):
 
 def _new_acc(fn):
     return {"sum": 0, "count": 0, "min": None, "max": None, "first": None,
-            "has_first": False}
+            "has_first": False, "mean": 0.0, "m2": 0.0, "list": []}
 
 
 def _acc_update(acc, fn, vc, i):
@@ -759,6 +924,14 @@ def _acc_update(acc, fn, vc, i):
                 acc["sum"] = np.int64(acc["sum"] + np.int64(v))
         else:
             acc["sum"] = float(acc["sum"]) + float(v)
+    elif isinstance(fn, _VarianceBase):
+        # Welford, exactly Spark's CentralMomentAgg update
+        acc["count"] += 1
+        delta = float(v) - acc["mean"]
+        acc["mean"] += delta / acc["count"]
+        acc["m2"] += delta * (float(v) - acc["mean"])
+    elif isinstance(fn, CollectList):
+        acc["list"].append(vc.data[i])
     elif isinstance(fn, Min):
         acc["min"] = v if acc["min"] is None else _spark_min(acc["min"], v, fn)
     elif isinstance(fn, Max):
@@ -795,6 +968,17 @@ def _acc_final(acc, fn):
         if acc["count"] == 0:
             return None
         return float(acc["sum"]) / acc["count"]
+    if isinstance(fn, _VarianceBase):
+        n = acc["count"]
+        if n == 0:
+            return None
+        denom = n - fn.ddof
+        var = acc["m2"] / denom if denom > 0 else float("nan")
+        import math
+        return math.sqrt(var) if fn.sqrt_final and var == var else (
+            float("nan") if fn.sqrt_final else var)
+    if isinstance(fn, CollectList):
+        return [_py_scalar(v, fn.input_dtype) for v in acc["list"]]
     if isinstance(fn, Min):
         return acc["min"]
     if isinstance(fn, Max):
@@ -802,6 +986,16 @@ def _acc_final(acc, fn):
     if isinstance(fn, First):
         return acc["first"]
     raise NotImplementedError(fn.name)
+
+
+def _py_scalar(v, dt):
+    if isinstance(dt, (T.FloatType, T.DoubleType)):
+        return float(v)
+    if isinstance(dt, T.BooleanType):
+        return bool(v)
+    if isinstance(dt, (T.StringType, T.BinaryType)):
+        return v
+    return int(v)
 
 
 def plan_cpu_aggregate(node: L.Aggregate, child: CpuExec,

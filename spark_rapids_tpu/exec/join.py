@@ -47,17 +47,28 @@ from spark_rapids_tpu.ops.expressions import Expression
 # helpers shared by both paths
 # ---------------------------------------------------------------------------
 
+def _gather_list(child, partition=None):
+    """Child batches as a compacted list (all partitions or one)."""
+    parts = (range(child.num_partitions()) if partition is None
+             else [partition])
+    return [compact(b) for p in parts for b in child.execute(p)]
+
+
+def _concat_or_empty(schema, batches):
+    from spark_rapids_tpu.columnar.column import empty_batch
+    if not batches:
+        return empty_batch(schema)
+    return concat_device_batches(schema, batches)
+
+
 def _gather_all(child, schema, device: bool, partition=None):
     """Concat child batches to one batch — all partitions, or just one
     (the co-partitioned path downstream of a key-hash exchange)."""
     parts = (range(child.num_partitions()) if partition is None
              else [partition])
     if device:
-        batches = [compact(b) for p in parts for b in child.execute(p)]
-        if not batches:
-            from spark_rapids_tpu.columnar.column import empty_batch
-            return empty_batch(schema)
-        return concat_device_batches(schema, batches)
+        return _concat_or_empty(
+            schema, [compact(b) for p in parts for b in child.execute(p)])
     from spark_rapids_tpu.exec.sort import _concat_host
     batches = [b for p in parts for b in child.execute(p)]
     if not batches:
@@ -451,6 +462,7 @@ class TpuSortMergeJoinExec(TpuExec):
         return 1
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.memory import RetryOOM, get_manager
         jt = self.join_type
         if jt == "right":
             yield from self._execute_swapped(partition)
@@ -463,15 +475,90 @@ class TpuSortMergeJoinExec(TpuExec):
             lpart = rpart = partition
         else:
             lpart = rpart = None
-        lb = _gather_all(self.children[0], self.children[0].schema, True,
-                         lpart)
-        rb = _gather_all(self.children[1], self.children[1].schema, True,
-                         rpart)
-        with self.timer():
-            if jt == "cross" or (jt == "inner" and not self.left_keys):
-                yield self._apply_condition(self._cross(lb, rb))
+        l_list = _gather_list(self.children[0], lpart)
+        r_list = _gather_list(self.children[1], rpart)
+        nokey = jt == "cross" or not self.left_keys
+        mgr = get_manager()
+        total = (sum(b.nbytes() for b in l_list)
+                 + sum(b.nbytes() for b in r_list))
+        try:
+            # in-core: both sides + the expanded output live together
+            with mgr.transient(2 * total):
+                lb = _concat_or_empty(self.children[0].schema, l_list)
+                rb = _concat_or_empty(self.children[1].schema, r_list)
+                with self.timer():
+                    if nokey:
+                        yield self._apply_condition(self._cross(lb, rb))
+                    else:
+                        yield from self._merge_join(lb, rb, jt)
                 return
-            yield from self._merge_join(lb, rb, jt)
+        except RetryOOM:
+            if nokey:
+                raise  # nested loop can't hash-split; let retry handle
+            self.metric("subPartitionJoins").add(1)
+        yield from self._sub_partition_join(l_list, r_list, jt, total,
+                                            mgr)
+
+    def _sub_partition_join(self, l_list, r_list, jt, total, mgr
+                            ) -> Iterator[DeviceBatch]:
+        """Oversized inputs: recursive hash split [REF:
+        GpuSubPartitionHashJoin].  Both sides re-hash on the join keys
+        with a DIFFERENT murmur3 seed (rows of one exchange partition
+        must spread), each (batch × sub-partition) slice registers as a
+        spillable, and sub-partition pairs join independently — peak HBM
+        ≈ one pair.  Equal keys land in equal sub-partitions, so every
+        join type's semantics hold per pair."""
+        from spark_rapids_tpu.parallel.shuffle import (
+            make_pid_fn, split_to_spillables)
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        k = max(2, min(64, int(np.ceil(total / max(mgr.budget // 4, 1)))))
+        canon = tuple(
+            type(le.dtype) is not type(re.dtype)
+            and isinstance(le.dtype, _INT_FAMILY)
+            for le, re in zip(self.left_keys, self.right_keys))
+        SUB_SEED = 0x53504C54  # != Spark shuffle seed 42
+
+        def split(batches, keys, schema):
+            pid_fn = cached_kernel(
+                ("subpart_pid", k, SUB_SEED, canon, fingerprint(keys),
+                 fingerprint(schema)),
+                lambda: make_pid_fn(keys, k, canon, seed=SUB_SEED))
+            # drains ``batches`` in place so the originals free even
+            # though execute()'s frame still references the lists
+            return split_to_spillables(batches, pid_fn, k, mgr)
+
+        l_slices = split(l_list, self.left_keys, self.children[0].schema)
+        r_slices = split(r_list, self.right_keys,
+                         self.children[1].schema)
+        for i in range(k):
+            # inner/semi emit only matched left rows: an empty side means
+            # an empty pair output (left/anti/full still must run to emit
+            # their preserved side)
+            if (jt in ("inner", "left_semi")
+                    and (not l_slices[i] or not r_slices[i])):
+                for s in l_slices[i] + r_slices[i]:
+                    s.close()
+                continue
+            if not l_slices[i] and jt in ("left", "left_anti"):
+                for s in r_slices[i]:
+                    s.close()
+                continue
+            pair_bytes = (sum(s.nbytes for s in l_slices[i])
+                          + sum(s.nbytes for s in r_slices[i]))
+            # clamped: one pair can exceed a tiny budget after pow-2
+            # padding; full-pool pressure is the reservation's ceiling
+            with mgr.transient(min(2 * max(pair_bytes, 1), mgr.budget)):
+                lb = _concat_or_empty(
+                    self.children[0].schema,
+                    [s.get() for s in l_slices[i]])
+                rb = _concat_or_empty(
+                    self.children[1].schema,
+                    [s.get() for s in r_slices[i]])
+                with self.timer():
+                    yield from self._merge_join(lb, rb, jt)
+                for s in l_slices[i] + r_slices[i]:
+                    s.close()
 
     def _apply_condition(self, batch: DeviceBatch) -> DeviceBatch:
         """Residual condition as a fused mask over the join output (its

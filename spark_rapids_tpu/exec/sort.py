@@ -1,13 +1,26 @@
-"""Sort execs (global sort; device lexicographic sort on orderable keys).
+"""Sort execs (device lexicographic sort; out-of-core range sort).
 
-[REF: sql-plugin/../GpuSortExec.scala :: GpuSortExec, SortUtils.scala] —
-the reference calls cuDF's multi-key radix/merge sort; here the device
-sort is one stable ``lax.sort`` over the orderable key limbs from
-ops/ordering.py (direction and null placement baked into the encoding),
-with the whole partition coalesced first (RequireSingleBatch goal, as the
-reference's total-order sort requires).  Out-of-core (spill-merge) sort is
-a later phase (SURVEY §2.1 #16).
-"""
+[REF: sql-plugin/../GpuSortExec.scala :: GpuSortExec,
+ GpuOutOfCoreSortIterator, SortUtils.scala] — the reference calls cuDF's
+multi-key radix/merge sort, spilling sorted runs and merging for
+oversized partitions; here the device sort is one stable ``lax.sort``
+over the orderable key limbs from ops/ordering.py (direction and null
+placement baked into the encoding).
+
+Out-of-core re-design (TPU-idiom — a k-way streaming merge is
+scatter/branch hostile): **sample-based range partitioning**, the same
+scheme Spark uses for total-order range exchanges:
+
+  1. sample encoded key limbs from every input batch (device gather,
+     host quantile pick → R-1 boundary rows),
+  2. each input batch gets a range id per row (vectorized lexicographic
+     binary search against the boundaries), is sliced per range, and the
+     slices register with the HBM arbiter as spillables,
+  3. ranges are restored one at a time, concatenated and sorted — the
+     output streams as R ordered batches, peak HBM ≈ one range.
+
+Engaged when the arbiter cannot reserve the single-batch working set
+(RetryOOM), exactly like the aggregate's split-retry."""
 
 from __future__ import annotations
 
@@ -77,7 +90,9 @@ def _concat_host(schema, batches: List[H.HostBatch]) -> H.HostBatch:
 
 
 class TpuSortExec(TpuExec):
-    """[REF: GpuSortExec] — single lax.sort over encoded key limbs."""
+    """[REF: GpuSortExec + GpuOutOfCoreSortIterator] — single lax.sort
+    over encoded key limbs; range-partitioned out-of-core path when the
+    whole partition won't fit the budget (see module docstring)."""
 
     def __init__(self, orders: Sequence[SortOrder], child: TpuExec):
         super().__init__(child.schema, child)
@@ -90,15 +105,103 @@ class TpuSortExec(TpuExec):
         return 1
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.runtime.memory import RetryOOM, get_manager
         child = self.children[0]
         batches = [compact(b) for p in range(child.num_partitions())
                    for b in child.execute(p)]
         if not batches:
             return
+        mgr = get_manager()
+        total = sum(b.nbytes() for b in batches)
+        try:
+            # in-core: input + sorted copy live together
+            with mgr.transient(2 * total):
+                with self.timer():
+                    merged = concat_device_batches(self.schema, batches)
+                    out = sort_batch(merged, self.orders)
+                self.metric("numOutputBatches").add(1)
+                yield out
+                return
+        except RetryOOM:
+            self.metric("outOfCoreSorts").add(1)
+        yield from self._out_of_core(batches, total, mgr)
+
+    def _out_of_core(self, batches: List[DeviceBatch], total: int, mgr
+                     ) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu.parallel.shuffle import split_to_spillables
+        orders = self.orders
+        # ranges sized so one range (~2x working set) fits the budget
+        per_range = max(mgr.budget // 4, 1)
+        nranges = max(2, min(64, int(np.ceil(total / per_range))))
+        bounds = _sample_boundaries(batches, orders, nranges)
         with self.timer():
-            merged = concat_device_batches(self.schema, batches)
-            yield sort_batch(merged, self.orders)
-        self.metric("numOutputBatches").add(1)
+            # drains ``batches`` in place so the originals free even
+            # though execute()'s frame still references the list
+            slices = split_to_spillables(
+                batches, lambda b: _range_ids(b, orders, bounds),
+                nranges, mgr)
+        for r in range(nranges):
+            if not slices[r]:
+                continue
+            range_bytes = sum(sp.nbytes for sp in slices[r])
+            # reserving the range's working set pressures OTHER ranges'
+            # slices out to host — the actual spill trigger.  Clamped to
+            # the budget: pow-2 slice padding can push one range's
+            # working set past a tiny budget, and full-pool pressure is
+            # the most a reservation can achieve anyway.
+            with mgr.transient(min(2 * range_bytes, mgr.budget)):
+                with self.timer():
+                    parts = [sp.get() for sp in slices[r]]
+                    merged = concat_device_batches(self.schema, parts)
+                    out = sort_batch(merged, orders)
+                    for sp in slices[r]:
+                        sp.close()
+            self.metric("numOutputBatches").add(1)
+            yield out
+
+
+def _encode_key_limbs(batch: DeviceBatch, orders: Sequence[SortOrder]
+                      ) -> List[jnp.ndarray]:
+    """Fused orderable limbs of the sort keys (dead rows NOT flagged —
+    callers mask separately)."""
+    parts = []
+    for o in orders:
+        c = o.expr.eval_tpu(batch)
+        parts.extend(ORD.column_order_parts(c, o.ascending, o.nulls_first))
+    return ORD.fuse_parts(parts)
+
+
+def _sample_boundaries(batches: List[DeviceBatch],
+                       orders: Sequence[SortOrder], nranges: int
+                       ) -> List[np.ndarray]:
+    """Sample live rows' key limbs, host-sort, pick range quantiles.
+    Returns per-limb boundary arrays uint64[nranges-1]."""
+    oversample = 8
+    samples = []  # [limbs][chunks]
+    for b in batches:
+        limbs = _encode_key_limbs(b, orders)
+        live_idx = jnp.nonzero(b.sel, size=min(b.capacity, 1024),
+                               fill_value=0)[0]
+        take = max(1, (nranges * oversample) // max(len(batches), 1))
+        idx = live_idx[:take]
+        samples.append([np.asarray(jnp.take(l, idx)) for l in limbs])
+    nlimbs = len(samples[0])
+    cols = [np.concatenate([s[i] for s in samples]) for i in
+            range(nlimbs)]
+    order = np.lexsort(list(reversed(cols)))
+    n = len(order)
+    picks = [order[min(n - 1, (i + 1) * n // nranges)]
+             for i in range(nranges - 1)]
+    return [c[picks] for c in cols]
+
+
+def _range_ids(batch: DeviceBatch, orders: Sequence[SortOrder],
+               bounds: List[np.ndarray]) -> jnp.ndarray:
+    """Range id per row: lexicographic searchsorted against boundaries."""
+    from spark_rapids_tpu.exec.join import _lex_search
+    limbs = _encode_key_limbs(batch, orders)
+    blimbs = [jnp.asarray(b) for b in bounds]
+    return _lex_search(blimbs, limbs, "right").astype(jnp.int32)
 
 
 def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]

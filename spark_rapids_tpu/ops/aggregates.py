@@ -141,3 +141,85 @@ class First(AggregateFunction):
 
     def buffer_dtypes(self):
         return [self.input_dtype]
+
+
+class _VarianceBase(AggregateFunction):
+    """Variance family over a sum-of-squares buffer decomposition.
+
+    [REF: aggregate/GpuStddev/GpuVariance — cuDF M2 buffers there]
+    TPU re-design: buffers are (Σx, Σx², n) — plain "sum" kinds that ride
+    the existing segment-reduce/merge protocol (a joint Welford/M2 merge
+    would need a multi-column combine the scan kernels don't have).
+    Trade-off vs Spark's Welford: catastrophic cancellation for
+    |mean| >> stddev data; tests compare with float tolerance.
+    """
+
+    buffer_kinds = ["sum", "sum", "sum"]  # Σx, Σx², valid n
+    ddof = 1          # sample by default
+    sqrt_final = False
+
+    @property
+    def result_dtype(self):
+        return T.DoubleT
+
+    def buffer_dtypes(self):
+        return [T.DoubleT, T.DoubleT, T.LongT]
+
+
+class VarianceSamp(_VarianceBase):
+    name = "var_samp"
+    ddof = 1
+
+
+class VariancePop(_VarianceBase):
+    name = "var_pop"
+    ddof = 0
+
+
+class StddevSamp(_VarianceBase):
+    name = "stddev_samp"
+    ddof = 1
+    sqrt_final = True
+
+
+class StddevPop(_VarianceBase):
+    name = "stddev_pop"
+    ddof = 0
+    sqrt_final = True
+
+
+class CountDistinct(AggregateFunction):
+    """count(DISTINCT x) — planner-rewritten into a two-level aggregate
+    (dedup groupby on (keys, x) below a plain count), so it never reaches
+    the kernels.  [REF: Spark's RewriteDistinctAggregates]"""
+
+    name = "count_distinct"
+
+    @property
+    def result_dtype(self):
+        return T.LongT
+
+
+class CollectList(AggregateFunction):
+    """collect_list(x) → array<x> — each group's values in input order.
+
+    Device design (TPU-idiom, mirrors the string layout): the result
+    column is a padded element matrix [G, Lmax] + lengths, produced
+    scatter-free from the sorted-groupby order (each group's rows are
+    contiguous after the stable key sort, so group g's list is one
+    gather from its start offset).  Lmax is the pow-2 bucket of the
+    largest group (one host sync, like the join's output sizing).
+    Whole-aggregation runs single-kernel over the gathered input
+    (no partial/merge: merging variable-length buffers needs a
+    re-collect, deferred).  [REF: GpuCollectList]
+    """
+
+    name = "collect_list"
+    buffer_kinds = ["collect"]
+
+    @property
+    def result_dtype(self):
+        return T.ArrayType(self.input_dtype)
+
+    def buffer_dtypes(self):
+        return [self.result_dtype]

@@ -23,7 +23,7 @@ the bucket just grows.
 
 from __future__ import annotations
 
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -62,8 +62,13 @@ def _hash_f64_tpu_safe(data: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_pid_fn(keys: Sequence[Expression], nparts: int,
-                canon_int64: Sequence[bool] = ()):
+                canon_int64: Sequence[bool] = (),
+                seed: Optional[int] = None):
     """batch → int32 partition ids via the bit-exact Spark murmur3.
+
+    ``seed`` overrides the Spark shuffle seed — join sub-partitioning
+    re-hashes with a DIFFERENT seed so rows of one exchange partition
+    spread across sub-partitions [REF: GpuSubPartitionHashJoin].
 
     ``canon_int64[i]`` widens key i's int-family column to int64 before
     hashing — needed when the two sides of a join carry different int
@@ -76,9 +81,10 @@ def make_pid_fn(keys: Sequence[Expression], nparts: int,
     (NormalizeFloatingNumbers), so equal keys MUST land on one device.
     """
     canon = tuple(canon_int64) or (False,) * len(keys)
+    seed_v = HH.SEED if seed is None else seed
 
     def pids(batch: DeviceBatch) -> jnp.ndarray:
-        h = jnp.full((batch.capacity,), HH.SEED, jnp.uint32)
+        h = jnp.full((batch.capacity,), jnp.uint32(seed_v), jnp.uint32)
         for e, widen in zip(keys, canon):
             c = e.eval_tpu(batch)
             dt = c.dtype
@@ -198,6 +204,34 @@ def shard_batch(mesh: jax.sharding.Mesh, batch: DeviceBatch) -> DeviceBatch:
     sharding = jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(axis))
     return jax.device_put(batch, sharding)
+
+
+def split_to_spillables(batches, ids_fn, nbuckets: int, mgr):
+    """Slice every batch by bucket id and register each slice as an
+    unreserved spillable (the out-of-core sort/join spill pool).
+
+    CONSUMES ``batches`` in place (front pop): an upstream generator
+    frame usually still references the same list object, so an in-place
+    drain is the only way the original batches actually free as their
+    slices are carved — `del` in the callee would just drop an alias.
+    Front pop keeps concat order identical to the in-core path (stable
+    sorts break ties by input order)."""
+    from spark_rapids_tpu.columnar.column import compact
+    from spark_rapids_tpu.runtime.memory import SpillableBatch
+    out = [[] for _ in range(nbuckets)]
+    while batches:
+        b = batches.pop(0)
+        ids = ids_fn(b)
+        for i in range(nbuckets):
+            part = compact(b.with_sel(b.sel & (ids == i)))
+            n = part.num_rows_host()
+            if n == 0:
+                continue
+            cap = max(8, 1 << (n - 1).bit_length())
+            if cap < part.capacity:
+                part = slice_batch(part, 0, cap)
+            out[i].append(SpillableBatch(part, mgr, reserve=False))
+    return out
 
 
 def slice_batch(batch: DeviceBatch, lo: int, cap: int) -> DeviceBatch:

@@ -256,7 +256,11 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
 
 
 _AGG_MAP = {"sum": A.Sum, "min": A.Min, "max": A.Max, "count": A.Count,
-            "avg": A.Average, "first": A.First}
+            "avg": A.Average, "first": A.First,
+            "var_samp": A.VarianceSamp, "var_pop": A.VariancePop,
+            "stddev_samp": A.StddevSamp, "stddev_pop": A.StddevPop,
+            "count_distinct": A.CountDistinct,
+            "collect_list": A.CollectList}
 
 
 def resolve_aggregate(u: UExpr, schema: T.StructType
@@ -278,10 +282,16 @@ def resolve_aggregate(u: UExpr, schema: T.StructType
     if kind == "sum" and isinstance(child.dtype,
                                     (T.FloatType,)):
         child = cast_to(child, T.DoubleT)
+    if kind in ("var_samp", "var_pop", "stddev_samp", "stddev_pop"):
+        if not T.is_numeric(child.dtype):
+            raise AnalysisException(f"{kind} needs a numeric input")
+        child = cast_to(child, T.DoubleT)
     cls = _AGG_MAP.get(kind)
     if cls is None:
         raise AnalysisException(f"unsupported aggregate '{kind}'")
     fn = cls(child)
+    if kind == "count_distinct":
+        return fn, alias or f"count(DISTINCT {u.children[0]})"
     return fn, alias or f"{kind}({u.children[0]})"
 
 

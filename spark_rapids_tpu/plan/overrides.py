@@ -52,6 +52,20 @@ def is_device_supported_type(dt: T.DataType) -> Optional[str]:
     return f"type {dt.simple_name} not supported on device"
 
 
+def is_device_supported_output_type(dt: T.DataType) -> Optional[str]:
+    """Exec OUTPUT columns additionally allow array<primitive> — the
+    collect_list result (padded element matrix + lengths, D2H-convertible)
+    — while expressions over arrays stay unsupported."""
+    if isinstance(dt, T.ArrayType):
+        et = dt.element_type
+        if isinstance(et, (T.ArrayType, T.MapType, T.StructType,
+                           T.StringType, T.BinaryType, T.DecimalType)):
+            return (f"array element type {et.simple_name} not supported "
+                    "on device")
+        return None
+    return is_device_supported_type(dt)
+
+
 # ---------------------------------------------------------------------------
 # Meta: per-node tagging state
 # ---------------------------------------------------------------------------
@@ -89,7 +103,7 @@ class ExecMeta:
                 f"exec {rule.name} disabled by "
                 f"spark.rapids.sql.exec.{rule.name}=false")
         for f in self.cpu.schema.fields:
-            r = is_device_supported_type(f.dtype)
+            r = is_device_supported_output_type(f.dtype)
             if r:
                 self.will_not_work(f"output column '{f.name}': {r}")
         rule.tag(self)
@@ -210,7 +224,8 @@ EXEC_RULES[B.CpuUnionExec] = ExecRule(
 def _tag_aggregate(meta: ExecMeta):
     from spark_rapids_tpu.exec.aggregate import CpuAggregateExec
     from spark_rapids_tpu.ops.aggregates import (
-        Average, Count, CountStar, First, Max, Min, Sum)
+        Average, CollectList, Count, CountStar, First, Max, Min, Sum,
+        _VarianceBase)
     cpu: CpuAggregateExec = meta.cpu
     meta.tag_expressions(cpu.grouping)
     for fn in cpu.fns:
@@ -219,7 +234,7 @@ def _tag_aggregate(meta: ExecMeta):
                 "sum under spark.sql.ansi.enabled=true: device sum wraps "
                 "on overflow (non-ANSI) — CPU fallback")
         if not isinstance(fn, (Sum, Min, Max, Count, CountStar, Average,
-                               First)):
+                               First, _VarianceBase, CollectList)):
             meta.will_not_work(
                 f"aggregate function {fn.name} has no TPU implementation")
             continue
@@ -230,14 +245,30 @@ def _tag_aggregate(meta: ExecMeta):
             meta.will_not_work(
                 f"{fn.name} over {fn.input_dtype.simple_name} input not yet "
                 "supported on device (string agg buffers)")
+        if isinstance(fn, _VarianceBase) and not T.is_numeric(
+                fn.input_dtype):
+            meta.will_not_work(f"{fn.name} needs a numeric input")
+        if isinstance(fn, CollectList):
+            if not cpu.grouping:
+                meta.will_not_work(
+                    "global collect_list (no grouping keys) not on "
+                    "device yet")
+            if isinstance(fn.input_dtype,
+                          (T.StringType, T.BinaryType, T.DecimalType,
+                           T.ArrayType)):
+                meta.will_not_work(
+                    f"collect_list over {fn.input_dtype.simple_name} not "
+                    "on device yet (element matrix is numeric-only)")
 
 
 def _convert_aggregate(cpu, ch, conf):
     from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.exec.distributed import ici_active
+    from spark_rapids_tpu.ops.aggregates import CollectList
     has_nans = bool(conf.get(C.HAS_NANS))
-    if ici_active(conf) and cpu.grouping:
+    has_collect = any(isinstance(f, CollectList) for f in cpu.fns)
+    if ici_active(conf) and cpu.grouping and not has_collect:
         # distributed: {partial agg → hash exchange on keys → final agg}
         # — one SPMD all_to_all per shuffle stage (SURVEY §5.8)
         from spark_rapids_tpu.exec.distributed import (
@@ -436,6 +467,12 @@ def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     if isinstance(plan, TpuExec):
         plan = DeviceToHostExec(plan)
     plan = insert_coalesce(plan, conf)
+    from spark_rapids_tpu import conf as C
+    lore_tag = str(conf.get(C.LORE_TAG)).strip()
+    if lore_tag:
+        from spark_rapids_tpu.utils.lore import install_lore_taps
+        plan = install_lore_taps(plan, lore_tag,
+                                 str(conf.get(C.LORE_DUMP_PATH)))
     result = OverrideResult(plan, metas)
 
     explain = conf.explain

@@ -60,6 +60,11 @@ class SpillableBatch:
         # True only while this batch's host copy is counted in the
         # manager's _host_used (a disk restore staged in _host is NOT)
         self._host_accounted = False
+        # True while the device bytes are counted in _reserved —
+        # reserve=False registrations (e.g. out-of-core slices carved
+        # from already-materialized inputs) must not release bytes they
+        # never claimed
+        self._device_accounted = reserve
         self.schema = batch.schema
         self.compacted = batch.compacted
         self.nbytes = batch.nbytes()
@@ -91,7 +96,10 @@ class SpillableBatch:
         self._host = ([np.asarray(x) for x in leaves], treedef)
         self._batch = None
         self._host_accounted = True
-        self._mgr._on_spill(self, self.nbytes)
+        was_accounted = self._device_accounted
+        self._device_accounted = False
+        self._mgr._on_spill(self, self.nbytes,
+                            release_device=was_accounted)
         return self.nbytes
 
     def spill_to_disk(self) -> int:
@@ -130,6 +138,7 @@ class SpillableBatch:
             self._disk_path = None
         leaves, treedef = self._host
         self._mgr.reserve(self.nbytes, _restoring=self)
+        self._device_accounted = True
         self._batch = jax.tree.unflatten(
             treedef, [jax.numpy.asarray(x) for x in leaves])
         self._host = None
@@ -159,10 +168,19 @@ class DeviceMemoryManager:
                  host_limit: int = 4 << 30,
                  spill_path: str = "/tmp/tpuq-spill",
                  inject_oom_at: int = -1,
-                 retry_max_attempts: int = 8):
+                 retry_max_attempts: int = 8,
+                 debug: bool = False):
         self.retry_max_attempts = retry_max_attempts
         self._lock = threading.RLock()
         self._spillables: Dict[int, SpillableBatch] = {}
+        # leak tracker [REF: cudf MemoryCleaner]: with debug on, every
+        # registration records its creation stack; unreleased handles
+        # are reported at shutdown / replacement (LEAK DETECTED)
+        self.debug = debug
+        self._origins: Dict[int, str] = {}
+        if debug:
+            import atexit
+            atexit.register(self.report_leaks)
         self._reserved = 0
         self._host_used = 0
         self.host_limit = host_limit
@@ -239,11 +257,37 @@ class DeviceMemoryManager:
     def _register(self, s: SpillableBatch) -> None:
         with self._lock:
             self._spillables[id(s)] = s
+            if self.debug:
+                import traceback
+                self._origins[id(s)] = "".join(
+                    traceback.format_stack(limit=12)[:-2])
+
+    def leaked(self, include_pinned: bool = False) -> List[tuple]:
+        """(batch, origin-stack) for every never-closed registration.
+        The scan cache is a deliberate long-lived pool — excluded unless
+        ``include_pinned`` (its entries close on eviction)."""
+        from spark_rapids_tpu.exec.basic import _scan_cache
+        pinned = {id(sp) for entries in _scan_cache.values()
+                  for pairs in entries.values() for sp, _ in pairs}
+        with self._lock:
+            return [(s, self._origins.get(i, "<enable memory.gpu.debug "
+                                             "for stacks>"))
+                    for i, s in self._spillables.items()
+                    if include_pinned or i not in pinned]
+
+    def report_leaks(self) -> int:
+        leaks = self.leaked()
+        for s, origin in leaks:
+            print(f"LEAK DETECTED: spillable batch {s.nbytes} B "
+                  f"(tier={s.tier}) never closed; created at:\n{origin}")
+        return len(leaks)
 
     def _unregister(self, s: SpillableBatch) -> None:
         with self._lock:
             self._spillables.pop(id(s), None)
-            if s.tier == "device":
+            self._origins.pop(id(s), None)
+            if s.tier == "device" and s._device_accounted:
+                s._device_accounted = False
                 self.release(s.nbytes)
             elif s._host_accounted:
                 # symmetric with _on_spill: host-tier bytes leave the
@@ -252,9 +296,11 @@ class DeviceMemoryManager:
                 s._host_accounted = False
                 self._host_used = max(0, self._host_used - s.nbytes)
 
-    def _on_spill(self, s: SpillableBatch, nbytes: int) -> None:
+    def _on_spill(self, s: SpillableBatch, nbytes: int,
+                  release_device: bool = True) -> None:
         with self._lock:
-            self.release(nbytes)
+            if release_device:
+                self.release(nbytes)
             self._host_used += nbytes
             self.metrics["spillToHostBytes"] += nbytes
             while self._host_used > self.host_limit:
@@ -292,10 +338,11 @@ def get_manager(conf=None) -> DeviceMemoryManager:
         elif conf is not None:
             cfg = _build(conf)
             if (cfg.budget, cfg.host_limit, cfg._inject_at,
-                    cfg.retry_max_attempts, cfg.spill_path) != (
+                    cfg.retry_max_attempts, cfg.spill_path,
+                    cfg.debug) != (
                     _manager.budget, _manager.host_limit,
                     _manager._inject_at, _manager.retry_max_attempts,
-                    _manager.spill_path):
+                    _manager.spill_path, _manager.debug):
                 # a new manager orphans batches registered with the old
                 # one — evict the device-resident scan cache so nothing
                 # keeps accounting against the dead arbiter
@@ -322,6 +369,7 @@ def _build(conf) -> DeviceMemoryManager:
         spill_path=conf.get(C.SPILL_PATH),
         inject_oom_at=conf.get(C.FAULT_INJECT),
         retry_max_attempts=conf.get(C.RETRY_MAX),
+        debug=str(conf.get(C.MEMORY_DEBUG)).upper() == "STDOUT",
     )
 
 

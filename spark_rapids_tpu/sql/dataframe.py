@@ -375,10 +375,21 @@ class DataFrame:
         return result.plan
 
     def toArrow(self) -> pa.Table:
+        import contextlib
+        from spark_rapids_tpu import conf as C
         conf = self.session.rapids_conf()
         plan = self._execute_plan()
         self._last_plan = plan
-        tables = self._pump_partitions(plan, conf)
+        profile = contextlib.nullcontext()
+        if conf.get(C.PROFILE_ENABLED):
+            # per-query xplane capture [REF: spark-rapids-jni profiler]
+            import jax
+            import os
+            path = str(conf.get(C.PROFILE_PATH))
+            os.makedirs(path, exist_ok=True)
+            profile = jax.profiler.trace(path)
+        with profile:
+            tables = self._pump_partitions(plan, conf)
         if not tables:
             return pa.table(
                 {f.name: pa.array([], type=T.to_arrow(f.dtype))
@@ -477,16 +488,58 @@ class GroupedData:
         self.names = names
 
     def agg(self, *aggs) -> DataFrame:
+        from spark_rapids_tpu.ops.aggregates import CountDistinct
         fns = []
-        fields = [T.StructField(n, g.dtype)
-                  for n, g in zip(self.names, self.grouping)]
+        names = []
         for a in aggs:
             fn, name = AN.resolve_aggregate(_to_column(a)._u, self.df.schema)
             fns.append(fn)
-            fields.append(T.StructField(name, fn.result_dtype))
+            names.append(name)
+        if any(isinstance(f, CountDistinct) for f in fns):
+            return self._agg_distinct(fns, names)
+        fields = [T.StructField(n, g.dtype)
+                  for n, g in zip(self.names, self.grouping)]
+        fields += [T.StructField(n, f.result_dtype)
+                   for n, f in zip(names, fns)]
         schema = T.StructType(tuple(fields))
         return DataFrame(self.df.session, L.Aggregate(
             self.df._plan, self.grouping, fns, schema))
+
+    def _agg_distinct(self, fns, names) -> DataFrame:
+        """count(DISTINCT x): Spark's RewriteDistinctAggregates shape —
+        a dedup groupby on (keys, x) feeding a plain count.
+
+        [REF: Spark RewriteDistinctAggregates; the reference accelerates
+        the same two-level plan]"""
+        from spark_rapids_tpu.ops.aggregates import CountDistinct
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        if not all(isinstance(f, CountDistinct) for f in fns):
+            raise AN.AnalysisException(
+                "mixing distinct and non-distinct aggregates in one "
+                "agg() is not yet supported")
+        if len(fns) != 1:
+            raise AN.AnalysisException(
+                "multiple count(DISTINCT) aggregates in one agg() are "
+                "not yet supported")
+        fn = fns[0]
+        nk = len(self.grouping)
+        inner_fields = [T.StructField(f"k{i}", g.dtype)
+                        for i, g in enumerate(self.grouping)]
+        inner_fields.append(T.StructField("_dv", fn.child.dtype))
+        inner_schema = T.StructType(tuple(inner_fields))
+        inner = L.Aggregate(self.df._plan,
+                            list(self.grouping) + [fn.child], [],
+                            inner_schema)
+        from spark_rapids_tpu.ops.aggregates import Count
+        outer_grouping = [BoundReference(i, g.dtype)
+                          for i, g in enumerate(self.grouping)]
+        outer_fn = Count(BoundReference(nk, fn.child.dtype))
+        fields = [T.StructField(n, g.dtype)
+                  for n, g in zip(self.names, self.grouping)]
+        fields.append(T.StructField(names[0], T.LongT))
+        schema = T.StructType(tuple(fields))
+        return DataFrame(self.df.session, L.Aggregate(
+            inner, outer_grouping, [outer_fn], schema))
 
     def count(self) -> DataFrame:
         from spark_rapids_tpu.sql import functions as F

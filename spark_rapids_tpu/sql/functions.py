@@ -121,6 +121,25 @@ def countDistinct(c) -> Column:
     return Column(UExpr("agg", "count_distinct", (_cu(c),)))
 
 
+count_distinct = countDistinct
+
+
+def _agg1(kind):
+    def fn(c) -> Column:
+        return Column(UExpr("agg", kind, (_cu(c),)))
+    fn.__name__ = kind
+    return fn
+
+
+var_samp = _agg1("var_samp")
+var_pop = _agg1("var_pop")
+stddev_samp = _agg1("stddev_samp")
+stddev_pop = _agg1("stddev_pop")
+variance = var_samp
+stddev = stddev_samp
+collect_list = _agg1("collect_list")
+
+
 # window functions ----------------------------------------------------------
 
 def row_number() -> Column:

@@ -1,0 +1,196 @@
+"""Aggregate breadth: variance/stddev, count(DISTINCT), collect_list.
+
+[REF: integration_tests hash_aggregate_test.py]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+def _t(n=3000, seed=21, nulls=True):
+    rng = np.random.default_rng(seed)
+    v = rng.uniform(-100, 100, n)
+    vals = [None if nulls and i % 13 == 0 else float(v[i])
+            for i in range(n)]
+    return pa.table({
+        "k": pa.array(rng.integers(0, 20, n)),
+        "v": pa.array(vals, pa.float64()),
+        "i": pa.array(rng.integers(-50, 50, n).astype(np.int32)),
+    })
+
+
+@pytest.mark.parametrize("fn,name", [
+    (F.var_samp, "var_samp"), (F.var_pop, "var_pop"),
+    (F.stddev_samp, "stddev_samp"), (F.stddev_pop, "stddev_pop")])
+def test_variance_family_grouped(fn, name):
+    t = _t()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            fn(F.col("v")).alias("r")),
+        ignore_order=True, approx_float=True)
+
+
+def test_variance_global():
+    t = _t()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.var_samp(F.col("v")).alias("vs"),
+            F.stddev_pop(F.col("v")).alias("sp")),
+        approx_float=True)
+
+
+def test_variance_single_row_groups():
+    """var_samp of a 1-row group = NaN; var_pop = 0.0 (Spark)."""
+    t = pa.table({"k": pa.array([1, 2, 3]),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.var_samp(F.col("v")).alias("vs"),
+            F.var_pop(F.col("v")).alias("vp")),
+        ignore_order=True, approx_float=True)
+
+
+def test_variance_all_null_group_is_null():
+    t = pa.table({"k": pa.array([1, 1, 2]),
+                  "v": pa.array([None, None, 5.0], pa.float64())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.stddev_samp(F.col("v")).alias("sd")),
+        ignore_order=True, approx_float=True)
+
+
+def test_variance_int_input():
+    t = _t()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.variance(F.col("i")).alias("r")),
+        ignore_order=True, approx_float=True)
+
+
+def test_variance_distributed():
+    t = _t(4000)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.stddev(F.col("v")).alias("sd"),
+            F.sum("v").alias("sv")),
+        ignore_order=True, approx_float=True,
+        conf={"spark.rapids.shuffle.mode": "ICI"})
+
+
+# -- count distinct ----------------------------------------------------------
+
+def test_count_distinct_grouped():
+    t = _t()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.countDistinct(F.col("i")).alias("cd")),
+        ignore_order=True)
+
+
+def test_count_distinct_global():
+    t = _t()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.countDistinct(F.col("i")).alias("cd")))
+
+
+def test_count_distinct_ignores_nulls():
+    t = pa.table({"k": pa.array([1, 1, 1, 2]),
+                  "x": pa.array([5, 5, None, None], pa.int64())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.countDistinct(F.col("x")).alias("cd")),
+        ignore_order=True)
+
+
+def test_count_distinct_on_device():
+    t = _t()
+    s = tpu_session({})
+    df = s.createDataFrame(t).groupBy("k").agg(
+        F.countDistinct(F.col("i")).alias("cd"))
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    rc = s.rapids_conf()
+    tree = apply_overrides(plan_physical(df._plan, rc), rc).plan.tree_string()
+    assert tree.count("TpuHashAggregate") == 2, tree  # dedup + count
+
+
+def test_count_distinct_mixing_rejected():
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    t = _t(100)
+    s = tpu_session({})
+    with pytest.raises(AnalysisException):
+        s.createDataFrame(t).groupBy("k").agg(
+            F.countDistinct(F.col("i")), F.sum("v"))
+
+
+def test_distinct_still_works():
+    t = pa.table({"a": pa.array([1, 1, 2, 2, 3]),
+                  "b": pa.array(["x", "x", "y", "z", "z"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).distinct(), ignore_order=True)
+
+
+# -- collect_list ------------------------------------------------------------
+
+def test_collect_list_grouped():
+    t = _t(800)
+    c, tp = assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.collect_list(F.col("i")).alias("xs")),
+        ignore_order=True)
+    assert any(len(r["xs"]) > 1 for r in tp.to_pylist())
+
+
+def test_collect_list_skips_nulls_empty_ok():
+    t = pa.table({"k": pa.array([1, 1, 2, 3, 3]),
+                  "x": pa.array([7, None, None, 1, 2], pa.int64())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.collect_list(F.col("x")).alias("xs")),
+        ignore_order=True)
+
+
+def test_collect_list_with_other_aggs():
+    t = _t(500)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.count("*").alias("c"),
+            F.collect_list(F.col("i")).alias("xs"),
+            F.max("i").alias("mx")),
+        ignore_order=True)
+
+
+def test_collect_list_double_elements():
+    t = _t(400)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.collect_list(F.col("v")).alias("xs")),
+        ignore_order=True, approx_float=True)
+
+
+def test_collect_list_on_device():
+    t = _t(300)
+    s = tpu_session({})
+    df = s.createDataFrame(t).groupBy("k").agg(
+        F.collect_list(F.col("i")).alias("xs"))
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    rc = s.rapids_conf()
+    tree = apply_overrides(plan_physical(df._plan, rc), rc).plan.tree_string()
+    assert "TpuHashAggregate" in tree, tree
+
+
+def test_collect_list_string_falls_back():
+    t = pa.table({"k": pa.array([1, 1, 2]),
+                  "s": pa.array(["a", "b", "c"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.collect_list(F.col("s")).alias("xs")),
+        ignore_order=True,
+        allow_non_tpu=["HashAggregate", "InMemoryScan"])

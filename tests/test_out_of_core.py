@@ -1,0 +1,130 @@
+"""Out-of-core sort and join sub-partitioning under a tight budget.
+
+VERDICT r2 #6 'done' criterion: operator tests pass with poolSize forced
+below working-set size, actually exercising spill
+(spillToHostBytes > 0).  [REF: GpuOutOfCoreSortIterator,
+GpuSubPartitionHashJoin]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.runtime import memory as M
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_manager():
+    M.reset_manager()
+    from spark_rapids_tpu.exec.basic import clear_scan_cache
+    clear_scan_cache()
+    yield
+    M.reset_manager()
+    clear_scan_cache()
+
+
+def _sort_table(n=60_000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": pa.array(rng.integers(-10**6, 10**6, n)),
+        "b": pa.array(rng.uniform(-1000, 1000, n)),
+    })
+
+
+def _find(node, name):
+    if type(node).__name__ == name:
+        return node
+    for c in node.children:
+        r = _find(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+def test_out_of_core_sort_matches_oracle_and_spills():
+    t = _sort_table()
+    # table ~960 KB; budget 400 KB forces the range-partitioned path
+    pool = 400 << 10
+    conf = {"spark.rapids.tpu.memory.poolSize": pool,
+            "spark.rapids.tpu.batchRows": 8192}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("a", "b"),
+        conf=conf, approx_float=True)
+    mgr = M.get_manager()
+    assert mgr.metrics["spillToHostBytes"] > 0, mgr.metrics
+
+
+def test_out_of_core_sort_streams_multiple_batches():
+    t = _sort_table(40_000, seed=5)
+    s = tpu_session({"spark.rapids.tpu.memory.poolSize": 300 << 10,
+                     "spark.rapids.tpu.batchRows": 8192})
+    df = s.createDataFrame(t).orderBy("a")
+    out = df.toArrow()
+    assert out.column("a").to_pylist() == sorted(t.column("a").to_pylist())
+    sort_node = _find(df._last_plan, "TpuSortExec")
+    assert sort_node.metric("outOfCoreSorts").value == 1
+    assert sort_node.metric("numOutputBatches").value > 1
+
+
+def test_in_core_sort_unchanged_with_room():
+    t = _sort_table(5000, seed=6)
+    s = tpu_session({})
+    df = s.createDataFrame(t).orderBy("a")
+    df.toArrow()
+    sort_node = _find(df._last_plan, "TpuSortExec")
+    assert sort_node.metric("outOfCoreSorts").value == 0
+    assert sort_node.metric("numOutputBatches").value == 1
+
+
+def _join_tables(n=40_000, m=20_000, seed=9):
+    rng = np.random.default_rng(seed)
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 5000, n)),
+        "v": pa.array(rng.uniform(-10, 10, n)),
+    })
+    right = pa.table({
+        "k": pa.array(rng.integers(0, 6000, m)),
+        "w": pa.array(rng.integers(-100, 100, m)),
+    })
+    return left, right
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "full", "left_semi",
+                                 "left_anti"])
+def test_sub_partitioned_join_matches_oracle(how):
+    l, r = _join_tables()
+    conf = {"spark.rapids.tpu.memory.poolSize": 500 << 10,
+            "spark.sql.autoBroadcastJoinThreshold": 0,
+            "spark.rapids.tpu.batchRows": 8192}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            how),
+        conf=conf, ignore_order=True, approx_float=True)
+
+
+def test_sub_partitioned_join_spills_and_counts():
+    l, r = _join_tables(seed=11)
+    s = tpu_session({"spark.rapids.tpu.memory.poolSize": 500 << 10,
+                     "spark.sql.autoBroadcastJoinThreshold": 0,
+                     "spark.rapids.tpu.batchRows": 8192})
+    df = s.createDataFrame(l).join(s.createDataFrame(r), "k", "inner")
+    out = df.toArrow()
+    assert out.num_rows > 0
+    j = _find(df._last_plan, "TpuSortMergeJoinExec")
+    assert j.metric("subPartitionJoins").value == 1
+    mgr = M.get_manager()
+    assert mgr.metrics["spillToHostBytes"] > 0, mgr.metrics
+
+
+def test_sub_partitioned_right_join():
+    l, r = _join_tables(seed=13)
+    conf = {"spark.rapids.tpu.memory.poolSize": 500 << 10,
+            "spark.sql.autoBroadcastJoinThreshold": 0}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            "right"),
+        conf=conf, ignore_order=True, approx_float=True)
