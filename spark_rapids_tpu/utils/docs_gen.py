@@ -1,0 +1,119 @@
+"""Documentation generators — docs never drift from the registries.
+
+[REF: RapidsConf.scala :: doc-gen main (configs.md);
+ TypeChecks.scala :: supported_ops.md generation]
+
+Run:  python -m spark_rapids_tpu.utils.docs_gen [out_dir]
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import sys
+
+
+def generate_supported_ops_md() -> str:
+    """Exec + expression + aggregate support tables from the live
+    registries (same coupling the reference keeps: the rule table IS the
+    doc source)."""
+    from spark_rapids_tpu.ops import aggregates as A
+    from spark_rapids_tpu.ops import datetime_ops as D
+    from spark_rapids_tpu.ops import expressions as E
+    from spark_rapids_tpu.ops import hashing as HH
+    from spark_rapids_tpu.ops import strings as S
+    from spark_rapids_tpu.plan import overrides as O
+
+    O._register_lazy_rules()
+    lines = [
+        "# Supported operators",
+        "",
+        "Generated from the rule/expression registries "
+        "(`python -m spark_rapids_tpu.utils.docs_gen`) — do not edit.",
+        "",
+        "Every exec and expression below also has a per-op kill switch: "
+        "`spark.rapids.sql.exec.<Name>=false` / "
+        "`spark.rapids.sql.expression.<Name>=false`.",
+        "",
+        "## Execs",
+        "",
+        "| Exec | Description |",
+        "|---|---|",
+    ]
+    seen = set()
+    for rule in O.EXEC_RULES.values():
+        if rule.name in seen:
+            continue
+        seen.add(rule.name)
+        lines.append(f"| {rule.name} | {rule.desc} |")
+    lines += [
+        "",
+        "## Expressions",
+        "",
+        "| Expression | Notes |",
+        "|---|---|",
+    ]
+    mods = (E, S, D, HH)
+    rows = []
+    for mod in mods:
+        for name, cls in sorted(vars(mod).items()):
+            if (not inspect.isclass(cls)
+                    or not issubclass(cls, E.Expression)
+                    or cls is E.Expression or name.startswith("_")):
+                continue
+            if cls.__module__ != mod.__name__:
+                continue
+            if (not hasattr(cls, "eval_tpu")
+                    or cls.eval_tpu is E.Expression.eval_tpu):
+                continue
+            notes = []
+            if getattr(cls, "incompat", None):
+                notes.append(
+                    f"INCOMPAT ({cls.incompat}); needs "
+                    "`spark.rapids.sql.incompatibleOps.enabled=true`")
+            if getattr(cls, "ansi_sensitive", False):
+                notes.append("falls back under `spark.sql.ansi.enabled`")
+            rows.append((name, "; ".join(notes)))
+    for name, notes in sorted(set(rows)):
+        lines.append(f"| {name} | {notes} |")
+    lines += [
+        "",
+        "## Aggregate functions",
+        "",
+        "| Function | Notes |",
+        "|---|---|",
+    ]
+    agg_notes = {
+        "count_distinct": "planner-rewritten to a two-level aggregate",
+        "collect_list": "grouped only; numeric elements; whole-partition "
+                        "kernel (no partial/merge)",
+        "var_samp": "sum-of-squares buffers (float tolerance vs Welford)",
+        "var_pop": "sum-of-squares buffers",
+        "stddev_samp": "sum-of-squares buffers",
+        "stddev_pop": "sum-of-squares buffers",
+        "first": "input order within this engine's batches",
+        "sum": "falls back under ANSI mode (wrap-on-overflow kernels)",
+    }
+    for name, cls in sorted(vars(A).items()):
+        if (not inspect.isclass(cls)
+                or not issubclass(cls, A.AggregateFunction)
+                or cls is A.AggregateFunction or name.startswith("_")):
+            continue
+        fn_name = cls.name
+        lines.append(f"| {fn_name} | {agg_notes.get(fn_name, '')} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(out_dir: str = "docs"):
+    from spark_rapids_tpu.conf import generate_configs_md
+    os.makedirs(out_dir, exist_ok=True)
+    with open(os.path.join(out_dir, "configs.md"), "w") as f:
+        f.write(generate_configs_md())
+    with open(os.path.join(out_dir, "supported_ops.md"), "w") as f:
+        f.write(generate_supported_ops_md())
+    print(f"wrote {out_dir}/configs.md and {out_dir}/supported_ops.md")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "docs")

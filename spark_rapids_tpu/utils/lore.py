@@ -1,0 +1,197 @@
+"""LORE — local replay of a single operator from dumped input batches.
+
+[REF: sql-plugin/../lore/ :: GpuLore, GpuLoreDumpExec, GpuLoreReplayExec]
+— the reference's best debugging tool (SURVEY §5.4 "build this early"):
+tag a plan node, dump its input batches + meta while the query runs, then
+re-run JUST that operator offline from the dump.
+
+Usage:
+    conf: spark.rapids.sql.lore.tag=TpuSortMergeJoinExec
+          spark.rapids.sql.lore.dumpPath=/tmp/dump
+    ... run the failing query ...
+    from spark_rapids_tpu.utils import lore
+    table = lore.replay("/tmp/dump/TpuSortMergeJoinExec-0")
+
+Dump layout: one directory per tagged node instance —
+    meta.json                   node string, child schemas/partitions
+    node.pkl                    the exec skeleton (children stripped)
+    child<i>-part<p>-<j>.parquet  input batches in arrival order
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import pickle
+import re
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.exec.base import CpuExec, ExecNode, TpuExec
+
+
+class LoreTapExec(TpuExec):
+    """Pass-through child wrapper that dumps every batch it forwards."""
+
+    def __init__(self, child: TpuExec, dump_dir: str, child_idx: int):
+        super().__init__(child.schema, child)
+        self.dump_dir = dump_dir
+        self.child_idx = child_idx
+
+    def node_string(self):
+        return f"LoreTap [child={self.child_idx} → {self.dump_dir}]"
+
+    def execute(self, partition: int) -> Iterator:
+        from spark_rapids_tpu.columnar.column import device_to_host
+        j = 0
+        for b in self.children[0].execute(partition):
+            tbl = device_to_host(b)
+            path = os.path.join(
+                self.dump_dir,
+                f"child{self.child_idx}-part{partition}-{j}.parquet")
+            pq.write_table(tbl, path)
+            j += 1
+            yield b
+
+
+def _strip_for_pickle(node: ExecNode):
+    """Copy the node without children/metrics/caches; None if the node
+    holds unpicklable state (replay then returns just the inputs)."""
+    import copy
+    clone = copy.copy(node)
+    clone._children = ()
+    clone.metrics = {}
+    for attr in ("_mat_lock", "_lock", "_materialized", "_result",
+                 "_cached", "_shuffle_id", "_map_parts"):
+        if hasattr(clone, attr):
+            try:
+                setattr(clone, attr, None)
+            except AttributeError:
+                pass
+    try:
+        pickle.dumps(clone)
+        return clone
+    except Exception:
+        return None
+
+
+def install_lore_taps(plan: ExecNode, tag: str, base_path: str
+                      ) -> ExecNode:
+    """Wrap every exec named ``tag``'s children with dump taps."""
+    counter = [0]
+
+    def walk(node: ExecNode) -> ExecNode:
+        node._children = tuple(walk(c) for c in node.children)
+        if type(node).__name__ == tag:
+            d = os.path.join(base_path, f"{tag}-{counter[0]}")
+            counter[0] += 1
+            os.makedirs(d, exist_ok=True)
+            meta = {
+                "node": node.node_string(),
+                "cls": type(node).__name__,
+                "children": [
+                    {"schema": _schema_json(c.schema),
+                     "num_partitions": c.num_partitions()}
+                    for c in node.children],
+            }
+            with open(os.path.join(d, "meta.json"), "w") as f:
+                json.dump(meta, f, indent=2)
+            skel = _strip_for_pickle(node)
+            if skel is not None:
+                with open(os.path.join(d, "node.pkl"), "wb") as f:
+                    pickle.dump(skel, f)
+            node._children = tuple(
+                LoreTapExec(c, d, i) if isinstance(c, TpuExec) else c
+                for i, c in enumerate(node.children))
+        return node
+
+    return walk(plan)
+
+
+def _schema_json(schema: T.StructType) -> list:
+    return [{"name": f.name, "type": f.dtype.simple_name} for f in
+            schema.fields]
+
+
+class LoreReplayScan(TpuExec):
+    """Feeds dumped parquet batches back as device batches."""
+
+    def __init__(self, dump_dir: str, child_idx: int,
+                 schema: T.StructType, num_partitions: int):
+        super().__init__(schema)
+        self.dump_dir = dump_dir
+        self.child_idx = child_idx
+        self._nparts = num_partitions
+
+    def num_partitions(self) -> int:
+        return self._nparts
+
+    def execute(self, partition: int) -> Iterator:
+        from spark_rapids_tpu.columnar.column import host_to_device
+        pat = os.path.join(
+            self.dump_dir,
+            f"child{self.child_idx}-part{partition}-*.parquet")
+
+        def order(p):
+            return int(re.search(r"-(\d+)\.parquet$", p).group(1))
+
+        for path in sorted(glob.glob(pat), key=order):
+            tbl = pq.read_table(path)
+            b = host_to_device(tbl)
+            yield type(b)(self.schema, b.columns, b.sel, b.compacted)
+
+
+def replay(dump_dir: str) -> pa.Table:
+    """Re-run the dumped operator over its dumped inputs → result table.
+
+    Falls back to returning the concatenated child-0 inputs when the
+    exec skeleton was not picklable (meta.json says what it was)."""
+    from spark_rapids_tpu.columnar import host as H
+    with open(os.path.join(dump_dir, "meta.json")) as f:
+        meta = json.load(f)
+    scans = []
+    for i, child in enumerate(meta["children"]):
+        fields = tuple(
+            T.StructField(c["name"], _type_from_simple(c["type"]))
+            for c in child["schema"])
+        scans.append(LoreReplayScan(dump_dir, i, T.StructType(fields),
+                                    child["num_partitions"]))
+    pkl = os.path.join(dump_dir, "node.pkl")
+    if not os.path.exists(pkl):
+        tables = [H.to_arrow_table(b) for p in
+                  range(scans[0].num_partitions())
+                  for b in scans[0].execute(p)]
+        return pa.concat_tables(tables)
+    with open(pkl, "rb") as f:
+        node = pickle.load(f)
+    node._children = tuple(scans)
+    node.metrics = {}
+    from spark_rapids_tpu.columnar.column import device_to_host
+    tables = []
+    for p in range(node.num_partitions()):
+        for b in node.execute(p):
+            tables.append(device_to_host(b))
+    return pa.concat_tables(tables)
+
+
+_SIMPLE_TYPES = None
+
+
+def _type_from_simple(name: str) -> T.DataType:
+    global _SIMPLE_TYPES
+    if _SIMPLE_TYPES is None:
+        _SIMPLE_TYPES = {
+            t.simple_name: t for t in (
+                T.BooleanT, T.ByteT, T.ShortT, T.IntegerT, T.LongT,
+                T.FloatT, T.DoubleT, T.StringT, T.BinaryT, T.DateT,
+                T.TimestampT, T.NullT)}
+    if name in _SIMPLE_TYPES:
+        return _SIMPLE_TYPES[name]
+    m = re.match(r"decimal\((\d+),(\d+)\)", name)
+    if m:
+        return T.DecimalType(int(m.group(1)), int(m.group(2)))
+    raise ValueError(f"cannot parse type {name!r} from LORE meta")

@@ -1,0 +1,101 @@
+"""LORE dump/replay, leak tracker, per-query profiler capture.
+
+[REF: lore/, cudf MemoryCleaner, spark-rapids-jni profiler]
+"""
+
+import glob
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.harness import tpu_session
+
+
+def _t(n=2000, seed=1):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 30, n)),
+        "v": pa.array(rng.uniform(-10, 10, n)),
+    })
+
+
+def test_lore_dump_and_replay_aggregate(tmp_path):
+    """A tagged aggregate's inputs dump to parquet; replay re-runs the
+    exec offline and reproduces the query's result (r2 verdict #9's
+    'seeded failing operator reproduced offline' criterion)."""
+    t = _t()
+    dump = str(tmp_path / "lore")
+    s = tpu_session({"spark.rapids.sql.lore.tag": "TpuHashAggregateExec",
+                     "spark.rapids.sql.lore.dumpPath": dump})
+    df = s.createDataFrame(t).groupBy("k").agg(F.sum("v").alias("sv"))
+    expected = sorted(map(repr, df.toArrow().to_pylist()))
+    dirs = sorted(glob.glob(os.path.join(dump, "TpuHashAggregateExec-*")))
+    assert dirs, "no LORE dump written"
+    d = dirs[0]
+    assert os.path.exists(os.path.join(d, "meta.json"))
+    assert glob.glob(os.path.join(d, "child0-part*.parquet"))
+
+    from spark_rapids_tpu.utils import lore
+    replayed = lore.replay(d)
+    got = sorted(map(repr, replayed.to_pylist()))
+    assert got == expected
+
+
+def test_lore_dump_join_inputs(tmp_path):
+    t = _t(500)
+    r = pa.table({"k": pa.array([1, 2, 3]), "w": pa.array([10, 20, 30])})
+    dump = str(tmp_path / "lore2")
+    s = tpu_session({"spark.rapids.sql.lore.tag": "TpuSortMergeJoinExec",
+                     "spark.rapids.sql.lore.dumpPath": dump,
+                     "spark.sql.autoBroadcastJoinThreshold": 0})
+    df = s.createDataFrame(t).join(s.createDataFrame(r), "k", "inner")
+    expected = sorted(map(repr, df.toArrow().to_pylist()))
+    d = sorted(glob.glob(os.path.join(dump, "TpuSortMergeJoinExec-*")))[0]
+    # both join children dumped
+    assert glob.glob(os.path.join(d, "child0-part*.parquet"))
+    assert glob.glob(os.path.join(d, "child1-part*.parquet"))
+    from spark_rapids_tpu.utils import lore
+    got = sorted(map(repr, lore.replay(d).to_pylist()))
+    assert got == expected
+
+
+def test_leak_tracker_reports_unclosed(tmp_path):
+    from spark_rapids_tpu.runtime.memory import (
+        DeviceMemoryManager, SpillableBatch)
+    from spark_rapids_tpu.columnar.column import host_to_device
+    mgr = DeviceMemoryManager(budget=1 << 30, debug=True)
+    b = host_to_device(_t(100))
+    sp = SpillableBatch(b, mgr)
+    leaks = mgr.leaked()
+    assert len(leaks) == 1
+    assert "test_observability" in leaks[0][1]  # creation stack recorded
+    assert mgr.report_leaks() == 1
+    sp.close()
+    assert mgr.leaked() == []
+
+
+def test_leak_tracker_excludes_scan_cache():
+    from spark_rapids_tpu.runtime import memory as M
+    M.reset_manager()
+    s = tpu_session({"spark.rapids.memory.gpu.debug": "STDOUT"})
+    df = s.createDataFrame(_t(1000)).groupBy("k").count()
+    df.toArrow()
+    mgr = M.get_manager()
+    # scan-cache registrations are pinned, not leaks
+    assert mgr.leaked() == []
+    M.reset_manager()
+
+
+def test_profiler_capture_writes_trace(tmp_path):
+    prof = str(tmp_path / "prof")
+    s = tpu_session({"spark.rapids.profile.enabled": True,
+                     "spark.rapids.profile.path": prof})
+    df = s.createDataFrame(_t(500)).filter(F.col("v") > 0).groupBy(
+        "k").count()
+    out = df.toArrow()
+    assert out.num_rows > 0
+    captured = glob.glob(os.path.join(prof, "**", "*"), recursive=True)
+    assert any(os.path.isfile(p) for p in captured), captured
