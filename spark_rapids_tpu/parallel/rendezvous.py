@@ -1,0 +1,252 @@
+"""Multi-executor shuffle rendezvous — the collective/task impedance fix.
+
+[REF: sql-plugin/../shuffle/ucx/ :: RapidsShuffleServer/Client — the
+reference's executors pull shuffle blocks point-to-point, so reduce tasks
+start independently.  SURVEY §5.8 names the TPU inversion "the hardest
+novel piece": an ICI ``all_to_all`` needs EVERY participant to enter the
+same XLA program, but Spark schedules executor tasks independently.]
+
+Design (docs/rendezvous.md has the full write-up):
+
+* One **coordinator** (driver-side): a tiny TCP service holding per-stage
+  registration state.  Its one primitive is ``allgather(stage, payload)``
+  — a barrier that returns every participant's payload.  Used twice per
+  shuffle stage:
+    1. shape agreement: local per-partition row counts → everyone
+       computes the same global pow-2 ``cap`` (the static all_to_all
+       shape — XLA programs must hash identically across processes);
+    2. entry barrier: once agreed, every executor calls the SAME jitted
+       ``{layout → all_to_all}`` program over the global mesh; the
+       actual data rides XLA's cross-process collective (gloo on CPU
+       hosts, ICI on a TPU pod slice).
+* **Executors**: `DistributedShuffleExecutor` wraps
+  ``jax.distributed.initialize`` (global mesh spanning processes — each
+  process addresses only its local devices) + the rendezvous client +
+  the batch-general shuffle programs from parallel/shuffle.py, which
+  work unchanged over a multi-process mesh.
+* **Failure policy** (SURVEY §5.3: a hung collective wedges the slice):
+  every rendezvous has a deadline; the coordinator fails ALL waiters of
+  an incomplete stage so every executor aborts together instead of a
+  subset entering a collective that can never complete.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    data = json.dumps(obj).encode()
+    sock.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket):
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = sock.recv(4 - len(hdr))
+        if not chunk:
+            raise ConnectionError("rendezvous peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(n - len(data))
+        if not chunk:
+            raise ConnectionError("rendezvous peer closed")
+        data += chunk
+    return json.loads(data)
+
+
+class RendezvousTimeout(RuntimeError):
+    """Stage did not assemble before the deadline — slice-wide abort."""
+
+
+class _Stage:
+    def __init__(self, expected: int):
+        self.expected = expected
+        self.payloads: Dict[int, Any] = {}
+        self.cv = threading.Condition()
+        self.failed: Optional[str] = None
+
+
+class RendezvousCoordinator:
+    """Driver-side rendezvous service (the MapOutputTracker analog for
+    collective entry).  Thread-per-connection TCP; message = one JSON
+    request {stage, pid, payload, timeout} → {ok, payloads | error}."""
+
+    def __init__(self, num_processes: int, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.num_processes = num_processes
+        self._stages: Dict[str, _Stage] = {}
+        self._lock = threading.Lock()
+        coord = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    req = _recv_msg(self.request)
+                    resp = coord._handle(req)
+                    _send_msg(self.request, resp)
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = "{}:{}".format(*self._server.server_address[:2])
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def _handle(self, req) -> dict:
+        stage_id = req["stage"]
+        pid = req["pid"]
+        timeout = float(req.get("timeout", 60.0))
+        with self._lock:
+            st = self._stages.setdefault(
+                stage_id, _Stage(self.num_processes))
+        deadline = time.monotonic() + timeout
+        with st.cv:
+            if pid in st.payloads:
+                return {"ok": False,
+                        "error": f"pid {pid} registered twice for "
+                                 f"{stage_id}"}
+            st.payloads[pid] = req.get("payload")
+            if len(st.payloads) == st.expected:
+                st.cv.notify_all()
+            else:
+                while (len(st.payloads) < st.expected
+                       and st.failed is None):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not st.cv.wait(
+                            timeout=min(remaining, 1.0)):
+                        if time.monotonic() >= deadline:
+                            # fail EVERY waiter: nobody may enter the
+                            # collective alone
+                            st.failed = (
+                                f"stage {stage_id}: only "
+                                f"{len(st.payloads)}/{st.expected} "
+                                "executors arrived before the deadline")
+                            st.cv.notify_all()
+                            break
+            if st.failed is not None:
+                return {"ok": False, "error": st.failed}
+            payloads = [st.payloads[i] for i in range(st.expected)]
+        return {"ok": True, "payloads": payloads}
+
+    def shutdown(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RendezvousClient:
+    def __init__(self, address: str, pid: int):
+        host, port = address.rsplit(":", 1)
+        self.host, self.port = host, int(port)
+        self.pid = pid
+
+    def allgather(self, stage_id: str, payload=None,
+                  timeout: float = 60.0) -> List[Any]:
+        with socket.create_connection((self.host, self.port),
+                                      timeout=timeout + 10) as sock:
+            _send_msg(sock, {"stage": stage_id, "pid": self.pid,
+                             "payload": payload, "timeout": timeout})
+            resp = _recv_msg(sock)
+        if not resp.get("ok"):
+            raise RendezvousTimeout(resp.get("error", "rendezvous failed"))
+        return resp["payloads"]
+
+    def barrier(self, stage_id: str, timeout: float = 60.0) -> None:
+        self.allgather(stage_id, None, timeout)
+
+
+class DistributedShuffleExecutor:
+    """One executor process of a multi-process shuffle slice.
+
+    Wraps jax.distributed init (global mesh over all processes' devices)
+    and runs rendezvous-coordinated collective shuffle stages with the
+    SAME batch-general programs the single-process ICI exchange uses."""
+
+    def __init__(self, coordinator_addr: str, rendezvous_addr: str,
+                 process_id: int, num_processes: int):
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator_addr,
+            num_processes=num_processes, process_id=process_id)
+        import numpy as np
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.devices = jax.devices()          # global
+        self.local_devices = jax.local_devices()
+        self.mesh = jax.sharding.Mesh(np.array(self.devices), ("x",))
+        self.client = RendezvousClient(rendezvous_addr, process_id)
+
+    @property
+    def nparts(self) -> int:
+        return len(self.devices)
+
+    def shuffle_stage(self, stage_id: str, local_shards, schema, keys,
+                      timeout: float = 60.0):
+        """Run one collective shuffle stage.
+
+        ``local_shards``: one DeviceBatch per LOCAL device (uniform
+        capacity, committed to that device).  Returns one received
+        DeviceBatch per local device (that device's hash partition).
+        """
+        import jax
+        import numpy as np
+
+        from spark_rapids_tpu.parallel import shuffle as SH
+        from spark_rapids_tpu.columnar.column import round_up_pow2
+        d = self.nparts
+        # 1. local counts (plain per-device jit, no collective)
+        pid_fn = SH.make_pid_fn(keys, d)
+        cnt = jax.jit(lambda b: SH.local_partition_counts(
+            b, pid_fn(b), d))
+        local_max = 0
+        for shard in local_shards:
+            local_max = max(local_max,
+                            int(np.asarray(cnt(shard)).max()))
+        # 2. SHAPE AGREEMENT through the rendezvous: the all_to_all cap
+        #    must be identical in every process or the XLA programs
+        #    (and their collectives) won't match
+        counts = self.client.allgather(
+            stage_id + ":counts", local_max, timeout)
+        cap = round_up_pow2(max(max(counts), 1), 8)
+        # 3. assemble the global array from every process's local shards
+        sharding = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec("x"))
+        flat = [jax.tree.flatten(s) for s in local_shards]
+        treedef = flat[0][1]
+        local_b = flat[0][0][0].shape[0]
+        leaves = []
+        for i in range(len(flat[0][0])):
+            arrs = [flat[k][0][i] for k in range(len(local_shards))]
+            shape = (d * local_b,) + arrs[0].shape[1:]
+            leaves.append(jax.make_array_from_single_device_arrays(
+                shape, sharding, arrs))
+        sharded = jax.tree.unflatten(treedef, leaves)
+        # 4. entry barrier, then the collective program (identical
+        #    everywhere: same cap, same keys, same mesh)
+        self.client.barrier(stage_id + ":enter", timeout)
+        fn = SH.build_shuffle_program(self.mesh, keys, d, cap)
+        result = fn(sharded)
+        # 5. split back into per-local-device received batches
+        out = []
+        res_leaves, res_def = jax.tree.flatten(result)
+        for dev in self.local_devices:
+            dev_leaves = []
+            for leaf in res_leaves:
+                shard = next(s for s in leaf.addressable_shards
+                             if s.device == dev)
+                dev_leaves.append(shard.data)
+            out.append(jax.tree.unflatten(res_def, dev_leaves))
+        return out

@@ -1,0 +1,177 @@
+"""Multi-PROCESS rendezvous shuffle tests (2 processes × 2 CPU devices).
+
+The deterministic multi-node shuffle test the reference lacks (SURVEY
+§4.2): real OS processes, a real coordinator, jax.distributed collectives
+over the cross-process mesh.
+"""
+
+import multiprocessing as mp
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.parallel.rendezvous import (
+    RendezvousClient, RendezvousCoordinator, RendezvousTimeout)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# ---------------------------------------------------------------------------
+# coordinator unit tests (in-process)
+# ---------------------------------------------------------------------------
+
+def test_allgather_returns_all_payloads():
+    coord = RendezvousCoordinator(num_processes=3)
+    out = [None] * 3
+
+    def run(pid):
+        c = RendezvousClient(coord.address, pid)
+        out[pid] = c.allgather("s1", {"pid": pid, "v": pid * 10})
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    for pid in range(3):
+        assert [p["v"] for p in out[pid]] == [0, 10, 20]
+    coord.shutdown()
+
+
+def test_rendezvous_timeout_fails_all_waiters():
+    coord = RendezvousCoordinator(num_processes=2)
+    c = RendezvousClient(coord.address, 0)
+    t0 = time.monotonic()
+    with pytest.raises(RendezvousTimeout):
+        c.allgather("never", 1, timeout=1.5)
+    assert time.monotonic() - t0 < 10
+    coord.shutdown()
+
+
+def test_duplicate_registration_rejected():
+    coord = RendezvousCoordinator(num_processes=2)
+
+    def second():
+        RendezvousClient(coord.address, 1).allgather("dup", 1, timeout=20)
+
+    t = threading.Thread(target=second)
+    c = RendezvousClient(coord.address, 0)
+    res = [None]
+
+    def first():
+        res[0] = c.allgather("dup", 0, timeout=20)
+
+    t1 = threading.Thread(target=first)
+    t1.start()
+    time.sleep(0.2)
+    with pytest.raises(RendezvousTimeout):
+        RendezvousClient(coord.address, 0).allgather("dup", 99,
+                                                     timeout=2)
+    t.start()
+    t1.join(timeout=30)
+    t.join(timeout=30)
+    assert res[0] == [0, 1]
+    coord.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# full multi-process shuffle stage
+# ---------------------------------------------------------------------------
+
+def _worker(pid, nprocs, jax_port, rdv_addr, q):
+    try:
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        from spark_rapids_tpu.parallel.rendezvous import (
+            DistributedShuffleExecutor)
+        ex = DistributedShuffleExecutor(
+            f"127.0.0.1:{jax_port}", rdv_addr, pid, nprocs)
+
+        import jax.numpy as jnp
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.columnar.column import host_to_device
+        from spark_rapids_tpu.ops.expressions import BoundReference
+
+        rng = np.random.default_rng(pid)
+        local_shards = []
+        rows = []
+        per = 64
+        for li, dev in enumerate(ex.local_devices):
+            k = rng.integers(0, 37, per)
+            gidx = pid * len(ex.local_devices) + li
+            # globally unique values → row-conservation check is exact
+            v = gidx * 1_000_000 + np.arange(per) * 100 + k
+            rows.extend(zip(k.tolist(), v.tolist()))
+            tbl = pa.table({"k": pa.array(k), "v": pa.array(v)})
+            b = host_to_device(tbl, bucket=per)
+            local_shards.append(jax.device_put(b, dev))
+        keys = [BoundReference(0, T.LongT)]
+        outs = ex.shuffle_stage("stage-7", local_shards,
+                                local_shards[0].schema, keys)
+        got = []
+        for li, ob in enumerate(outs):
+            sel = np.asarray(ob.sel)
+            kk = np.asarray(ob.columns[0].data)[sel]
+            vv = np.asarray(ob.columns[1].data)[sel]
+            gpid = pid * len(ex.local_devices) + li
+            got.append((gpid, kk.tolist(), vv.tolist()))
+        q.put(("ok", pid, rows, got))
+    except Exception as e:  # pragma: no cover
+        import traceback
+        q.put(("err", pid, traceback.format_exc(), None))
+
+
+def test_multiprocess_shuffle_stage():
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    nprocs = 2
+    jax_port = _free_port()
+    coord = RendezvousCoordinator(num_processes=nprocs)
+    procs = [ctx.Process(target=_worker,
+                         args=(i, nprocs, jax_port, coord.address, q))
+             for i in range(nprocs)]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nprocs):
+            results.append(q.get(timeout=240))
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        coord.shutdown()
+    errs = [r for r in results if r[0] == "err"]
+    assert not errs, errs[0][2]
+
+    all_rows = sorted(r for res in results for r in res[2])
+    received = {}
+    key_home = {}
+    for res in results:
+        for gpid, ks, vs in res[3]:
+            for k, v in zip(ks, vs):
+                received.setdefault((k, v), 0)
+                received[(k, v)] += 1
+                # every key lands on exactly one global partition
+                assert key_home.setdefault(k, gpid) == gpid, (
+                    f"key {k} split across partitions")
+    assert sorted(received) == all_rows
+    assert all(c == 1 for c in received.values())
+    # murmur3 partitioning is deterministic — both processes agree
+    from spark_rapids_tpu.ops import hashing as HH
+    from spark_rapids_tpu.columnar import dtypes as T
+    for k, home in key_home.items():
+        assert home == HH.spark_hash_py([k], [T.LongT]) % 4
