@@ -70,17 +70,30 @@ class DataFrameReader:
         read_opts = pacsv.ReadOptions(
             autogenerate_column_names=not header)
         convert = pacsv.ConvertOptions()
+        if self._schema is not None:
+            # user schema drives column types (and names when headerless)
+            if not header:
+                read_opts = pacsv.ReadOptions(
+                    column_names=self._schema.field_names())
+            convert = pacsv.ConvertOptions(column_types={
+                f.name: T.to_arrow(f.dtype)
+                for f in self._schema.fields})
         tables = [pacsv.read_csv(p, read_options=read_opts,
                                  convert_options=convert) for p in paths]
         tbl = pa.concat_tables(tables, promote_options="permissive")
-        if not header:
+        if not header and self._schema is None:
             tbl = tbl.rename_columns(
                 [f"_c{i}" for i in range(tbl.num_columns)])
         return self.session.createDataFrame(tbl)
 
     def json(self, path):
         paths = _expand(path)
-        tables = [pajson.read_json(p) for p in paths]
+        parse = pajson.ParseOptions()
+        if self._schema is not None:
+            parse = pajson.ParseOptions(explicit_schema=pa.schema(
+                [(f.name, T.to_arrow(f.dtype))
+                 for f in self._schema.fields]))
+        tables = [pajson.read_json(p, parse_options=parse) for p in paths]
         tbl = pa.concat_tables(tables, promote_options="permissive")
         return self.session.createDataFrame(tbl)
 

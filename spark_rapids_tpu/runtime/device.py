@@ -44,12 +44,38 @@ def ensure_initialized() -> None:
         cache_dir = os.environ.get(
             "SPARK_RAPIDS_TPU_XLA_CACHE",
             os.path.expanduser("~/.cache/spark_rapids_tpu/xla_cache"))
-        if cache_dir:
+        # The persistent cache exists for TPU compile times (minutes per
+        # sort kernel).  On the CPU platform it is DISABLED: XLA:CPU AOT
+        # executables carry target pseudo-features (+prefer-no-gather …)
+        # the loader's host check rejects, and reading such an entry
+        # SEGFAULTS the process (observed under the test suite's forced
+        # CPU platform — same machine, fresh cache).
+        # resolved backend, not the config string — jax_platforms is
+        # None when jax auto-selects, which is exactly the no-TPU host
+        # case that must NOT get a persistent cache
+        on_cpu = jax.default_backend() == "cpu"
+        if cache_dir and not on_cpu:
+            cache_dir = os.path.join(cache_dir, _machine_fingerprint())
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs", 1.0)
         _initialized = True
+
+
+def _machine_fingerprint() -> str:
+    """Short hash of the host's CPU feature flags."""
+    import hashlib
+    import platform
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha1(
+                        line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    return platform.machine()
 
 
 def device_count() -> int:

@@ -54,3 +54,18 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
 
 def cache_stats() -> Tuple[int,]:
     return (len(_CACHE),)
+
+
+def clear() -> None:
+    """Drop every cached kernel wrapper AND jax's compiled executables.
+
+    Needed by long single-process runs on the CPU platform: XLA:CPU
+    JIT-compiled executables accumulate in code memory, and past a few
+    hundred live programs LLVM's emitter can crash the process during a
+    NEW compilation (observed as a SIGSEGV inside
+    ``backend_compile_and_load`` late in the test suite).  Clearing
+    between test modules bounds live executables; kernels lazily
+    recompile on next use."""
+    with _CACHE_LOCK:
+        _CACHE.clear()
+    jax.clear_caches()

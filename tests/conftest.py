@@ -30,3 +30,17 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng_seed():
     return 0
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_live_xla_programs():
+    """Clear kernel + jax executable caches after every test module.
+
+    XLA:CPU JIT code space is finite: with several hundred live compiled
+    programs in one process, a NEW compilation can SIGSEGV inside
+    LLVM's emitter (reproduced: full suite crashes in
+    test_window.py::test_running_aggregates_range_frame, any subset
+    passes).  Kernels recompile lazily, so this only costs time."""
+    yield
+    from spark_rapids_tpu.runtime import kernel_cache
+    kernel_cache.clear()

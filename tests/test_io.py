@@ -88,3 +88,32 @@ def test_json_round_trip(tmp_path):
     s.createDataFrame(t).write.mode("overwrite").json(out)
     back = s.read.json(out).toArrow()
     assert back.num_rows == 2
+
+
+def test_csv_reader_honors_schema(tmp_path):
+    """r1 advisor finding: .schema() must not be silently ignored."""
+    from spark_rapids_tpu.columnar import dtypes as T
+    p = tmp_path / "data.csv"
+    p.write_text("1,2.5,x\n3,4.5,y\n")
+    schema = T.StructType((
+        T.StructField("a", T.LongT), T.StructField("b", T.DoubleT),
+        T.StructField("c", T.StringT)))
+    s = tpu_session({})
+    df = s.read.schema(schema).csv(str(p))
+    assert df.schema.field_names() == ["a", "b", "c"]
+    assert [f.dtype.simple_name for f in df.schema.fields] == [
+        "long", "double", "string"]
+    assert df.toArrow().column("a").to_pylist() == [1, 3]
+
+
+def test_json_reader_honors_schema(tmp_path):
+    from spark_rapids_tpu.columnar import dtypes as T
+    p = tmp_path / "data.json"
+    p.write_text('{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}\n')
+    schema = T.StructType((
+        T.StructField("a", T.DoubleT), T.StructField("b", T.StringT)))
+    s = tpu_session({})
+    df = s.read.schema(schema).json(str(p))
+    assert [f.dtype.simple_name for f in df.schema.fields] == [
+        "double", "string"]
+    assert df.toArrow().column("a").to_pylist() == [1.0, 2.0]
