@@ -51,15 +51,19 @@ def round_up_pow2(n: int, min_bucket: int = 1024) -> int:
 class DeviceColumn:
     """One SQL column on device.
 
-    data:     jnp array [B] (fixed width types) or uint8 [B, W] (string/binary)
+    data:     jnp array [B] (fixed width types) or uint8 [B, W]
+              (string/binary) or elem[B, W] (array<numeric>)
     validity: jnp bool [B], True = valid; None = all valid
-    lengths:  jnp int32 [B] for string/binary; None otherwise
+    lengths:  jnp int32 [B] for string/binary/array; None otherwise
+    evalid:   jnp bool [B, W] element validity for array columns whose
+              elements may be null; None = all elements valid
     """
 
     dtype: T.DataType
     data: jax.Array
     validity: Optional[jax.Array] = None
     lengths: Optional[jax.Array] = None
+    evalid: Optional[jax.Array] = None
 
     @property
     def capacity(self) -> int:
@@ -75,14 +79,17 @@ class DeviceColumn:
         return self.validity
 
     def with_validity(self, validity: Optional[jax.Array]) -> "DeviceColumn":
-        return DeviceColumn(self.dtype, self.data, validity, self.lengths)
+        return DeviceColumn(self.dtype, self.data, validity, self.lengths,
+                            self.evalid)
 
     def gather(self, idx: jax.Array) -> "DeviceColumn":
         """Row gather (used by compaction, sort, join)."""
         data = jnp.take(self.data, idx, axis=0)
         validity = None if self.validity is None else jnp.take(self.validity, idx)
         lengths = None if self.lengths is None else jnp.take(self.lengths, idx)
-        return DeviceColumn(self.dtype, data, validity, lengths)
+        evalid = None if self.evalid is None else jnp.take(
+            self.evalid, idx, axis=0)
+        return DeviceColumn(self.dtype, data, validity, lengths, evalid)
 
     def nbytes(self) -> int:
         n = self.data.size * self.data.dtype.itemsize
@@ -90,16 +97,18 @@ class DeviceColumn:
             n += self.validity.size
         if self.lengths is not None:
             n += self.lengths.size * 4
+        if self.evalid is not None:
+            n += self.evalid.size
         return n
 
 
 def _col_flatten(c: DeviceColumn):
-    return (c.data, c.validity, c.lengths), c.dtype
+    return (c.data, c.validity, c.lengths, c.evalid), c.dtype
 
 
 def _col_unflatten(dtype, children):
-    data, validity, lengths = children
-    return DeviceColumn(dtype, data, validity, lengths)
+    data, validity, lengths, evalid = children
+    return DeviceColumn(dtype, data, validity, lengths, evalid)
 
 
 jax.tree_util.register_pytree_node(DeviceColumn, _col_flatten, _col_unflatten)
@@ -236,6 +245,39 @@ def _matrix_to_string(mat: np.ndarray, lengths: np.ndarray,
     )
 
 
+def _list_to_matrix(arr: pa.Array, elem_dt: T.DataType):
+    """Arrow list array -> (elem[B, W] padded matrix, int32 lengths,
+    optional bool[B, W] element validity)."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    if pa.types.is_large_list(arr.type):
+        arr = arr.cast(pa.list_(arr.type.value_type))
+    n = len(arr)
+    offs = np.asarray(arr.offsets)
+    lengths = (offs[1:] - offs[:-1]).astype(np.int32)
+    lengths = np.where(np.asarray(arr.is_null()), 0, lengths)
+    npdt = T.to_numpy_dtype(elem_dt)
+    fill = pa.scalar(False if isinstance(elem_dt, T.BooleanType) else 0,
+                     type=arr.type.value_type)
+    values = np.asarray(arr.values.fill_null(fill)).astype(npdt, copy=False)
+    evalues = None
+    if arr.values.null_count:
+        evalues = ~np.asarray(arr.values.is_null())
+    w = round_up_pow2(int(lengths.max()) if n else 1, 1)
+    mat = np.zeros((n, w), dtype=npdt)
+    emask = None if evalues is None else np.ones((n, w), dtype=bool)
+    total = int(lengths.sum())
+    if total:
+        row_idx = np.repeat(np.arange(n), lengths)
+        col_idx = (np.arange(total)
+                   - np.repeat(np.cumsum(lengths) - lengths, lengths))
+        src = (np.repeat(offs[:-1].astype(np.int64), lengths)
+               + col_idx)
+        mat[row_idx, col_idx] = values[src]
+        if emask is not None:
+            emask[row_idx, col_idx] = evalues[src]
+    return mat, lengths, emask
+
+
 def _decimal_to_int64(arr: pa.Array) -> np.ndarray:
     arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
     buf = arr.buffers()[1]
@@ -261,6 +303,17 @@ def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
             dt, jnp.asarray(mat),
             None if validity_np is None else jnp.asarray(validity_np),
             jnp.asarray(lengths),
+        )
+    if isinstance(dt, T.ArrayType):
+        # padded element matrix [B, W] + lengths — the same layout
+        # collect_list produces and Generate/explode consumes.  Element
+        # nulls ride in an optional [B, W] evalid plane.
+        mat, lengths, emask = _list_to_matrix(arr, dt.element_type)
+        return DeviceColumn(
+            dt, jnp.asarray(mat),
+            None if validity_np is None else jnp.asarray(validity_np),
+            jnp.asarray(lengths),
+            None if emask is None else jnp.asarray(emask),
         )
     if isinstance(dt, T.DecimalType):
         data = _decimal_to_int64(arr)
@@ -305,7 +358,10 @@ def _pad_col(c: DeviceColumn, bucket: int) -> DeviceColumn:
     lengths = c.lengths
     if lengths is not None:
         lengths = jnp.pad(lengths, (0, pad))
-    return DeviceColumn(c.dtype, data, validity, lengths)
+    evalid = c.evalid
+    if evalid is not None:
+        evalid = jnp.pad(evalid, ((0, pad), (0, 0)), constant_values=True)
+    return DeviceColumn(c.dtype, data, validity, lengths, evalid)
 
 
 def pad_batch(batch: DeviceBatch, capacity: int) -> DeviceBatch:
@@ -371,15 +427,19 @@ def device_to_host(batch: DeviceBatch, already_compact: bool = False) -> pa.Tabl
             offsets = np.zeros(n + 1, np.int32)
             np.cumsum(lengths, out=offsets[1:])
             total = int(offsets[-1])
+            emask_flat = None
             if total:
                 ii = np.repeat(np.arange(n), lengths)
                 jj = (np.arange(total)
                       - np.repeat(offsets[:-1].astype(np.int64), lengths))
                 values = mat[ii, jj]
+                if c.evalid is not None:
+                    emask_flat = ~np.asarray(c.evalid)[:n][ii, jj]
             else:
                 values = np.zeros(0, mat.dtype)
             elem = pa.array(values,
-                            type=T.to_arrow(f.dtype.element_type))
+                            type=T.to_arrow(f.dtype.element_type),
+                            mask=emask_flat)
             arr = pa.ListArray.from_arrays(pa.array(offsets), elem)
             if validity is not None and not validity.all():
                 arr = pa.ListArray.from_arrays(

@@ -529,9 +529,11 @@ def concat_device_batches(schema: T.StructType,
         parts_data = []
         parts_val = []
         parts_len = []
+        parts_ev = []
         any_val = (force_validity[ci] if force_validity is not None
                    else any(b.columns[ci].validity is not None
                             for b in batches))
+        any_ev = any(b.columns[ci].evalid is not None for b in batches)
         is_str = batches[0].columns[ci].is_string
         # min_width may be per-column (sequence) — a global min would pad
         # every string column to the schema's widest one
@@ -547,6 +549,13 @@ def concat_device_batches(schema: T.StructType,
                     d = jnp.pad(d, ((0, 0), (0, width - d.shape[1])))
                 parts_data.append(d)
                 parts_len.append(c.lengths[:n])
+                if any_ev:
+                    ev = (c.evalid[:n] if c.evalid is not None
+                          else jnp.ones((n, c.data.shape[1]), jnp.bool_))
+                    if ev.shape[1] < width:
+                        ev = jnp.pad(ev, ((0, 0), (0, width - ev.shape[1])),
+                                     constant_values=True)
+                    parts_ev.append(ev)
             else:
                 parts_data.append(c.data[:n])
             if any_val:
@@ -564,7 +573,11 @@ def concat_device_batches(schema: T.StructType,
         lengths = None
         if is_str:
             lengths = jnp.pad(jnp.concatenate(parts_len), (0, pad))
+        evalid = None
+        if any_ev:
+            evalid = jnp.pad(jnp.concatenate(parts_ev, axis=0),
+                             ((0, pad), (0, 0)), constant_values=True)
         cols.append(type(batches[0].columns[ci])(f.dtype, data, validity,
-                                                 lengths))
+                                                 lengths, evalid))
     sel = jnp.arange(bucket, dtype=jnp.int32) < total
     return DeviceBatch(schema, tuple(cols), sel)

@@ -1,17 +1,23 @@
-"""Parquet scan + write execs.
+"""File-source scan + write execs (parquet, orc).
 
 [REF: sql-plugin/../GpuParquetScan.scala :: GpuParquetMultiFilePartitionReader
- (MULTITHREADED / COALESCING / PERFILE), GpuParquetFileFormat (write)] —
+ (MULTITHREADED / COALESCING / PERFILE), GpuParquetFileFormat (write),
+ GpuOrcScan.scala, GpuFileSourceScanExec.scala (partition values,
+ input_file_name), GpuFileFormatDataWriter.scala (dynamic partitions)] —
 the reference decodes Parquet pages on GPU via libcudf; a TPU has no
 decompression engine (SURVEY §2.2 N6), so phase-1 keeps decode on host
-(pyarrow's C++ reader) and lands device-resident batches:
+(pyarrow's C++ readers) and lands device-resident batches:
 
 * MULTITHREADED analog: a thread pool reads+decodes files concurrently
   while the device consumes earlier batches (read-ahead overlap);
-* COALESCING analog: small files concatenate into one batch up to the
-  target batch size before H2D;
-* predicate/column pushdown: row-group pruning via pyarrow filters and
-  column projection (wired by the planner's pushdown pass when present).
+* predicate pushdown: row-group pruning against parquet column-chunk
+  min/max statistics (``prunedRowGroups`` metric); the Filter node above
+  re-applies the exact predicate, so pruning only ever has to be
+  conservative;
+* column pruning: the optimizer narrows the read set to referenced
+  columns (plan/optimizer.py);
+* hive-style partition values and input_file_name() are appended as
+  constant columns per file before H2D.
 """
 
 from __future__ import annotations
@@ -36,35 +42,124 @@ def parquet_schema(paths: Sequence[str]) -> T.StructType:
         T.StructField(f.name, T.from_arrow(f.type)) for f in s))
 
 
-def _partition_files(paths: Sequence[str], num_partitions: int
-                     ) -> List[List[str]]:
-    parts: List[List[str]] = [[] for _ in range(num_partitions)]
-    for i, p in enumerate(sorted(paths)):
-        parts[i % num_partitions].append(p)
+def orc_schema(paths: Sequence[str]) -> T.StructType:
+    import pyarrow.orc as po
+    s = po.ORCFile(paths[0]).schema
+    return T.StructType(tuple(
+        T.StructField(f.name, T.from_arrow(f.type)) for f in s))
+
+
+def _partition_files(n_files: int, num_partitions: int) -> List[List[int]]:
+    parts: List[List[int]] = [[] for _ in range(num_partitions)]
+    for i in range(n_files):
+        parts[i % num_partitions].append(i)
     return parts
 
 
+def _rg_may_match(md_rg, colmap, filters) -> bool:
+    """Conservative row-group keep test against chunk min/max stats.
+
+    A conjunct that provably matches no non-null value lets the group be
+    skipped: predicate comparisons drop null rows anyway, so null-only
+    remains never survive the exact Filter above."""
+    for name, op, val in filters:
+        ci = colmap.get(name)
+        if ci is None:
+            continue
+        st = md_rg.column(ci).statistics
+        if st is None or not st.has_min_max:
+            continue
+        mn, mx = st.min, st.max
+        try:
+            if op == "eq" and (val < mn or val > mx):
+                return False
+            if op == "lt" and not (mn < val):
+                return False
+            if op == "le" and not (mn <= val):
+                return False
+            if op == "gt" and not (mx > val):
+                return False
+            if op == "ge" and not (mx >= val):
+                return False
+        except TypeError:
+            continue  # incomparable stats type — keep the group
+    return True
+
+
 class CpuParquetScanExec(CpuExec):
-    def __init__(self, paths: Sequence[str], schema: T.StructType,
-                 conf: RapidsConf, columns: Optional[List[str]] = None):
-        super().__init__(schema)
-        self.paths = list(paths)
+    """Generic file scan (parquet/orc) — CPU oracle path."""
+
+    def __init__(self, relation, conf: RapidsConf):
+        super().__init__(relation.schema)
+        self.relation = relation
+        self.paths = list(relation.paths)
         self.conf = conf
-        self.columns = columns
+        self.columns = relation.columns
         self._num_partitions = max(1, min(len(self.paths),
                                           conf.shuffle_partitions))
 
     def node_string(self):
-        return f"ParquetScan [{len(self.paths)} files]"
+        extra = ""
+        if self.relation.filters:
+            extra = f", pushdown={self.relation.filters}"
+        return (f"{self.relation.format.capitalize()}Scan "
+                f"[{len(self.paths)} files{extra}]")
 
     def num_partitions(self) -> int:
         return self._num_partitions
 
+    def _data_columns(self) -> Optional[List[str]]:
+        if self.columns is not None:
+            return self.columns
+        np_ = len(self.relation.partition_fields)
+        nf = 1 if self.relation.file_name_col else 0
+        fields = self.schema.fields
+        end = len(fields) - np_ - nf
+        return [f.name for f in fields[:end]]
+
+    def _read_file(self, fi: int) -> pa.Table:
+        """Read one file's pruned columns + append partition/file cols."""
+        path = self.paths[fi]
+        cols = self._data_columns()
+        if self.relation.format == "orc":
+            import pyarrow.orc as po
+            tbl = po.ORCFile(path).read(columns=cols)
+        else:
+            filters = self.relation.filters
+            if filters:
+                pf = pq.ParquetFile(path)
+                colmap = {pf.metadata.schema.column(i).name: i
+                          for i in range(pf.metadata.num_columns)}
+                keep = [rg for rg in range(pf.metadata.num_row_groups)
+                        if _rg_may_match(pf.metadata.row_group(rg),
+                                         colmap, filters)]
+                self.metric("prunedRowGroups").add(
+                    pf.metadata.num_row_groups - len(keep))
+                tbl = (pf.read_row_groups(keep, columns=cols) if keep
+                       else pf.schema_arrow.empty_table().select(cols))
+            else:
+                tbl = pq.read_table(path, columns=cols)
+        n = tbl.num_rows
+        if self.relation.partition_values is not None:
+            pv = self.relation.partition_values[fi]
+            for f in self.relation.partition_fields:
+                v = pv.get(f.name)
+                arr = pa.array(
+                    [v] * n if v is not None else [None] * n,
+                    type=T.to_arrow(f.dtype))
+                tbl = tbl.append_column(f.name, arr)
+        if self.relation.file_name_col:
+            tbl = tbl.append_column(
+                "input_file_name()",
+                pa.array([path] * n, type=pa.string()))
+        return tbl
+
     def execute(self, partition: int) -> Iterator[H.HostBatch]:
-        files = _partition_files(self.paths, self._num_partitions)[partition]
-        for f in files:
+        idxs = _partition_files(len(self.paths),
+                                self._num_partitions)[partition]
+        for fi in idxs:
             with self.timer():
-                tbl = pq.read_table(f, columns=self.columns)
+                tbl = self._read_file(fi)
                 b = H.from_arrow_table(tbl)
                 b = H.HostBatch(self.schema, b.columns)
             self.metric("numOutputRows").add(b.num_rows)
@@ -78,40 +173,42 @@ class TpuParquetScanExec(TpuExec):
     [REF: GpuMultiFileReader.scala :: MultiFileCloudPartitionReader]
     """
 
-    def __init__(self, paths: Sequence[str], schema: T.StructType,
-                 conf: RapidsConf, columns: Optional[List[str]] = None):
-        super().__init__(schema)
-        self.paths = list(paths)
-        self.conf = conf
-        self.columns = columns
-        self._num_partitions = max(1, min(len(self.paths),
-                                          conf.shuffle_partitions))
-        self.num_threads = int(conf.get_raw(
+    def __init__(self, cpu: CpuParquetScanExec):
+        super().__init__(cpu.schema)
+        self._cpu = cpu
+        self.paths = cpu.paths
+        self._num_partitions = cpu._num_partitions
+        self.num_threads = int(cpu.conf.get_raw(
             "spark.rapids.sql.multiThreadedRead.numThreads", 4) or 4)
 
     def node_string(self):
-        return f"TpuParquetScan [{len(self.paths)} files]"
+        return "Tpu" + self._cpu.node_string()
 
     def num_partitions(self) -> int:
         return self._num_partitions
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
-        files = _partition_files(self.paths, self._num_partitions)[partition]
-        if not files:
+        idxs = _partition_files(len(self.paths),
+                                self._num_partitions)[partition]
+        if not idxs:
             return
         with cf.ThreadPoolExecutor(max_workers=self.num_threads) as pool:
-            futures = [pool.submit(pq.read_table, f, columns=self.columns)
-                       for f in files]
+            futures = [pool.submit(self._cpu._read_file, fi)
+                       for fi in idxs]
             for fut in futures:
                 with self.timer("scanTime"):
                     tbl = fut.result()
                 with self.timer():
                     b = host_to_device(tbl)
-                    b = DeviceBatch(self.schema, b.columns, b.sel)
-                self.metric("numOutputRows").add(
-                    int(np.sum(np.asarray(b.sel))))
+                    b = DeviceBatch(self.schema, b.columns, b.sel,
+                                    compacted=True)
+                self.metric("numOutputRows").add(tbl.num_rows)
                 self.metric("numOutputBatches").add(1)
                 yield b
+        # pruning metric accrues on the shared CPU reader
+        pruned = self._cpu.metrics.get("prunedRowGroups")
+        if pruned is not None:
+            self.metric("prunedRowGroups").value = pruned.value
 
 
 def _tag_parquet(meta):
@@ -119,16 +216,20 @@ def _tag_parquet(meta):
 
 
 def _convert_parquet(cpu: CpuParquetScanExec, ch, conf):
-    return TpuParquetScanExec(cpu.paths, cpu.schema, cpu.conf, cpu.columns)
+    return TpuParquetScanExec(cpu)
 
 
-def write_parquet(table: pa.Table, path: str, mode: str = "error"):
+HIVE_NULL = "__HIVE_DEFAULT_PARTITION__"
+
+
+def _prepare_out_dir(path: str, mode: str) -> bool:
+    """Returns False when the write should be skipped (mode=ignore)."""
     import os
     if os.path.exists(path):
         if mode in ("error", "errorifexists"):
             raise FileExistsError(path)
         if mode == "ignore":
-            return
+            return False
         if mode == "overwrite":
             import shutil
             if os.path.isdir(path):
@@ -136,4 +237,44 @@ def write_parquet(table: pa.Table, path: str, mode: str = "error"):
             else:
                 os.remove(path)
     os.makedirs(path, exist_ok=True)
-    pq.write_table(table, os.path.join(path, "part-00000.parquet"))
+    return True
+
+
+def write_parquet(table: pa.Table, path: str, mode: str = "error",
+                  partition_by: Optional[List[str]] = None,
+                  fmt: str = "parquet"):
+    """Write a table as a directory of part files, optionally
+    hive-partitioned [REF: GpuFileFormatDataWriter.scala ::
+    GpuDynamicPartitionDataSingleWriter]."""
+    import os
+    if not _prepare_out_dir(path, mode):
+        return
+
+    def _write(tbl: pa.Table, out_dir: str, part_idx: int):
+        os.makedirs(out_dir, exist_ok=True)
+        fname = f"part-{part_idx:05d}.{fmt}"
+        if fmt == "orc":
+            import pyarrow.orc as po
+            po.write_table(tbl, os.path.join(out_dir, fname))
+        else:
+            pq.write_table(tbl, os.path.join(out_dir, fname))
+
+    if not partition_by:
+        _write(table, path, 0)
+        return
+    for c in partition_by:
+        if c not in table.column_names:
+            raise KeyError(f"partitionBy column '{c}' not in output")
+    data_cols = [c for c in table.column_names if c not in partition_by]
+    # group rows by distinct partition tuple (hash-free: arrow dictionary
+    # encode over the tuple string is overkill at host-write volume)
+    keys = list(zip(*[table.column(c).to_pylist() for c in partition_by]))
+    groups = {}
+    for i, k in enumerate(keys):
+        groups.setdefault(k, []).append(i)
+    for pi, (k, rows) in enumerate(sorted(
+            groups.items(), key=lambda kv: str(kv[0]))):
+        sub = table.take(pa.array(rows, type=pa.int64())).select(data_cols)
+        segs = [f"{c}=" + (HIVE_NULL if v is None else str(v))
+                for c, v in zip(partition_by, k)]
+        _write(sub, os.path.join(path, *segs), pi)

@@ -23,16 +23,64 @@ def _expand(path) -> List[str]:
     paths: List[str] = []
     for p in ([path] if isinstance(path, str) else list(path)):
         if os.path.isdir(p):
-            paths.extend(sorted(
-                f for f in glob.glob(os.path.join(p, "*"))
-                if os.path.isfile(f) and not os.path.basename(f).startswith(
-                    ("_", "."))))
+            # recursive walk: hive-partitioned layouts nest k=v dirs.
+            # In-place dirs pruning skips metadata trees (_delta_log/,
+            # _temporary/, .checkpoints/) and keeps traversal sorted.
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(("_", ".")))
+                for f in sorted(files):
+                    if not f.startswith(("_", ".")):
+                        paths.append(os.path.join(root, f))
         else:
             matches = sorted(glob.glob(p))
             paths.extend(matches if matches else [p])
     if not paths:
         raise FileNotFoundError(f"no input files at {path}")
     return paths
+
+
+def _discover_partitions(roots, paths: List[str]):
+    """Hive-style partition columns from ``k=v`` directory segments.
+
+    Returns (per-file value dicts, partition StructFields) — ((), ())
+    when the layout is unpartitioned.  Values infer int64 when every
+    non-null value parses as int (Spark's inference), else string."""
+    from spark_rapids_tpu.columnar import dtypes as T
+    root_list = [roots] if isinstance(roots, str) else list(roots)
+    values: List[dict] = []
+    keys: List[str] = []
+    for p in paths:
+        rel = None
+        for r in root_list:
+            if os.path.isdir(r) and os.path.abspath(p).startswith(
+                    os.path.abspath(r) + os.sep):
+                rel = os.path.relpath(p, r)
+                break
+        d = {}
+        if rel:
+            for seg in rel.split(os.sep)[:-1]:
+                if "=" in seg:
+                    from spark_rapids_tpu.io.parquet import HIVE_NULL
+                    k, v = seg.split("=", 1)
+                    d[k] = None if v == HIVE_NULL else v
+                    if k not in keys:
+                        keys.append(k)
+        values.append(d)
+    if not keys:
+        return (), ()
+    fields = []
+    for k in keys:
+        vs = [d.get(k) for d in values]
+        try:
+            ints = [None if v is None else int(v) for v in vs]
+            dt = T.LongT
+            for d, iv in zip(values, ints):
+                d[k] = iv
+        except (TypeError, ValueError):
+            dt = T.StringT
+        fields.append(T.StructField(k, dt, any(v is None for v in vs)))
+    return values, tuple(fields)
 
 
 class DataFrameReader:
@@ -53,14 +101,43 @@ class DataFrameReader:
         self._schema = s
         return self
 
-    def parquet(self, path):
-        from spark_rapids_tpu.io.parquet import parquet_schema
+    def _file_relation(self, path, fmt: str):
+        from spark_rapids_tpu.io.parquet import orc_schema, parquet_schema
         from spark_rapids_tpu.plan.logical import ParquetRelation
         from spark_rapids_tpu.sql.dataframe import DataFrame
 
         paths = _expand(path)
-        schema = self._schema or parquet_schema(paths)
-        return DataFrame(self.session, ParquetRelation(paths, schema))
+        data_schema = self._schema or (
+            orc_schema(paths) if fmt == "orc" else parquet_schema(paths))
+        part_values, part_fields = _discover_partitions(path, paths)
+        schema = T.StructType(tuple(data_schema.fields) + part_fields)
+        return DataFrame(self.session, ParquetRelation(
+            paths, schema, format=fmt,
+            partition_values=list(part_values) or None,
+            partition_fields=part_fields))
+
+    def parquet(self, path):
+        return self._file_relation(path, "parquet")
+
+    def orc(self, path):
+        """[REF: GpuOrcScan.scala] — host pyarrow.orc decode + H2D."""
+        return self._file_relation(path, "orc")
+
+    def avro(self, path):
+        raise NotImplementedError(
+            "avro is not supported in this environment (no avro decoder "
+            "library is bundled); convert to parquet/orc, or use "
+            "csv/json for text formats")
+
+    def text(self, path):
+        """Each line as one 'value' string column (spark.read.text)."""
+        paths = _expand(path)
+        rows = []
+        for p in paths:
+            with open(p, "r", errors="replace") as f:
+                rows.extend(line.rstrip("\n") for line in f)
+        return self.session.createDataFrame(
+            pa.table({"value": pa.array(rows, type=pa.string())}))
 
     def csv(self, path, header: Optional[bool] = None):
         paths = _expand(path)
@@ -103,6 +180,7 @@ class DataFrameWriter:
         self.df = df
         self._mode = "error"
         self._options: Dict[str, str] = {}
+        self._partition_by: List[str] = []
 
     def mode(self, m: str) -> "DataFrameWriter":
         self._mode = m
@@ -112,9 +190,21 @@ class DataFrameWriter:
         self._options[str(key)] = value
         return self
 
+    def partitionBy(self, *cols) -> "DataFrameWriter":
+        """Hive-style dynamic-partition layout (k=v directories)
+        [REF: GpuFileFormatDataWriter.scala]."""
+        self._partition_by = [c for c in cols]
+        return self
+
     def parquet(self, path: str):
         from spark_rapids_tpu.io.parquet import write_parquet
-        write_parquet(self.df.toArrow(), path, self._mode)
+        write_parquet(self.df.toArrow(), path, self._mode,
+                      partition_by=self._partition_by)
+
+    def orc(self, path: str):
+        from spark_rapids_tpu.io.parquet import write_parquet
+        write_parquet(self.df.toArrow(), path, self._mode,
+                      partition_by=self._partition_by, fmt="orc")
 
     def csv(self, path: str):
         import pyarrow.csv as pacsv

@@ -91,6 +91,26 @@ class Expression:
 
 
 @dataclasses.dataclass
+class InputFileName(Expression):
+    """Marker for input_file_name() — the optimizer rewrites it to a
+    BoundReference over the scan's appended file-name column
+    [REF: GpuFileSourceScanExec.scala :: InputFileName handling]."""
+
+    dtype: T.DataType = dataclasses.field(
+        default_factory=lambda: T.StringT)
+
+    def eval_tpu(self, batch):
+        raise RuntimeError(
+            "input_file_name() was not bound to a file scan — it is only "
+            "valid directly above a file source")
+
+    eval_cpu = eval_tpu
+
+    def __str__(self):
+        return "input_file_name()"
+
+
+@dataclasses.dataclass
 class BoundReference(Expression):
     index: int
     dtype: T.DataType
@@ -114,6 +134,11 @@ class Literal(Expression):
     def eval_tpu(self, batch):
         b = batch.capacity
         if self.value is None:
+            if isinstance(self.dtype, (T.StringType, T.BinaryType)):
+                return DeviceColumn(self.dtype,
+                                    jnp.zeros((b, 1), jnp.uint8),
+                                    jnp.zeros((b,), jnp.bool_),
+                                    jnp.zeros((b,), jnp.int32))
             npdt = (np.int32 if isinstance(self.dtype, T.NullType)
                     else T.to_numpy_dtype(self.dtype))
             data = jnp.zeros((b,), npdt)
@@ -137,9 +162,11 @@ class Literal(Expression):
     def eval_cpu(self, batch):
         n = batch.num_rows
         if self.value is None:
+            if isinstance(self.dtype, (T.StringType, T.BinaryType)):
+                return HostCol(self.dtype, np.full(n, "", object),
+                               np.zeros(n, bool))
             npdt = (np.int32 if isinstance(self.dtype, T.NullType)
-                    else (object if isinstance(self.dtype, T.StringType)
-                          else T.to_numpy_dtype(self.dtype)))
+                    else T.to_numpy_dtype(self.dtype))
             return HostCol(self.dtype, np.zeros(n, npdt), np.zeros(n, bool))
         if isinstance(self.dtype, T.StringType):
             return HostCol(self.dtype, np.array([self.value] * n, object))

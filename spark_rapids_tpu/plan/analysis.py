@@ -93,8 +93,19 @@ def common_type(a: T.DataType, b: T.DataType) -> T.DataType:
 def cast_to(e: E.Expression, dt: T.DataType) -> E.Expression:
     if e.dtype == dt:
         return e
-    if isinstance(e, E.Literal) and e.value is None:
-        return E.Literal(None, dt)
+    if isinstance(e, E.Literal):
+        # constant-fold literal widenings: keeps predicates in the
+        # (ref cmp literal) shape scan pushdown recognizes and shrinks
+        # kernel-cache keys
+        if e.value is None:
+            return E.Literal(None, dt)
+        v = e.value
+        if not isinstance(v, bool):
+            if T.is_integral(dt) and isinstance(v, int):
+                return E.Literal(v, dt)
+            if isinstance(dt, (T.DoubleType, T.FloatType)) and isinstance(
+                    v, (int, float)):
+                return E.Literal(float(v), dt)
     return E.Cast(e, dt)
 
 
@@ -247,6 +258,8 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
     if op == "hash":
         from spark_rapids_tpu.ops.hashing import Murmur3Hash
         return Murmur3Hash([resolve(c, schema) for c in u.children])
+    if op == "input_file_name":
+        return E.InputFileName()
     if op == "agg":
         raise AnalysisException(
             f"aggregate function '{u.payload}' is only allowed in agg()")

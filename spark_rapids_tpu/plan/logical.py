@@ -52,8 +52,23 @@ class InMemoryRelation(LogicalPlan):
 
 @dataclasses.dataclass
 class ParquetRelation(LogicalPlan):
+    """File-source relation (parquet or orc — ``format`` selects).
+
+    ``columns``: pruned data-column names (projection pushdown);
+    ``filters``: (name, op, literal) conjuncts for row-group pruning;
+    ``partition_values``: hive-style partition values per file (aligned
+    with ``paths``); ``file_name_col``: append input_file_name() column.
+    Pushdown fields are filled by plan/optimizer.py, not by users.
+    """
+
     paths: List[str]
     schema: T.StructType
+    format: str = "parquet"
+    columns: Optional[List[str]] = None
+    filters: Optional[List[tuple]] = None
+    partition_values: Optional[List[dict]] = None
+    partition_fields: Tuple = ()
+    file_name_col: bool = False
 
 
 @dataclasses.dataclass
@@ -172,6 +187,63 @@ class Join(LogicalPlan):
     @property
     def children(self):
         return (self.left, self.right)
+
+
+@dataclasses.dataclass
+class Range(LogicalPlan):
+    """session.range — generated ids, no backing data."""
+
+    start: int
+    end: int
+    step: int
+    schema: T.StructType
+    num_partitions: int = 1
+
+
+@dataclasses.dataclass
+class Sample(LogicalPlan):
+    """Bernoulli sample (without replacement)."""
+
+    child: LogicalPlan
+    fraction: float
+    seed: int
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Expand(LogicalPlan):
+    """Grouping-sets row multiplication [REF: Spark Expand]."""
+
+    child: LogicalPlan
+    projections: List[List[Expression]]
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class Generate(LogicalPlan):
+    """explode/posexplode of an array column, appending pos/element
+    columns to the child's output [REF: Spark Generate]."""
+
+    child: LogicalPlan
+    generator: Expression  # ArrayType-valued
+    with_pos: bool
+    outer: bool
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.child,)
 
 
 @dataclasses.dataclass

@@ -1,0 +1,248 @@
+"""Logical-plan optimizer: scan pushdown.
+
+[REF: the reference relies on Spark's own optimizer for column pruning /
+ filter pushdown and implements the scan side in GpuParquetScan.scala
+ (predicate → row-group pruning) and GpuFileSourceScanExec.scala
+ (partition values, input_file_name).  This engine has no Catalyst, so
+ the two scan-facing rules live here.]
+
+Rules (bottom-up, single pass):
+
+* **Filter pushdown**: ``Filter* → ParquetRelation`` chains attach their
+  simple conjuncts ``(col, cmp, literal)`` to the relation for row-group
+  statistics pruning.  The Filter stays in the plan — pruning is
+  conservative, exactness comes from the Filter itself.
+* **Column pruning**: a ``Project | Aggregate → Filter* → ParquetRelation``
+  chain narrows the relation to the referenced columns and remaps every
+  bound reference in the chain.  (Head nodes define a fresh schema, so
+  ancestors are unaffected.)
+* **input_file_name() binding**: markers in the head projection turn on
+  the relation's file-name column and rebind to it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Set, Tuple
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops import expressions as E
+from spark_rapids_tpu.plan import logical as L
+
+
+def transform_expr(e: E.Expression, fn) -> E.Expression:
+    """Rebuild an expression tree bottom-up; fn(node) may return a
+    replacement (or None to keep the rebuilt node)."""
+    if dataclasses.is_dataclass(e):
+        changes = {}
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            nv = _transform_field(v, fn)
+            if nv is not v:
+                changes[f.name] = nv
+        if changes:
+            e = dataclasses.replace(e, **changes)
+    out = fn(e)
+    return e if out is None else out
+
+
+def _transform_field(v, fn):
+    if isinstance(v, E.Expression):
+        return transform_expr(v, fn)
+    if isinstance(v, (list, tuple)):
+        items = [_transform_field(x, fn) for x in v]
+        if all(a is b for a, b in zip(items, v)):
+            return v
+        return type(v)(items) if isinstance(v, tuple) else items
+    return v
+
+
+def collect_refs(e: E.Expression, out: Set[int]):
+    if isinstance(e, E.BoundReference):
+        out.add(e.index)
+    for c in e.children:
+        collect_refs(c, out)
+
+
+def _has_file_name_marker(exprs) -> bool:
+    found = [False]
+
+    def look(e):
+        if isinstance(e, E.InputFileName):
+            found[0] = True
+        for c in e.children:
+            look(c)
+
+    for e in exprs:
+        look(e)
+    return found[0]
+
+
+_CMP_OPS = {E.EqualTo: "eq", E.LessThan: "lt", E.LessThanOrEqual: "le",
+            E.GreaterThan: "gt", E.GreaterThanOrEqual: "ge"}
+_FLIP = {"lt": "gt", "le": "ge", "gt": "lt", "ge": "le", "eq": "eq"}
+_PUSHABLE_LIT = (T.ByteType, T.ShortType, T.IntegerType, T.LongType,
+                 T.FloatType, T.DoubleType, T.StringType, T.BooleanType)
+
+
+def _extract_filters(cond: E.Expression, rel: L.ParquetRelation
+                     ) -> List[tuple]:
+    """Simple (col-name, op, literal) conjuncts for row-group pruning."""
+    n_data = (len(rel.schema.fields) - len(rel.partition_fields)
+              - (1 if rel.file_name_col else 0))
+    out = []
+
+    def visit(e):
+        if isinstance(e, E.And):
+            visit(e.left)
+            visit(e.right)
+            return
+        op = _CMP_OPS.get(type(e))
+        if op is None:
+            return
+        ref, lit, flip = None, None, False
+        if (isinstance(e.left, E.BoundReference)
+                and isinstance(e.right, E.Literal)):
+            ref, lit = e.left, e.right
+        elif (isinstance(e.right, E.BoundReference)
+              and isinstance(e.left, E.Literal)):
+            ref, lit, flip = e.right, e.left, True
+        if ref is None or lit.value is None or ref.index >= n_data:
+            return
+        if not isinstance(lit.dtype, _PUSHABLE_LIT):
+            return
+        v = lit.value
+        if isinstance(v, float) and v != v:  # NaN never prunes
+            return
+        out.append((rel.schema.fields[ref.index].name,
+                    _FLIP[op] if flip else op, v))
+
+    visit(cond)
+    return out
+
+
+def _filter_chain(node) -> Tuple[List[L.Filter], Optional[L.ParquetRelation]]:
+    filters = []
+    while isinstance(node, L.Filter):
+        filters.append(node)
+        node = node.child
+    if isinstance(node, L.ParquetRelation):
+        return filters, node
+    return filters, None
+
+
+def _rebuild_chain(filters: List[L.Filter], leaf, remap=None):
+    """Re-stack Filter nodes (innermost last) over a new leaf, remapping
+    their conditions when the leaf schema changed."""
+    node = leaf
+    for f in reversed(filters):
+        cond = f.condition
+        if remap is not None:
+            cond = transform_expr(cond, remap)
+        node = L.Filter(node, cond)
+    return node
+
+
+def _prune_relation(rel: L.ParquetRelation, required: Set[int],
+                    need_file_name: bool):
+    """Narrowed relation + old→new index map."""
+    fields = rel.schema.fields
+    n_data = (len(fields) - len(rel.partition_fields)
+              - (1 if rel.file_name_col else 0))
+    if n_data and not any(i < n_data for i in required):
+        # partition-only / count(*) shapes: always read ≥1 data column —
+        # ORC's reader loses the row count on a zero-column read
+        required = set(required) | {0}
+    keep = sorted(required)
+    index_map = {old: new for new, old in enumerate(keep)}
+    new_fields = [fields[i] for i in keep]
+    columns = [fields[i].name for i in keep if i < n_data]
+    part_fields = tuple(fields[i] for i in keep
+                        if n_data <= i < n_data + len(rel.partition_fields))
+    file_name_col = rel.file_name_col or need_file_name
+    if file_name_col:
+        new_fields.append(T.StructField("input_file_name()", T.StringT,
+                                        False))
+        fn_idx = len(new_fields) - 1
+    else:
+        fn_idx = None
+    new_rel = dataclasses.replace(
+        rel, schema=T.StructType(tuple(new_fields)), columns=columns,
+        partition_fields=part_fields, file_name_col=file_name_col)
+    return new_rel, index_map, fn_idx
+
+
+def _make_remap(index_map, fn_idx):
+    def remap(e):
+        if isinstance(e, E.BoundReference):
+            return E.BoundReference(index_map[e.index], e.dtype,
+                                    e.nullable)
+        if isinstance(e, E.InputFileName):
+            if fn_idx is None:
+                return None
+            return E.BoundReference(fn_idx, T.StringT, False)
+        return None
+    return remap
+
+
+def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+    plan = _rewrite_children(plan)
+
+    if isinstance(plan, (L.Project, L.Aggregate)):
+        filters, rel = _filter_chain(plan.child)
+        # the inner Filter rule may already have attached row-group
+        # filters (bottom-up order) — pruning only needs columns unset
+        if rel is not None and rel.columns is None:
+            if isinstance(plan, L.Project):
+                head_exprs = list(plan.exprs)
+            else:
+                head_exprs = (list(plan.grouping)
+                              + [f.child for f in plan.aggregates
+                                 if getattr(f, "child", None) is not None])
+            required: Set[int] = set()
+            for e in head_exprs:
+                collect_refs(e, required)
+            for f in filters:
+                collect_refs(f.condition, required)
+            need_fn = isinstance(plan, L.Project) and _has_file_name_marker(
+                head_exprs)
+            pushed = rel.filters
+            if pushed is None:
+                pushed = []
+                for f in filters:
+                    pushed.extend(_extract_filters(f.condition, rel))
+            new_rel, index_map, fn_idx = _prune_relation(
+                rel, required, need_fn)
+            if pushed:
+                new_rel = dataclasses.replace(new_rel, filters=pushed)
+            remap = _make_remap(index_map, fn_idx)
+            child = _rebuild_chain(filters, new_rel, remap)
+            if isinstance(plan, L.Project):
+                exprs = [transform_expr(e, remap) for e in plan.exprs]
+                return L.Project(child, exprs, plan.schema)
+            grouping = [transform_expr(e, remap) for e in plan.grouping]
+            aggs = [transform_expr(a, remap) for a in plan.aggregates]
+            return L.Aggregate(child, grouping, aggs, plan.schema)
+
+    if isinstance(plan, L.Filter):
+        filters, rel = _filter_chain(plan)
+        if rel is not None and rel.filters is None:
+            pushed = []
+            for f in filters:
+                pushed.extend(_extract_filters(f.condition, rel))
+            if pushed:
+                new_rel = dataclasses.replace(rel, filters=pushed)
+                return _rebuild_chain(filters, new_rel)
+
+    return plan
+
+
+def _rewrite_children(plan: L.LogicalPlan) -> L.LogicalPlan:
+    if isinstance(plan, L.Union):
+        return L.Union([optimize(c) for c in plan.inputs])
+    if isinstance(plan, L.Join):
+        return dataclasses.replace(plan, left=optimize(plan.left),
+                                   right=optimize(plan.right))
+    if hasattr(plan, "child"):
+        return dataclasses.replace(plan, child=optimize(plan.child))
+    return plan

@@ -106,6 +106,17 @@ class ExecMeta:
             r = is_device_supported_output_type(f.dtype)
             if r:
                 self.will_not_work(f"output column '{f.name}': {r}")
+        # a CPU-falling child feeds this node through an H2D transition —
+        # every column of the child's schema must survive the transfer
+        # [REF: GpuTransitionOverrides.scala — transition type validation]
+        for c in self.children:
+            if not c.can_run_on_tpu:
+                for f in c.cpu.schema.fields:
+                    r = is_device_supported_output_type(f.dtype)
+                    if r:
+                        self.will_not_work(
+                            f"input column '{f.name}' cannot cross the "
+                            f"host→device transition: {r}")
         rule.tag(self)
 
 
@@ -132,7 +143,14 @@ def tag_expression(e: Expression, meta: ExecMeta):
         r = hook(meta.conf)
         if r:
             meta.will_not_work(f"expression {name}: {r}")
-    r = is_device_supported_type(e.dtype)
+    from spark_rapids_tpu.ops.expressions import BoundReference
+    if isinstance(e, BoundReference):
+        # direct column pass-through supports everything a batch can
+        # carry (incl. array<numeric>); computed expressions stay
+        # restricted to scalar device types
+        r = is_device_supported_output_type(e.dtype)
+    else:
+        r = is_device_supported_type(e.dtype)
     if r:
         meta.will_not_work(f"expression {e}: {r}")
     if not hasattr(e, "eval_tpu") or (
@@ -331,6 +349,25 @@ def _register_lazy_rules():
         EXEC_RULES.setdefault(CpuParquetScanExec, ExecRule(
             "ParquetScan", _tag_parquet, _convert_parquet,
             "parquet scan landing device-resident batches"))
+    except ImportError:
+        pass
+    try:
+        from spark_rapids_tpu.exec import misc as M
+        EXEC_RULES.setdefault(M.CpuRangeExec, ExecRule(
+            "Range", M._tag_range, M._convert_range,
+            "device iota id generation (no host data)"))
+        EXEC_RULES.setdefault(M.CpuSampleExec, ExecRule(
+            "Sample", M._tag_sample, M._convert_sample,
+            "hash-Bernoulli sample folded into the sel mask"))
+        EXEC_RULES.setdefault(M.CpuExpandExec, ExecRule(
+            "Expand", M._tag_expand, M._convert_expand,
+            "grouping-sets expansion (one kernel per projection)"))
+        EXEC_RULES.setdefault(M.CpuGenerateExec, ExecRule(
+            "Generate", M._tag_generate, M._convert_generate,
+            "explode/posexplode via element-matrix reshape"))
+        EXEC_RULES.setdefault(M.CpuTopNExec, ExecRule(
+            "TakeOrderedAndProject", M._tag_topn, M._convert_topn,
+            "per-partition device topN + winner merge"))
     except ImportError:
         pass
 

@@ -20,7 +20,7 @@ def plan_physical(node: L.LogicalPlan, conf: RapidsConf) -> CpuExec:
                              conf.batch_rows)
     if isinstance(node, L.ParquetRelation):
         from spark_rapids_tpu.io.parquet import CpuParquetScanExec
-        return CpuParquetScanExec(node.paths, node.schema, conf)
+        return CpuParquetScanExec(node, conf)
     if isinstance(node, L.Project):
         return B.CpuProjectExec(node.exprs, node.schema,
                                 plan_physical(node.child, conf))
@@ -28,9 +28,39 @@ def plan_physical(node: L.LogicalPlan, conf: RapidsConf) -> CpuExec:
         return B.CpuFilterExec(node.condition,
                                plan_physical(node.child, conf))
     if isinstance(node, L.Limit):
+        # TakeOrderedAndProject pattern: Limit(Sort) / Limit(Project(Sort))
+        # plans a topN instead of a full global sort [REF: GpuTopN]
+        from spark_rapids_tpu.exec.misc import CpuTopNExec
+        inner = node.child
+        proj = None
+        if isinstance(inner, L.Project):
+            proj, inner = inner, inner.child
+        if isinstance(inner, L.Sort) and inner.global_sort:
+            topn = CpuTopNExec(inner.orders, node.n,
+                               plan_physical(inner.child, conf))
+            if proj is not None:
+                return B.CpuProjectExec(proj.exprs, proj.schema, topn)
+            return topn
         return B.CpuGlobalLimitExec(
             node.n, B.CpuLocalLimitExec(node.n,
                                         plan_physical(node.child, conf)))
+    if isinstance(node, L.Range):
+        from spark_rapids_tpu.exec.misc import CpuRangeExec
+        return CpuRangeExec(node.start, node.end, node.step, node.schema,
+                            node.num_partitions, conf.batch_rows)
+    if isinstance(node, L.Sample):
+        from spark_rapids_tpu.exec.misc import CpuSampleExec
+        return CpuSampleExec(node.fraction, node.seed,
+                             plan_physical(node.child, conf))
+    if isinstance(node, L.Expand):
+        from spark_rapids_tpu.exec.misc import CpuExpandExec
+        return CpuExpandExec(node.projections, node.schema,
+                             plan_physical(node.child, conf))
+    if isinstance(node, L.Generate):
+        from spark_rapids_tpu.exec.misc import CpuGenerateExec
+        return CpuGenerateExec(node.generator, node.with_pos, node.outer,
+                               node.schema,
+                               plan_physical(node.child, conf))
     if isinstance(node, L.Union):
         return B.CpuUnionExec([plan_physical(c, conf) for c in node.inputs])
     if isinstance(node, L.Aggregate):

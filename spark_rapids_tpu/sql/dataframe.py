@@ -69,6 +69,9 @@ class DataFrame:
 
     # -- transformations ----------------------------------------------------
     def select(self, *cols) -> "DataFrame":
+        if any(self._generate_u(c) is not None for c in cols
+               if not (isinstance(c, str) and c == "*")):
+            return self._select_with_generate(cols)
         if any(self._window_u(c) is not None for c in cols
                if not (isinstance(c, str) and c == "*")):
             return self._select_with_windows(cols)
@@ -88,6 +91,68 @@ class DataFrame:
             fields.append(T.StructField(name, e.dtype))
         schema = T.StructType(tuple(fields))
         return DataFrame(self.session, L.Project(self._plan, exprs, schema))
+
+    @staticmethod
+    def _generate_u(c) -> Optional[UExpr]:
+        """The explode/posexplode UExpr under an optional alias."""
+        if isinstance(c, str):
+            return None
+        u = _to_column(c)._u
+        core = u.children[0] if u.op == "alias" else u
+        return core if core.op == "generate" else None
+
+    def _select_with_generate(self, cols) -> "DataFrame":
+        """Spark's ExtractGenerator analog: one Generate node appends
+        pos/element columns to the child, then a Project picks the
+        requested output."""
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        gens = [c for c in cols
+                if not (isinstance(c, str) and c == "*")
+                and self._generate_u(c) is not None]
+        if len(gens) > 1:
+            raise AN.AnalysisException(
+                "only one generator (explode/posexplode) is allowed per "
+                "select")
+        base_schema = self.schema
+        gu = self._generate_u(gens[0])
+        with_pos, outer = gu.payload
+        gen_expr = AN.resolve(gu.children[0], base_schema)
+        if not isinstance(gen_expr.dtype, T.ArrayType):
+            raise AN.AnalysisException(
+                f"explode needs an array column, got "
+                f"{gen_expr.dtype.simple_name}")
+        alias_u = _to_column(gens[0])._u
+        elem_name = (alias_u.payload if alias_u.op == "alias" else "col")
+        elem_dt = gen_expr.dtype.element_type
+        nc = len(base_schema)
+        ext_fields = list(base_schema.fields)
+        if with_pos:
+            ext_fields.append(T.StructField("pos", T.IntegerT, outer))
+        ext_fields.append(T.StructField(elem_name, elem_dt, True))
+        ext_schema = T.StructType(tuple(ext_fields))
+        plan = L.Generate(self._plan, gen_expr, with_pos, outer,
+                          ext_schema)
+        exprs, fields = [], []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                for i, f in enumerate(base_schema.fields):
+                    exprs.append(BoundReference(i, f.dtype, f.nullable))
+                    fields.append(f)
+                continue
+            if self._generate_u(c) is not None:
+                if with_pos:
+                    exprs.append(BoundReference(nc, T.IntegerT, outer))
+                    fields.append(T.StructField("pos", T.IntegerT, outer))
+                idx = nc + (1 if with_pos else 0)
+                exprs.append(BoundReference(idx, elem_dt, True))
+                fields.append(T.StructField(elem_name, elem_dt, True))
+                continue
+            u = _to_column(c)._u
+            e = AN.resolve(u, ext_schema)
+            exprs.append(e)
+            fields.append(T.StructField(self._output_name(u, e), e.dtype))
+        return DataFrame(self.session, L.Project(
+            plan, exprs, T.StructType(tuple(fields))))
 
     @staticmethod
     def _window_u(c) -> Optional[UExpr]:
@@ -211,6 +276,29 @@ class DataFrame:
     def distinct(self) -> "DataFrame":
         return self.groupBy(*self.columns).agg()
 
+    def sample(self, withReplacement=None, fraction=None, seed=None
+               ) -> "DataFrame":
+        """Bernoulli sample.  Accepts pyspark's signature variants:
+        sample(fraction), sample(fraction, seed),
+        sample(withReplacement, fraction, seed)."""
+        if isinstance(withReplacement, float):
+            # legacy form sample(fraction[, seed]): shift the arguments —
+            # an explicit seed= keyword wins over the positional slot
+            s2 = seed if seed is not None else fraction
+            withReplacement, fraction, seed = (
+                False, withReplacement, None if s2 is None else int(s2))
+        if withReplacement:
+            raise NotImplementedError(
+                "sample(withReplacement=True) is not supported")
+        if fraction is None or not (0.0 <= fraction <= 1.0):
+            raise AN.AnalysisException(
+                f"sample fraction must be in [0, 1], got {fraction}")
+        if seed is None:
+            import random
+            seed = random.randint(0, 2**31 - 1)
+        return DataFrame(self.session,
+                         L.Sample(self._plan, float(fraction), int(seed)))
+
     def repartition(self, num: int, *cols) -> "DataFrame":
         keys = [AN.resolve(_to_column(c)._u, self.schema) for c in cols] or None
         return DataFrame(self.session,
@@ -227,6 +315,24 @@ class DataFrame:
         return GroupedData(self, exprs, names)
 
     groupby = groupBy
+
+    def rollup(self, *cols) -> "GroupedData":
+        """Hierarchical grouping sets: (a,b), (a), () for rollup(a, b).
+        [REF: GpuExpandExec.scala — the reference accelerates Spark's
+        Expand+Aggregate rollup plan; same shape here]"""
+        g = self.groupBy(*cols)
+        nk = len(g.grouping)
+        g.sets = [list(range(k)) for k in range(nk, -1, -1)]
+        return g
+
+    def cube(self, *cols) -> "GroupedData":
+        """All 2^n grouping-set combinations."""
+        import itertools
+        g = self.groupBy(*cols)
+        nk = len(g.grouping)
+        g.sets = [list(s) for r in range(nk, -1, -1)
+                  for s in itertools.combinations(range(nk), r)]
+        return g
 
     def agg(self, *aggs) -> "DataFrame":
         return GroupedData(self, [], []).agg(*aggs)
@@ -369,8 +475,9 @@ class DataFrame:
 
     # -- actions ------------------------------------------------------------
     def _execute_plan(self):
+        from spark_rapids_tpu.plan.optimizer import optimize
         conf = self.session.rapids_conf()
-        cpu = plan_physical(self._plan, conf)
+        cpu = plan_physical(optimize(self._plan), conf)
         result = apply_overrides(cpu, conf)
         return result.plan
 
@@ -467,8 +574,9 @@ class DataFrame:
         print(self.limit(n).toArrow().to_pandas().to_string())
 
     def explain(self, extended: bool = False):
+        from spark_rapids_tpu.plan.optimizer import optimize
         conf = self.session.rapids_conf()
-        cpu = plan_physical(self._plan, conf)
+        cpu = plan_physical(optimize(self._plan), conf)
         result = apply_overrides(cpu, conf)
         print(result.plan.tree_string())
         if extended:
@@ -486,6 +594,7 @@ class GroupedData:
         self.df = df
         self.grouping = grouping
         self.names = names
+        self.sets = None  # grouping sets (rollup/cube); None = plain
 
     def agg(self, *aggs) -> DataFrame:
         from spark_rapids_tpu.ops.aggregates import CountDistinct
@@ -496,7 +605,13 @@ class GroupedData:
             fns.append(fn)
             names.append(name)
         if any(isinstance(f, CountDistinct) for f in fns):
+            if self.sets is not None:
+                raise AN.AnalysisException(
+                    "count(DISTINCT) under rollup/cube is not yet "
+                    "supported")
             return self._agg_distinct(fns, names)
+        if self.sets is not None:
+            return self._agg_grouping_sets(fns, names)
         fields = [T.StructField(n, g.dtype)
                   for n, g in zip(self.names, self.grouping)]
         fields += [T.StructField(n, f.result_dtype)
@@ -504,6 +619,55 @@ class GroupedData:
         schema = T.StructType(tuple(fields))
         return DataFrame(self.df.session, L.Aggregate(
             self.df._plan, self.grouping, fns, schema))
+
+    def _agg_grouping_sets(self, fns, names) -> DataFrame:
+        """rollup/cube → Expand + Aggregate(keys + grouping id) + drop-gid
+        Project — Spark's ResolveGroupingAnalytics plan shape, which the
+        reference accelerates via GpuExpandExec."""
+        from spark_rapids_tpu.ops.expressions import BoundReference, Literal
+        child_schema = self.df.schema
+        nc = len(child_schema)
+        nk = len(self.grouping)
+        projections = []
+        for s in self.sets:
+            inc = set(s)
+            proj = [BoundReference(i, f.dtype, f.nullable)
+                    for i, f in enumerate(child_schema.fields)]
+            for i, g in enumerate(self.grouping):
+                proj.append(g if i in inc else Literal(None, g.dtype))
+            # Spark grouping_id: bit (nk-1-i) set when key i is NOT in
+            # the grouping set
+            gid = sum(1 << (nk - 1 - i) for i in range(nk)
+                      if i not in inc)
+            proj.append(Literal(gid, T.IntegerT))
+            projections.append(proj)
+        ex_fields = (list(child_schema.fields)
+                     + [T.StructField(f"_g{i}", g.dtype, True)
+                        for i, g in enumerate(self.grouping)]
+                     + [T.StructField("_gid", T.IntegerT, False)])
+        expand = L.Expand(self.df._plan, projections,
+                          T.StructType(tuple(ex_fields)))
+        grouping = [BoundReference(nc + i, g.dtype, True)
+                    for i, g in enumerate(self.grouping)]
+        grouping.append(BoundReference(nc + nk, T.IntegerT, False))
+        agg_fields = ([T.StructField(n, g.dtype, True)
+                       for n, g in zip(self.names, self.grouping)]
+                      + [T.StructField("_gid", T.IntegerT, False)]
+                      + [T.StructField(n, f.result_dtype)
+                         for n, f in zip(names, fns)])
+        agg = L.Aggregate(expand, grouping, fns,
+                          T.StructType(tuple(agg_fields)))
+        # final projection drops the grouping id
+        out_fields = ([T.StructField(n, g.dtype, True)
+                       for n, g in zip(self.names, self.grouping)]
+                      + [T.StructField(n, f.result_dtype)
+                         for n, f in zip(names, fns)])
+        exprs = ([BoundReference(i, g.dtype, True)
+                  for i, g in enumerate(self.grouping)]
+                 + [BoundReference(nk + 1 + i, f.result_dtype)
+                    for i, f in enumerate(fns)])
+        return DataFrame(self.df.session, L.Project(
+            agg, exprs, T.StructType(tuple(out_fields))))
 
     def _agg_distinct(self, fns, names) -> DataFrame:
         """count(DISTINCT x): Spark's RewriteDistinctAggregates shape —

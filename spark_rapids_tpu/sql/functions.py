@@ -140,6 +140,29 @@ stddev = stddev_samp
 collect_list = _agg1("collect_list")
 
 
+def input_file_name() -> Column:
+    """File path of the current row's source file (file scans only)."""
+    return Column(UExpr("input_file_name", None))
+
+
+# generators ----------------------------------------------------------------
+
+def explode(c) -> Column:
+    return Column(UExpr("generate", (False, False), (_cu(c),)))
+
+
+def explode_outer(c) -> Column:
+    return Column(UExpr("generate", (False, True), (_cu(c),)))
+
+
+def posexplode(c) -> Column:
+    return Column(UExpr("generate", (True, False), (_cu(c),)))
+
+
+def posexplode_outer(c) -> Column:
+    return Column(UExpr("generate", (True, True), (_cu(c),)))
+
+
 # window functions ----------------------------------------------------------
 
 def row_number() -> Column:

@@ -141,11 +141,19 @@ class TpuSession:
             "list of tuples with a schema (list of names or StructType)")
 
     def range(self, start: int, end: Optional[int] = None,
-              step: int = 1) -> "DataFrame":
+              step: int = 1, numPartitions: Optional[int] = None
+              ) -> "DataFrame":
+        """Generated id column — lands as a device iota, no host data
+        [REF: basicPhysicalOperators.scala :: GpuRangeExec]."""
+        from spark_rapids_tpu.plan.logical import Range
+        from spark_rapids_tpu.sql.dataframe import DataFrame
         if end is None:
             start, end = 0, start
-        vals = np.arange(start, end, step, dtype=np.int64)
-        return self.createDataFrame(pa.table({"id": pa.array(vals)}))
+        nparts = numPartitions or int(
+            self.conf.get("spark.default.parallelism", 1))
+        schema = T.StructType((T.StructField("id", T.LongT, False),))
+        return DataFrame(self, Range(int(start), int(end), int(step),
+                                     schema, nparts))
 
     @property
     def read(self):

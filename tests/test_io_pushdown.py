@@ -1,0 +1,166 @@
+"""File-scan pushdown: column pruning, row-group stats pruning,
+hive partitions, input_file_name, partitioned writes, ORC, text.
+
+[REF: integration_tests/src/main/python/parquet_test.py, orc_test.py —
+ the read/write/pushdown families; SURVEY §2.1 #19-21]
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, cpu_session, tpu_session)
+
+
+def big_table(n=10000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": pa.array(np.arange(n, dtype=np.int64)),
+        "b": pa.array(rng.normal(size=n)),
+        "c": pa.array([f"s{i % 50}" for i in range(n)]),
+        "d": pa.array((np.arange(n) % 11).astype(np.int32)),
+    })
+
+
+@pytest.fixture()
+def pq_file(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    # many small row groups so stats pruning has something to skip
+    pq.write_table(big_table(), p, row_group_size=1000)
+    return p
+
+
+def test_column_pruning_narrows_scan(pq_file):
+    s = tpu_session()
+    df = s.read.parquet(pq_file).select((col("a") + 1).alias("a1"))
+    df.toArrow()
+    tree = df._last_plan.tree_string()
+    assert "1 files" in tree
+    # the physical scan must read only column 'a'
+    from spark_rapids_tpu.plan.optimizer import optimize
+    rel = optimize(df._plan).children[0]
+    assert rel.columns == ["a"], rel.columns
+
+
+def test_row_group_pruning_skips_groups(pq_file):
+    s = tpu_session()
+    df = s.read.parquet(pq_file).filter(col("a") < 1500) \
+        .select(col("a"), col("b"))
+    out = df.toArrow()
+    assert out.num_rows == 1500
+    metrics = dict(df._last_plan.collect_metrics())
+    scan = [v for k, v in metrics.items() if "Scan" in k][0]
+    assert scan.get("prunedRowGroups", 0) >= 8, metrics
+
+
+def test_pushdown_oracle_equal(pq_file):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(pq_file)
+        .filter((col("a") >= 2000) & (col("a") < 4000) & (col("d") != 3))
+        .select("a", "d", (col("b") * 2).alias("b2")))
+
+
+def test_agg_head_pruning_oracle(pq_file):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(pq_file).groupBy("d").agg(
+            F.sum("a").alias("sa")),
+        ignore_order=True)
+
+
+def test_input_file_name(pq_file):
+    s = tpu_session()
+    out = s.read.parquet(pq_file).select(
+        "a", F.input_file_name().alias("f")).limit(5).toArrow()
+    assert all(v.endswith("t.parquet") for v in
+               out.column("f").to_pylist())
+
+
+def test_partitioned_write_read_round_trip(tmp_path):
+    t = pa.table({
+        "k": pa.array([1, 1, 2, 2, 3], type=pa.int64()),
+        "g": pa.array(["x", "y", "x", "y", "x"]),
+        "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+    })
+    out = str(tmp_path / "part_out")
+    s = cpu_session()
+    s.createDataFrame(t).write.partitionBy("k").parquet(out)
+    # hive layout on disk
+    assert sorted(d for d in os.listdir(out)) == ["k=1", "k=2", "k=3"]
+    # read back: partition column reconstructed from dir names
+    back = tpu_session().read.parquet(out).orderBy("v").toArrow()
+    assert back.column("v").to_pylist() == [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert back.column("k").to_pylist() == [1, 1, 2, 2, 3]
+
+
+def test_partitioned_read_oracle(tmp_path):
+    t = big_table(2000, 3)
+    out = str(tmp_path / "p2")
+    cpu_session().createDataFrame(t).write.partitionBy("d").parquet(out)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(out).groupBy("d").agg(
+            F.count("*").alias("c"), F.sum("a").alias("sa")),
+        ignore_order=True)
+
+
+def test_orc_round_trip(tmp_path):
+    t = big_table(500, 1)
+    out = str(tmp_path / "t_orc")
+    cpu_session().createDataFrame(t).write.orc(out)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.orc(out).filter(col("d") == 5)
+        .select("a", "c"))
+
+
+def test_text_reader(tmp_path):
+    p = str(tmp_path / "lines.txt")
+    with open(p, "w") as f:
+        f.write("alpha\nbeta\ngamma\n")
+    s = tpu_session()
+    out = s.read.text(p).toArrow()
+    assert out.column("value").to_pylist() == ["alpha", "beta", "gamma"]
+
+
+def test_avro_gated(tmp_path):
+    s = tpu_session()
+    with pytest.raises(NotImplementedError):
+        s.read.avro(str(tmp_path / "x.avro"))
+
+
+def test_orc_partition_only_select(tmp_path):
+    # pruning to zero data columns must not lose the ORC row count
+    t = pa.table({"k": pa.array([1, 1, 2], type=pa.int64()),
+                  "v": pa.array([1.0, 2.0, 3.0])})
+    out = str(tmp_path / "po")
+    cpu_session().createDataFrame(t).write.partitionBy("k").orc(out)
+    s = tpu_session()
+    assert s.read.orc(out).select("k").count() == 3
+    got = s.read.orc(out).agg(F.count("*").alias("c")).collect()
+    assert got[0].c == 3
+
+
+def test_metadata_dirs_skipped(tmp_path):
+    t = pa.table({"x": pa.array([1, 2, 3], type=pa.int64())})
+    out = str(tmp_path / "d")
+    cpu_session().createDataFrame(t).write.parquet(out)
+    os.makedirs(os.path.join(out, "_delta_log"))
+    with open(os.path.join(out, "_delta_log", "00000.json"), "w") as f:
+        f.write("{}")
+    assert tpu_session().read.parquet(out).count() == 3
+
+
+def test_write_modes(tmp_path):
+    t = pa.table({"x": pa.array([1, 2], type=pa.int64())})
+    out = str(tmp_path / "m")
+    s = cpu_session()
+    s.createDataFrame(t).write.parquet(out)
+    with pytest.raises(FileExistsError):
+        s.createDataFrame(t).write.parquet(out)
+    s.createDataFrame(t).write.mode("ignore").parquet(out)
+    s.createDataFrame(t).write.mode("overwrite").parquet(out)
+    assert tpu_session().read.parquet(out).count() == 2

@@ -1,0 +1,283 @@
+"""Range / Sample / Expand (rollup, cube) / Generate (explode) / TopN.
+
+[REF: integration_tests/src/main/python/ — row_count/sample/expand/
+ generate/limit test families; SURVEY §2.1 #16/#18]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+def kv_table(seed=0, n=400):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array((np.arange(n) % 7).astype(np.int32)),
+        "g": pa.array([f"g{i % 3}" for i in range(n)]),
+        "v": dg.DoubleGen().generate(rng, n),
+        "i": dg.IntegerGen().generate(rng, n),
+    })
+
+
+def list_table():
+    return pa.table({
+        "id": pa.array(np.arange(6, dtype=np.int64)),
+        "arr": pa.array([[1, 2, 3], [], [7], None, [9, 10], [0]],
+                        type=pa.list_(pa.int64())),
+    })
+
+
+# -- Range ------------------------------------------------------------------
+
+def test_range_simple():
+    assert_tpu_and_cpu_are_equal_collect(lambda s: s.range(100))
+
+
+def test_range_step_partitions():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(5, 95, 3, numPartitions=4))
+
+
+def test_range_negative_step():
+    assert_tpu_and_cpu_are_equal_collect(lambda s: s.range(50, 0, -7))
+
+
+def test_range_empty():
+    assert_tpu_and_cpu_are_equal_collect(lambda s: s.range(10, 10))
+
+
+def test_range_feeds_ops():
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.range(0, 1000, 1, numPartitions=3)
+        .filter(col("id") % 5 == 0)
+        .select((col("id") * 2).alias("x")))
+
+
+# -- Sample -----------------------------------------------------------------
+
+def test_sample_oracle_equal():
+    t = kv_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).sample(0.5, 42))
+
+
+def test_sample_fraction_stats():
+    # hash-Bernoulli draw should land near the fraction on large input
+    n = 20000
+    t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64))})
+    s = tpu_session()
+    got = s.createDataFrame(t).sample(0.25, 7).count()
+    assert abs(got / n - 0.25) < 0.02
+
+
+def test_sample_deterministic():
+    t = kv_table(3)
+    s = tpu_session()
+    a = s.createDataFrame(t).sample(0.3, 99).select("k", "g", "i").toArrow()
+    b = s.createDataFrame(t).sample(0.3, 99).select("k", "g", "i").toArrow()
+    assert a.equals(b)  # NaN-free columns: draw is fully deterministic
+
+
+def test_sample_seed_varies():
+    n = 5000
+    t = pa.table({"x": pa.array(np.arange(n, dtype=np.int64))})
+    s = tpu_session()
+    a = s.createDataFrame(t).sample(0.5, 1).toArrow()
+    b = s.createDataFrame(t).sample(0.5, 2).toArrow()
+    assert not a.equals(b)
+
+
+# -- Expand: rollup / cube --------------------------------------------------
+
+def test_rollup_single_key():
+    t = kv_table(1)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).rollup("k").agg(
+            F.sum("v").alias("s"), F.count("*").alias("c")),
+        ignore_order=True, approx_float=True)
+
+
+def test_rollup_two_keys():
+    t = kv_table(2)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).rollup("k", "g").agg(
+            F.sum("v").alias("s")),
+        ignore_order=True, approx_float=True)
+
+
+def test_cube_two_keys():
+    t = kv_table(4)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).cube("k", "g").agg(
+            F.min("i").alias("mn"), F.max("v").alias("mx")),
+        ignore_order=True, approx_float=True)
+
+
+def test_rollup_row_counts():
+    # rollup(k) over 7 distinct keys → 7 + 1 grand-total rows
+    t = kv_table(5)
+    s = tpu_session()
+    out = s.createDataFrame(t).rollup("k").agg(F.count("*").alias("c"))
+    assert out.count() == 8
+
+
+def test_cube_null_keys():
+    t = pa.table({
+        "k": pa.array([1, None, 2, None, 1], type=pa.int32()),
+        "v": pa.array([1.0, 2.0, 3.0, 4.0, 5.0]),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).cube("k").agg(
+            F.sum("v").alias("s")),
+        ignore_order=True, approx_float=True)
+
+
+# -- Generate: explode ------------------------------------------------------
+
+def test_explode_basic():
+    t = list_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.explode(col("arr")).alias("x")))
+
+
+def test_explode_outer():
+    t = list_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.explode_outer(col("arr")).alias("x")))
+
+
+def test_posexplode():
+    t = list_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.posexplode(col("arr"))))
+
+
+def test_posexplode_outer():
+    t = list_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.posexplode_outer(col("arr"))))
+
+
+def test_explode_then_agg():
+    t = list_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t)
+        .select("id", F.explode(col("arr")).alias("x"))
+        .groupBy("id").agg(F.sum("x").alias("s")),
+        ignore_order=True)
+
+
+def test_explode_double_elements():
+    t = pa.table({
+        "id": pa.array([1, 2], type=pa.int64()),
+        "arr": pa.array([[1.5, -2.5], [0.0]],
+                        type=pa.list_(pa.float64())),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.explode(col("arr")).alias("x")))
+
+
+def test_explode_null_elements_on_device():
+    # element nulls ride the evalid plane — device result must match
+    # the oracle (1, NULL, 3), not coerce nulls to 0
+    t = pa.table({
+        "id": pa.array([1, 2], type=pa.int64()),
+        "arr": pa.array([[1, None, 3], [None]], type=pa.list_(pa.int64())),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.explode(col("arr")).alias("x")))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.posexplode_outer(col("arr"))))
+
+
+def test_array_null_elements_round_trip():
+    t = pa.table({
+        "arr": pa.array([[1, None], None, [3]], type=pa.list_(pa.int64())),
+    })
+    s = tpu_session()
+    out = s.createDataFrame(t).select("arr").toArrow()
+    assert out.column("arr").to_pylist() == [[1, None], None, [3]]
+
+
+def test_sample_full_fraction_keeps_all():
+    t = kv_table(12)
+    s = tpu_session()
+    assert s.createDataFrame(t).sample(1.0, 5).count() == t.num_rows
+
+
+def test_sample_keyword_seed_deterministic():
+    t = kv_table(13)
+    s = tpu_session()
+    a = s.createDataFrame(t).sample(0.4, seed=7).select("k", "i").toArrow()
+    b = s.createDataFrame(t).sample(0.4, seed=7).select("k", "i").toArrow()
+    assert a.equals(b)
+
+
+def test_explode_string_elements_falls_back():
+    t = pa.table({
+        "id": pa.array([1, 2], type=pa.int64()),
+        "arr": pa.array([["x", "y"], [None]], type=pa.list_(pa.string())),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "id", F.explode(col("arr")).alias("e")),
+        allow_non_tpu=["Generate", "InMemoryScan", "Project"])
+
+
+# -- TakeOrderedAndProject --------------------------------------------------
+
+def test_topn_basic():
+    t = kv_table(6)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy(col("v").desc()).limit(5))
+
+
+def test_topn_multi_partition():
+    t = kv_table(7, n=1000)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).repartition(4)
+        .orderBy(col("i"), col("v").desc()).limit(17),
+        conf={"spark.default.parallelism": 4})
+
+
+def test_topn_with_nulls():
+    t = kv_table(8)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t)
+        .orderBy(col("i").asc_nulls_last()).limit(9))
+
+
+def test_topn_under_project():
+    t = kv_table(9)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy(col("v"))
+        .select((col("v") * 2).alias("w"), "k").limit(4))
+
+
+def test_topn_n_larger_than_input():
+    t = kv_table(10, n=30)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).orderBy("v").limit(100))
+
+
+def test_topn_is_planned():
+    # the Limit(Sort) pattern must plan a TpuTopN, not a global sort
+    t = kv_table(11)
+    s = tpu_session()
+    df = s.createDataFrame(t).orderBy("v").limit(3)
+    df.toArrow()
+    tree = df._last_plan.tree_string()
+    assert "TopN" in tree, tree
