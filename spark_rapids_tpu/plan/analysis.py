@@ -260,6 +260,10 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
         return Murmur3Hash([resolve(c, schema) for c in u.children])
     if op == "input_file_name":
         return E.InputFileName()
+    if op == "pyudf":
+        raise AnalysisException(
+            "python UDFs are only supported as top-level select "
+            "expressions (optionally aliased)")
     if op == "agg":
         raise AnalysisException(
             f"aggregate function '{u.payload}' is only allowed in agg()")

@@ -247,6 +247,45 @@ class Generate(LogicalPlan):
 
 
 @dataclasses.dataclass
+class PythonEval(LogicalPlan):
+    """Appends python-UDF result columns [REF: Spark BatchEvalPython /
+    ArrowEvalPython]."""
+
+    child: LogicalPlan
+    udfs: List  # List[exec.python_udf.PyUDFSpec]
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class MapInPandas(LogicalPlan):
+    child: LogicalPlan
+    fn: object
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
+class FlatMapGroupsInPandas(LogicalPlan):
+    """Grouped map — child must be co-partitioned on key_indices."""
+
+    child: LogicalPlan
+    key_indices: List[int]
+    fn: object
+    schema: T.StructType
+
+    @property
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass
 class Union(LogicalPlan):
     inputs: List[LogicalPlan]
 

@@ -370,6 +370,22 @@ def _register_lazy_rules():
             "per-partition device topN + winner merge"))
     except ImportError:
         pass
+    try:
+        from spark_rapids_tpu.exec import python_udf as PU
+        EXEC_RULES.setdefault(PU.CpuArrowEvalPythonExec, ExecRule(
+            "ArrowEvalPython", PU._tag_python_eval,
+            PU._convert_python_eval,
+            "python/pandas UDFs: device args → in-process arrow bridge"))
+        EXEC_RULES.setdefault(PU.CpuMapInPandasExec, ExecRule(
+            "MapInPandas", PU._tag_map_in_pandas,
+            PU._convert_map_in_pandas,
+            "mapInPandas over the arrow bridge"))
+        EXEC_RULES.setdefault(PU.CpuFlatMapGroupsInPandasExec, ExecRule(
+            "FlatMapGroupsInPandas", PU._tag_flat_map_groups,
+            PU._convert_flat_map_groups,
+            "grouped-map pandas UDF above a device hash exchange"))
+    except ImportError:
+        pass
 
 
 # ---------------------------------------------------------------------------

@@ -61,6 +61,20 @@ def plan_physical(node: L.LogicalPlan, conf: RapidsConf) -> CpuExec:
         return CpuGenerateExec(node.generator, node.with_pos, node.outer,
                                node.schema,
                                plan_physical(node.child, conf))
+    if isinstance(node, L.PythonEval):
+        from spark_rapids_tpu.exec.python_udf import CpuArrowEvalPythonExec
+        return CpuArrowEvalPythonExec(node.udfs, node.schema,
+                                      plan_physical(node.child, conf))
+    if isinstance(node, L.MapInPandas):
+        from spark_rapids_tpu.exec.python_udf import CpuMapInPandasExec
+        return CpuMapInPandasExec(node.fn, node.schema,
+                                  plan_physical(node.child, conf))
+    if isinstance(node, L.FlatMapGroupsInPandas):
+        from spark_rapids_tpu.exec.python_udf import (
+            CpuFlatMapGroupsInPandasExec)
+        return CpuFlatMapGroupsInPandasExec(
+            node.key_indices, node.fn, node.schema,
+            plan_physical(node.child, conf))
     if isinstance(node, L.Union):
         return B.CpuUnionExec([plan_physical(c, conf) for c in node.inputs])
     if isinstance(node, L.Aggregate):

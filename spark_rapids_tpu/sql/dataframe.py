@@ -72,6 +72,15 @@ class DataFrame:
         if any(self._generate_u(c) is not None for c in cols
                if not (isinstance(c, str) and c == "*")):
             return self._select_with_generate(cols)
+        if any(self._pyudf_u(c) is not None for c in cols
+               if not (isinstance(c, str) and c == "*")):
+            if any(self._window_u(c) is not None for c in cols
+                   if not (isinstance(c, str) and c == "*")):
+                raise AN.AnalysisException(
+                    "cannot mix python UDFs and window functions in one "
+                    "select — materialize one of them first "
+                    "(e.g. withColumn)")
+            return self._select_with_pyudfs(cols)
         if any(self._window_u(c) is not None for c in cols
                if not (isinstance(c, str) and c == "*")):
             return self._select_with_windows(cols)
@@ -153,6 +162,74 @@ class DataFrame:
             fields.append(T.StructField(self._output_name(u, e), e.dtype))
         return DataFrame(self.session, L.Project(
             plan, exprs, T.StructType(tuple(fields))))
+
+    @staticmethod
+    def _pyudf_u(c) -> Optional[UExpr]:
+        if isinstance(c, str):
+            return None
+        u = _to_column(c)._u
+        core = u.children[0] if u.op == "alias" else u
+        return core if core.op == "pyudf" else None
+
+    def _select_with_pyudfs(self, cols) -> "DataFrame":
+        """Spark's ExtractPythonUDFs analog: one PythonEval node appends
+        every UDF result column, then a Project picks the output."""
+        from spark_rapids_tpu.exec.python_udf import PyUDFSpec
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        base_schema = self.schema
+        nc = len(base_schema)
+        udfs = []
+        out_specs = []
+        for c in cols:
+            if isinstance(c, str) and c == "*":
+                out_specs.append(("plain", c))
+                continue
+            uu = self._pyudf_u(c)
+            if uu is None:
+                out_specs.append(("plain", c))
+                continue
+            fn, dt, vectorized, fname = uu.payload
+            args = [AN.resolve(a, base_schema) for a in uu.children]
+            u = _to_column(c)._u
+            alias = u.payload if u.op == "alias" else None
+            name = alias or f"{fname}({', '.join(map(str, args))})"
+            udfs.append(PyUDFSpec(fn, args, dt, vectorized, name))
+            out_specs.append(("udf", len(udfs) - 1, name, dt))
+        ext_fields = (list(base_schema.fields)
+                      + [T.StructField(f"_udf{i}", u.dtype, True)
+                         for i, u in enumerate(udfs)])
+        ext_schema = T.StructType(tuple(ext_fields))
+        plan = L.PythonEval(self._plan, udfs, ext_schema)
+        exprs, fields = [], []
+        for spec in out_specs:
+            if spec[0] == "plain":
+                c = spec[1]
+                if isinstance(c, str) and c == "*":
+                    for i, f in enumerate(base_schema.fields):
+                        exprs.append(BoundReference(i, f.dtype,
+                                                    f.nullable))
+                        fields.append(f)
+                    continue
+                u = _to_column(c)._u
+                e = AN.resolve(u, ext_schema)
+                exprs.append(e)
+                fields.append(T.StructField(self._output_name(u, e),
+                                            e.dtype))
+            else:
+                _, i, name, dt = spec
+                exprs.append(BoundReference(nc + i, dt, True))
+                fields.append(T.StructField(name, dt, True))
+        return DataFrame(self.session, L.Project(
+            plan, exprs, T.StructType(tuple(fields))))
+
+    def mapInPandas(self, fn, schema) -> "DataFrame":
+        """fn(iterator[pandas.DataFrame]) → iterator[pandas.DataFrame]
+        with the declared output schema [REF: GpuMapInPandasExec]."""
+        if not isinstance(schema, T.StructType):
+            raise AN.AnalysisException(
+                "mapInPandas needs a StructType output schema")
+        return DataFrame(self.session,
+                         L.MapInPandas(self._plan, fn, schema))
 
     @staticmethod
     def _window_u(c) -> Optional[UExpr]:
@@ -708,6 +785,29 @@ class GroupedData:
     def count(self) -> DataFrame:
         from spark_rapids_tpu.sql import functions as F
         return self.agg(F.count("*").alias("count"))
+
+    def applyInPandas(self, fn, schema) -> DataFrame:
+        """Grouped-map pandas UDF: fn(pandas.DataFrame) → DataFrame per
+        group.  Rides a hash exchange on the keys so one group never
+        splits [REF: GpuFlatMapGroupsInPandasExec]."""
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        if not isinstance(schema, T.StructType):
+            raise AN.AnalysisException(
+                "applyInPandas needs a StructType output schema")
+        if self.sets is not None:
+            raise AN.AnalysisException(
+                "applyInPandas is not supported under rollup/cube")
+        key_indices = []
+        for g in self.grouping:
+            if not isinstance(g, BoundReference):
+                raise AN.AnalysisException(
+                    "applyInPandas grouping keys must be plain columns")
+            key_indices.append(g.index)
+        nparts = self.df.session.rapids_conf().shuffle_partitions
+        shuffled = L.Repartition(self.df._plan, nparts,
+                                 list(self.grouping))
+        return DataFrame(self.df.session, L.FlatMapGroupsInPandas(
+            shuffled, key_indices, fn, schema))
 
     def _simple(self, kind, *cols):
         from spark_rapids_tpu.sql import functions as F

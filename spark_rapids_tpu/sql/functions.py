@@ -140,6 +140,41 @@ stddev = stddev_samp
 collect_list = _agg1("collect_list")
 
 
+# python UDFs ---------------------------------------------------------------
+
+def _make_udf(f, returnType, vectorized: bool):
+    from spark_rapids_tpu.columnar import dtypes as T
+    from spark_rapids_tpu.plan.analysis import _parse_type
+    dt = (returnType if isinstance(returnType, T.DataType)
+          else _parse_type(returnType))
+
+    def call(*cols) -> Column:
+        name = getattr(f, "__name__", "udf")
+        return Column(UExpr("pyudf", (f, dt, vectorized, name),
+                            tuple(_cu(c) for c in cols)))
+
+    call.__name__ = getattr(f, "__name__", "udf")
+    return call
+
+
+def udf(f=None, returnType="string"):
+    """Row-at-a-time python UDF (also usable as @udf(returnType=...)).
+    [REF: GpuRowBasedScalaUDF analog — runs host-side over the arrow
+    bridge, args computed on device]"""
+    if f is None or not callable(f):
+        rt = returnType if f is None else f
+        return lambda fn: _make_udf(fn, rt, False)
+    return _make_udf(f, returnType, False)
+
+
+def pandas_udf(f=None, returnType="double"):
+    """Vectorized pandas UDF (Series → Series)."""
+    if f is None or not callable(f):
+        rt = returnType if f is None else f
+        return lambda fn: _make_udf(fn, rt, True)
+    return _make_udf(f, returnType, True)
+
+
 def input_file_name() -> Column:
     """File path of the current row's source file (file scans only)."""
     return Column(UExpr("input_file_name", None))

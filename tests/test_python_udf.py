@@ -1,0 +1,196 @@
+"""Python / pandas UDF bridge: scalar UDFs, mapInPandas, applyInPandas.
+
+[REF: integration_tests/src/main/python/udf_test.py — scalar /
+ grouped-map / map-in-pandas families; SURVEY §2.1 #29]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+def base_table(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array((np.arange(n) % 5).astype(np.int32)),
+        "a": pa.array(rng.integers(-100, 100, n)),
+        "b": pa.array(rng.normal(size=n)),
+        "s": pa.array([f"row{i}" for i in range(n)]),
+    })
+
+
+def test_row_udf():
+    t = base_table()
+    plus_one = F.udf(lambda x: None if x is None else int(x) + 1, "long")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "a", plus_one(col("a")).alias("a1")))
+
+
+def test_row_udf_two_args_string():
+    t = base_table(1)
+    fmt = F.udf(lambda k, s: f"{s}#{k}", "string")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            fmt(col("k"), col("s")).alias("f")))
+
+
+def test_pandas_udf_vectorized():
+    t = base_table(2)
+    times2 = F.pandas_udf(lambda x: x * 2.0, "double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "b", times2(col("b")).alias("b2")),
+        approx_float=True)
+
+
+def test_udf_over_expression_args():
+    # args computed on device before crossing the bridge
+    t = base_table(3)
+    f = F.pandas_udf(lambda x: x.abs(), "double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            f((col("a") + col("b")) / 2.0).alias("m")),
+        approx_float=True)
+
+
+def test_udf_decorator_form():
+    t = base_table(4)
+
+    @F.udf(returnType="int")
+    def parity(x):
+        return int(x) % 2 if x is not None else None
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "a", parity(col("a")).alias("p")))
+
+
+def test_multiple_udfs_one_select():
+    t = base_table(5)
+    u1 = F.udf(lambda x: int(x) * 10, "long")
+    u2 = F.pandas_udf(lambda x: -x, "double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            u1(col("a")).alias("x"), "k", u2(col("b")).alias("y")),
+        approx_float=True)
+
+
+def test_udf_then_filter_agg():
+    t = base_table(6)
+    sq = F.pandas_udf(lambda x: x * x, "double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t)
+        .select("k", sq(col("b")).alias("b2"))
+        .filter(col("b2") > 0.5)
+        .groupBy("k").agg(F.sum("b2").alias("sb")),
+        ignore_order=True, approx_float=True)
+
+
+def test_map_in_pandas():
+    t = base_table(7)
+
+    def double_and_filter(frames):
+        for df in frames:
+            out = df[df["a"] > 0][["k", "a"]].copy()
+            out["a"] = out["a"] * 2
+            yield out
+
+    schema = T.StructType((T.StructField("k", T.IntegerT),
+                           T.StructField("a", T.LongT)))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).mapInPandas(
+            double_and_filter, schema),
+        ignore_order=True)
+
+
+def test_apply_in_pandas_grouped():
+    t = base_table(8)
+
+    def center(g):
+        out = g[["k", "b"]].copy()
+        out["b"] = out["b"] - out["b"].mean()
+        return out
+
+    schema = T.StructType((T.StructField("k", T.IntegerT),
+                           T.StructField("b", T.DoubleT)))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").applyInPandas(
+            center, schema),
+        ignore_order=True, approx_float=True,
+        conf={"spark.sql.shuffle.partitions": 3})
+
+
+def test_apply_in_pandas_matches_engine_agg():
+    # grouped-map sum must equal the engine's own groupBy sum
+    t = base_table(9)
+
+    def gsum(g):
+        import pandas as pd
+        return pd.DataFrame({"k": [g["k"].iloc[0]],
+                             "sb": [g["b"].sum()]})
+
+    schema = T.StructType((T.StructField("k", T.IntegerT),
+                           T.StructField("sb", T.DoubleT)))
+    s = tpu_session()
+    got = {r.k: r.sb for r in s.createDataFrame(t).groupBy("k")
+           .applyInPandas(gsum, schema).collect()}
+    want = {r.k: r.sb for r in s.createDataFrame(t).groupBy("k")
+            .agg(F.sum("b").alias("sb")).collect()}
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-9
+
+
+def test_udf_result_missing_column_raises():
+    t = base_table(10)
+
+    def bad(frames):
+        import pandas as pd
+        for df in frames:
+            yield pd.DataFrame({"wrong": [1]})
+
+    schema = T.StructType((T.StructField("k", T.IntegerT),))
+    s = tpu_session()
+    with pytest.raises(ValueError):
+        s.createDataFrame(t).mapInPandas(bad, schema).collect()
+
+
+def test_zero_arg_udf():
+    t = base_table(50, 11)
+    one = F.udf(lambda: 1, "long")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select("k", one().alias("c")))
+
+
+def test_pandas_udf_wrong_length_raises():
+    t = base_table(12)
+    bad = F.pandas_udf(lambda x: x.head(5), "double")
+    s = tpu_session()
+    with pytest.raises(ValueError, match="expected"):
+        s.createDataFrame(t).select(bad(col("b")).alias("x")).collect()
+
+
+def test_udf_window_mix_raises():
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    from spark_rapids_tpu.sql.window import Window
+    t = base_table(13)
+    u = F.udf(lambda x: x, "long")
+    s = tpu_session()
+    w = Window.partitionBy("k").orderBy("a")
+    with pytest.raises(AnalysisException, match="mix python UDFs"):
+        s.createDataFrame(t).select(u(col("a")).alias("ua"),
+                                    F.row_number().over(w).alias("r"))
+
+
+def test_udf_nulls_cross_bridge():
+    t = pa.table({"x": pa.array([1, None, 3], type=pa.int64())})
+    u = F.udf(lambda v: None if v is None else v * 100, "long")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(u(col("x")).alias("y")))
