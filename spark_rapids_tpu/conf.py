@@ -340,6 +340,36 @@ BROADCAST_THRESHOLD = (
     .create_with_default(10 << 20)
 )
 
+ADAPTIVE_ENABLED = (
+    conf("spark.sql.adaptive.enabled")
+    .doc("Adaptive query execution: shuffle-read coalescing of small "
+         "partitions and splitting of skewed ones, planned from measured "
+         "partition sizes (Spark core key, honored here).")
+    .category("aqe")
+    .boolean()
+    .create_with_default(True)
+)
+
+ADVISORY_PARTITION_SIZE = (
+    conf("spark.sql.adaptive.advisoryPartitionSizeInBytes")
+    .doc("Target bytes per shuffle-read partition after AQE coalescing/"
+         "skew-splitting (Spark core key, honored here).")
+    .category("aqe")
+    .bytes()
+    .create_with_default(64 << 20)
+)
+
+DPP_ENABLED = (
+    conf("spark.sql.optimizer.dynamicPartitionPruning.enabled")
+    .doc("Dynamic partition pruning: joins on a hive-partition column "
+         "evaluate the build side's distinct keys first and skip "
+         "non-matching files of the probe-side scan (Spark core key, "
+         "honored here).")
+    .category("aqe")
+    .boolean()
+    .create_with_default(True)
+)
+
 ANSI_ENABLED = (
     conf("spark.sql.ansi.enabled")
     .doc("ANSI mode: arithmetic overflow and invalid casts raise instead "

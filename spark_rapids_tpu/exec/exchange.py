@@ -98,15 +98,7 @@ class CpuShuffleExchangeExec(CpuExec):
             yield b
 
 
-def _host_strings_to_mat(data: np.ndarray):
-    enc = [v.encode() if isinstance(v, str) else bytes(v) for v in data]
-    mx = max((len(v) for v in enc), default=1) or 1
-    mat = np.zeros((len(enc), mx), np.uint8)
-    lengths = np.zeros(len(enc), np.int32)
-    for i, v in enumerate(enc):
-        mat[i, :len(v)] = np.frombuffer(v, np.uint8)
-        lengths[i] = len(v)
-    return mat, lengths
+_host_strings_to_mat = HH.host_strings_to_matrix
 
 
 class TpuShuffleExchangeExec(TpuExec):
@@ -121,6 +113,7 @@ class TpuShuffleExchangeExec(TpuExec):
         self.nparts = num_partitions
         self.keys = list(keys) if keys else None
         self._materialized = None
+        self._batch_counts = None
         self._mat_lock = threading.Lock()
 
     def node_string(self):
@@ -184,6 +177,55 @@ class TpuShuffleExchangeExec(TpuExec):
             self.metric("numOutputBatches").add(1)
             yield out
 
+    # -- AQE stats + shaped reads [REF: GpuAQEShuffleReadExec] -----------
+    def aqe_partition_stats(self):
+        return "rows", self.partition_row_counts()
+
+    def partition_row_counts(self) -> np.ndarray:
+        """Live rows per output partition (one device bincount per
+        input batch; the map-stage statistics AQE plans from).  Caches
+        the per-batch counts so skew reads can compute their rank bases
+        host-side without any further device syncs."""
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+        if getattr(self, "_batch_counts", None) is not None:
+            return self._batch_counts.sum(axis=0)
+        nparts = self.nparts
+
+        def build():
+            def run(sel, pid):
+                return jnp.bincount(jnp.where(sel, pid, nparts),
+                                    length=nparts + 1)[:nparts]
+            return run
+
+        fn = cached_kernel(("pid_counts", nparts), build)
+        per_batch = [np.asarray(fn(b.sel, pid))
+                     for b, pid in self._materialize()]
+        self._batch_counts = (np.stack(per_batch) if per_batch
+                              else np.zeros((0, nparts), np.int64))
+        return self._batch_counts.sum(axis=0)
+
+    def execute_pid_range(self, lo: int, hi: int
+                          ) -> Iterator[DeviceBatch]:
+        """Coalesced read: partitions [lo, hi) as one output."""
+        for b, pid in self._materialize():
+            yield b.with_sel(b.sel & (pid >= lo) & (pid < hi))
+
+    def execute_split(self, p: int, j: int, k: int
+                      ) -> Iterator[DeviceBatch]:
+        """Skew read: slice j of k of partition p (by in-partition row
+        rank, stable across batches).  Rank bases come from the cached
+        per-batch counts — no device syncs in the read path."""
+        self.partition_row_counts()  # ensures _batch_counts
+        bases = np.concatenate(
+            [[0], np.cumsum(self._batch_counts[:, p])[:-1]]) \
+            if len(self._batch_counts) else []
+        for (b, pid), base in zip(self._materialize(), bases):
+            mine = b.sel & (pid == p)
+            rank = jnp.int32(int(base)) + \
+                jnp.cumsum(mine.astype(jnp.int32)) - 1
+            # k-way interleave by rank: slice j takes ranks ≡ j (mod k)
+            yield b.with_sel(mine & (rank % k == j))
+
 
 def _tag_exchange(meta):
     if meta.cpu.keys:
@@ -201,9 +243,18 @@ def _convert_exchange(cpu, ch, conf):
     if conf.shuffle_mode == "MULTITHREADED":
         from spark_rapids_tpu.shuffle.exchange import (
             TpuHostShuffleExchangeExec)
-        return TpuHostShuffleExchangeExec(
+        exchange = TpuHostShuffleExchangeExec(
             ch[0], cpu.nparts, cpu.keys,
             nthreads=conf.get(C.SHUFFLE_THREADS),
             min_bucket=conf.min_bucket_rows)
-    # CACHE_ONLY: in-process device-resident exchange (sel-mask views)
-    return TpuShuffleExchangeExec(ch[0], cpu.nparts, cpu.keys)
+    else:
+        # CACHE_ONLY: in-process device-resident exchange (sel-mask views)
+        exchange = TpuShuffleExchangeExec(ch[0], cpu.nparts, cpu.keys)
+    if conf.get(C.ADAPTIVE_ENABLED):
+        from spark_rapids_tpu.exec.aqe import TpuAQEShuffleReadExec
+        from spark_rapids_tpu.plan.overrides import _estimated_row_bytes
+        return TpuAQEShuffleReadExec(
+            exchange, conf.get(C.ADVISORY_PARTITION_SIZE),
+            _estimated_row_bytes(cpu.schema),
+            allow_split=cpu.keys is None)
+    return exchange

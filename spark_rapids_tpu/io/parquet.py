@@ -97,6 +97,37 @@ class CpuParquetScanExec(CpuExec):
         self.columns = relation.columns
         self._num_partitions = max(1, min(len(self.paths),
                                           conf.shuffle_partitions))
+        self._dpp_keep_cache = None
+        self._dpp_lock = __import__("threading").Lock()
+
+    def _dpp_keep(self):
+        """File indices surviving dynamic partition pruning (None = all).
+
+        Evaluates the build-side subquery ONCE, host-side, before the
+        scan pumps [REF: GpuSubqueryBroadcastExec — the reference reuses
+        the broadcast; dims are small, so a host evaluation is the
+        in-process analog]."""
+        if self.relation.dpp is None:
+            return None
+        with self._dpp_lock:
+            if self._dpp_keep_cache is not None:
+                return self._dpp_keep_cache
+            sub_plan, col_name = self.relation.dpp
+            from spark_rapids_tpu.plan.planner import plan_physical
+            sub = plan_physical(sub_plan, self.conf)
+            values = set()
+            for p in range(sub.num_partitions()):
+                for b in sub.execute(p):
+                    c = b.columns[0]
+                    tbl_col = H.to_arrow_column(c)
+                    values.update(v for v in tbl_col.to_pylist()
+                                  if v is not None)
+            keep = {fi for fi, pv in
+                    enumerate(self.relation.partition_values)
+                    if pv.get(col_name) in values}
+            self.metric("dppPrunedFiles").add(len(self.paths) - len(keep))
+            self._dpp_keep_cache = keep
+            return keep
 
     def node_string(self):
         extra = ""
@@ -157,6 +188,9 @@ class CpuParquetScanExec(CpuExec):
     def execute(self, partition: int) -> Iterator[H.HostBatch]:
         idxs = _partition_files(len(self.paths),
                                 self._num_partitions)[partition]
+        keep = self._dpp_keep()
+        if keep is not None:
+            idxs = [fi for fi in idxs if fi in keep]
         for fi in idxs:
             with self.timer():
                 tbl = self._read_file(fi)
@@ -190,6 +224,11 @@ class TpuParquetScanExec(TpuExec):
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         idxs = _partition_files(len(self.paths),
                                 self._num_partitions)[partition]
+        keep = self._cpu._dpp_keep()
+        if keep is not None:
+            idxs = [fi for fi in idxs if fi in keep]
+            self.metric("dppPrunedFiles").value = \
+                self._cpu.metric("dppPrunedFiles").value
         if not idxs:
             return
         with cf.ThreadPoolExecutor(max_workers=self.num_threads) as pool:

@@ -241,6 +241,20 @@ def jax_bitcast(x, dt):
     return lax.bitcast_convert_type(x, dt)
 
 
+def host_strings_to_matrix(data) -> tuple:
+    """Host object-array of str/bytes → (uint8[B, W] matrix, int32
+    lengths) — the one shared padding helper for every host hash/shuffle
+    path."""
+    enc = [v.encode() if isinstance(v, str) else bytes(v) for v in data]
+    w = max(max((len(v) for v in enc), default=1), 1)
+    mat = np.zeros((len(enc), w), np.uint8)
+    lengths = np.zeros(len(enc), np.int32)
+    for i, v in enumerate(enc):
+        mat[i, :len(v)] = np.frombuffer(v, np.uint8)
+        lengths[i] = len(v)
+    return mat, lengths
+
+
 def hash_column(col, dt: T.DataType, h, valid, xp):
     """Mix one column into running uint32 hash h; rows where ~valid keep h."""
     if isinstance(col, DeviceColumn) or isinstance(col, HostCol):
@@ -304,17 +318,7 @@ class Murmur3Hash(Expression):
         for e in self.exprs:
             c = e.eval_cpu(batch)
             if isinstance(e.dtype, (T.StringType, T.BinaryType)):
-                # build byte matrix from object array
-                bs = [s.encode() if isinstance(s, str) else bytes(s)
-                      for s in c.data]
-                w = max((len(x) for x in bs), default=1)
-                w = max(w, 1)
-                mat = np.zeros((n, w), np.uint8)
-                lengths = np.zeros(n, np.int32)
-                for i, x in enumerate(bs):
-                    mat[i, :len(x)] = np.frombuffer(x, np.uint8)
-                    lengths[i] = len(x)
-                data = (mat, lengths)
+                data = host_strings_to_matrix(c.data)
             else:
                 data = (c.data, None)
             h = hash_column(data, e.dtype, h, c.valid_mask(), np)
@@ -326,3 +330,310 @@ def partition_ids_from_hash(h_i32, num_partitions: int, xp):
     n = np.int32(num_partitions)
     r = h_i32 % n
     return xp.where(r < 0, r + n, r).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# xxhash64 — Spark's second hash family [REF: spark-rapids-jni ::
+# src/main/cpp/src/xxhash64.cu; Spark XXH64.java semantics]
+#
+# Same column protocol as murmur3 (seed chain h = hash(col_i, h), h0 = 42,
+# nulls leave h unchanged), but 64-bit lanes.  uint64 arithmetic wraps in
+# both numpy and jax (x64 mode), so one xp-dispatched implementation
+# serves the CPU oracle and the device path; a scalar python reference
+# cross-checks both in tests.
+# ---------------------------------------------------------------------------
+
+XXH_P1 = 0x9E3779B185EBCA87
+XXH_P2 = 0xC2B2AE3D27D4EB4F
+XXH_P3 = 0x165667B19E3779F9
+XXH_P4 = 0x85EBCA77C2B2AE63
+XXH_P5 = 0x27D4EB2F165667C5
+_M64 = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl64_py(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _fmix64_py(h):
+    h ^= h >> 33
+    h = (h * XXH_P2) & _M64
+    h ^= h >> 29
+    h = (h * XXH_P3) & _M64
+    h ^= h >> 32
+    return h
+
+
+def xxh_int_py(i: int, seed: int) -> int:
+    h = (seed + XXH_P5 + 4) & _M64
+    h ^= ((i & 0xFFFFFFFF) * XXH_P1) & _M64
+    h = (_rotl64_py(h, 23) * XXH_P2 + XXH_P3) & _M64
+    return _fmix64_py(h)
+
+
+def xxh_long_py(v: int, seed: int) -> int:
+    h = (seed + XXH_P5 + 8) & _M64
+    h ^= (_rotl64_py((v * XXH_P2) & _M64, 31) * XXH_P1) & _M64
+    h = (_rotl64_py(h, 27) * XXH_P1 + XXH_P4) & _M64
+    return _fmix64_py(h)
+
+
+def xxh_bytes_py(data: bytes, seed: int) -> int:
+    n = len(data)
+    i = 0
+    if n >= 32:
+        v1 = (seed + XXH_P1 + XXH_P2) & _M64
+        v2 = (seed + XXH_P2) & _M64
+        v3 = seed & _M64
+        v4 = (seed - XXH_P1) & _M64
+        while i + 32 <= n:
+            for k, v in enumerate((v1, v2, v3, v4)):
+                x = int.from_bytes(data[i + 8 * k:i + 8 * k + 8],
+                                   "little")
+                v = (v + x * XXH_P2) & _M64
+                v = (_rotl64_py(v, 31) * XXH_P1) & _M64
+                if k == 0:
+                    v1 = v
+                elif k == 1:
+                    v2 = v
+                elif k == 2:
+                    v3 = v
+                else:
+                    v4 = v
+            i += 32
+        h = (_rotl64_py(v1, 1) + _rotl64_py(v2, 7)
+             + _rotl64_py(v3, 12) + _rotl64_py(v4, 18)) & _M64
+        for v in (v1, v2, v3, v4):
+            h ^= (_rotl64_py((v * XXH_P2) & _M64, 31) * XXH_P1) & _M64
+            h = (h * XXH_P1 + XXH_P4) & _M64
+    else:
+        h = (seed + XXH_P5) & _M64
+    h = (h + n) & _M64
+    while i + 8 <= n:
+        k = int.from_bytes(data[i:i + 8], "little")
+        h ^= (_rotl64_py((k * XXH_P2) & _M64, 31) * XXH_P1) & _M64
+        h = (_rotl64_py(h, 27) * XXH_P1 + XXH_P4) & _M64
+        i += 8
+    if i + 4 <= n:
+        k = int.from_bytes(data[i:i + 4], "little")
+        h ^= (k * XXH_P1) & _M64
+        h = (_rotl64_py(h, 23) * XXH_P2 + XXH_P3) & _M64
+        i += 4
+    while i < n:
+        h ^= (data[i] * XXH_P5) & _M64
+        h = (_rotl64_py(h, 11) * XXH_P1) & _M64
+        i += 1
+    return _fmix64_py(h)
+
+
+def spark_xxhash_py(values: List, dtypes: List[T.DataType],
+                    seed: int = SEED) -> int:
+    """Row xxhash64 across columns, python reference (java long out)."""
+    h = seed & _M64
+    for v, dt in zip(values, dtypes):
+        if v is None:
+            continue
+        if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType,
+                           T.DateType)):
+            h = xxh_int_py(int(v) & 0xFFFFFFFF, h)
+        elif isinstance(dt, T.BooleanType):
+            h = xxh_int_py(1 if v else 0, h)
+        elif isinstance(dt, (T.LongType, T.TimestampType)):
+            h = xxh_long_py(int(v) & _M64, h)
+        elif isinstance(dt, T.FloatType):
+            f = np.float32(v)
+            bits = 0x7FC00000 if np.isnan(f) else _f32_bits(v)
+            h = xxh_int_py(bits, h)
+        elif isinstance(dt, T.DoubleType):
+            d = np.float64(v)
+            bits = (0x7FF8000000000000 if np.isnan(d) else _f64_bits(v))
+            h = xxh_long_py(bits, h)
+        elif isinstance(dt, T.StringType):
+            h = xxh_bytes_py(v.encode() if isinstance(v, str) else v, h)
+        elif isinstance(dt, T.DecimalType):
+            h = xxh_long_py(int(v) & _M64, h)
+        else:
+            raise NotImplementedError(f"xxhash64 of {dt}")
+    return h - (1 << 64) if h >= (1 << 63) else h
+
+
+# -- vectorized (numpy / jnp via xp dispatch on uint64 lanes) ---------------
+
+def _u64(x):
+    return np.uint64(x)
+
+
+def _rotl64(x, r, xp):
+    return (x << _u64(r)) | (x >> _u64(64 - r))
+
+
+def _fmix64(h, xp):
+    h = h ^ (h >> _u64(33))
+    h = h * _u64(XXH_P2)
+    h = h ^ (h >> _u64(29))
+    h = h * _u64(XXH_P3)
+    h = h ^ (h >> _u64(32))
+    return h
+
+
+def _xxh_int_vec(vals_u32, seed_u64, xp):
+    h = seed_u64 + _u64(XXH_P5 + 4)
+    h = h ^ (vals_u32.astype(np.uint64) * _u64(XXH_P1))
+    h = _rotl64(h, 23, xp) * _u64(XXH_P2) + _u64(XXH_P3)
+    return _fmix64(h, xp)
+
+
+def _xxh_long_vec(vals_u64, seed_u64, xp):
+    h = seed_u64 + _u64(XXH_P5 + 8)
+    h = h ^ (_rotl64(vals_u64 * _u64(XXH_P2), 31, xp) * _u64(XXH_P1))
+    h = _rotl64(h, 27, xp) * _u64(XXH_P1) + _u64(XXH_P4)
+    return _fmix64(h, xp)
+
+
+def _xxh_string_vec(mat, lengths, seed_u64, xp):
+    """Per-row Spark XXH64.hashUnsafeBytes over a uint8[B, W] matrix.
+
+    Lane-masked unrolling: every row walks the same W-wide loop; inactive
+    positions keep the running state unchanged."""
+    b, w = mat.shape
+    m64 = mat.astype(np.uint64)
+    len64 = lengths.astype(np.uint64)
+
+    def le_word(base, nbytes):
+        k = len64 * _u64(0)
+        for byte in range(nbytes):
+            col = base + byte
+            if col < w:
+                k = k | (m64[:, col] << _u64(8 * byte))
+        return k
+
+    stripes = (lengths // 32) * 32
+    big = lengths >= 32
+    # seed_u64 is the per-row running hash, so the accumulators are
+    # per-row lanes from the start
+    v1 = seed_u64 + _u64((XXH_P1 + XXH_P2) & _M64)
+    v2 = seed_u64 + _u64(XXH_P2)
+    v3 = seed_u64 + _u64(0)
+    v4 = seed_u64 - _u64(XXH_P1)
+    for base in range(0, w - w % 32, 32):
+        active = base < stripes
+        for k_i, acc in enumerate((v1, v2, v3, v4)):
+            x = le_word(base + 8 * k_i, 8)
+            nv = _rotl64(acc + x * _u64(XXH_P2), 31, xp) * _u64(XXH_P1)
+            if k_i == 0:
+                v1 = xp.where(active, nv, v1)
+            elif k_i == 1:
+                v2 = xp.where(active, nv, v2)
+            elif k_i == 2:
+                v3 = xp.where(active, nv, v3)
+            else:
+                v4 = xp.where(active, nv, v4)
+    h_big = (_rotl64(v1, 1, xp) + _rotl64(v2, 7, xp)
+             + _rotl64(v3, 12, xp) + _rotl64(v4, 18, xp))
+    for acc in (v1, v2, v3, v4):
+        h_big = h_big ^ (_rotl64(acc * _u64(XXH_P2), 31, xp)
+                         * _u64(XXH_P1))
+        h_big = h_big * _u64(XXH_P1) + _u64(XXH_P4)
+    h_small = seed_u64 + _u64(XXH_P5) + len64 * _u64(0)
+    h = xp.where(big, h_big, h_small)
+    h = h + len64
+    # trailing 8-byte words after the stripes
+    rem8_end = stripes + ((lengths - stripes) // 8) * 8
+    for base in range(0, w - w % 8, 8):
+        active = (base >= stripes) & (base < rem8_end)
+        k = le_word(base, 8)
+        nh = h ^ (_rotl64(k * _u64(XXH_P2), 31, xp) * _u64(XXH_P1))
+        nh = _rotl64(nh, 27, xp) * _u64(XXH_P1) + _u64(XXH_P4)
+        h = xp.where(active, nh, h)
+    # one 4-byte word
+    rem4_end = rem8_end + ((lengths - rem8_end) // 4) * 4
+    for base in range(0, w - w % 4, 4):
+        active = (base >= rem8_end) & (base < rem4_end)
+        k = le_word(base, 4)
+        nh = h ^ ((k & _u64(0xFFFFFFFF)) * _u64(XXH_P1))
+        nh = _rotl64(nh, 23, xp) * _u64(XXH_P2) + _u64(XXH_P3)
+        h = xp.where(active, nh, h)
+    # tail bytes
+    for pos in range(w):
+        active = (pos >= rem4_end) & (pos < lengths)
+        nh = h ^ (m64[:, pos] * _u64(XXH_P5))
+        nh = _rotl64(nh, 11, xp) * _u64(XXH_P1)
+        h = xp.where(active, nh, h)
+    return _fmix64(h, xp)
+
+
+def xxhash_column(col, dt: T.DataType, h, valid, xp):
+    """Mix one column into the running uint64 hash h (nulls keep h)."""
+    data, lengths = col
+    if isinstance(dt, (T.ByteType, T.ShortType, T.IntegerType, T.DateType)):
+        if xp is np:
+            v = data.astype(np.int32).view(np.uint32)
+        else:
+            v = jax_bitcast(data.astype(jnp.int32), jnp.uint32)
+        nh = _xxh_int_vec(v, h, xp)
+    elif isinstance(dt, T.BooleanType):
+        nh = _xxh_int_vec(data.astype(np.uint32), h, xp)
+    elif isinstance(dt, (T.LongType, T.TimestampType)):
+        if xp is np:
+            v = data.astype(np.int64).view(np.uint64)
+        else:
+            v = data.astype(jnp.int64).astype(jnp.uint64)
+        nh = _xxh_long_vec(v, h, xp)
+    elif isinstance(dt, T.FloatType):
+        nh = _xxh_int_vec(_canon_float_bits(data, xp), h, xp)
+    elif isinstance(dt, T.DoubleType):
+        bits = _canon_double_bits(data, xp)  # int64 canonical bits
+        if xp is np:
+            v = bits.view(np.uint64)
+        else:
+            v = bits.astype(jnp.uint64)
+        nh = _xxh_long_vec(v, h, xp)
+    elif isinstance(dt, T.DecimalType):
+        if xp is np:
+            v = data.astype(np.int64).view(np.uint64)
+        else:
+            v = data.astype(jnp.int64).astype(jnp.uint64)
+        nh = _xxh_long_vec(v, h, xp)
+    elif isinstance(dt, (T.StringType, T.BinaryType)):
+        nh = _xxh_string_vec(data, lengths, h, xp)
+    else:
+        raise NotImplementedError(f"xxhash64 of {dt}")
+    return xp.where(valid, nh, h)
+
+
+@dataclasses.dataclass
+class XxHash64(Expression):
+    """xxhash64(cols) → long [REF: spark-rapids-jni xxhash64.cu]."""
+
+    exprs: List[Expression]
+    seed: int = SEED
+    dtype: T.DataType = dataclasses.field(default_factory=T.LongType)
+
+    @property
+    def name(self):
+        return "XxHash64"
+
+    @property
+    def children(self):
+        return tuple(self.exprs)
+
+    def eval_tpu(self, batch):
+        b = batch.capacity
+        h = jnp.full((b,), self.seed, jnp.uint64)
+        for e in self.exprs:
+            c = e.eval_tpu(batch)
+            h = xxhash_column((c.data, c.lengths), e.dtype, h,
+                              c.valid_mask(), jnp)
+        return DeviceColumn(self.dtype, h.astype(jnp.int64))
+
+    def eval_cpu(self, batch):
+        n = batch.num_rows
+        h = np.full(n, self.seed, np.uint64)
+        for e in self.exprs:
+            c = e.eval_cpu(batch)
+            if isinstance(e.dtype, (T.StringType, T.BinaryType)):
+                data = host_strings_to_matrix(c.data)
+            else:
+                data = (c.data, None)
+            h = xxhash_column(data, e.dtype, h, c.valid_mask(), np)
+        return HostCol(self.dtype, h.view(np.int64))

@@ -954,3 +954,304 @@ def cast_string_to_float_device(c: DeviceColumn, dst: T.DataType
     validity = valid if c.validity is None else (c.validity & valid)
     npdt = T.to_numpy_dtype(dst)
     return DeviceColumn(dst, val.astype(npdt), validity)
+
+
+# ---------------------------------------------------------------------------
+# Regular expressions [REF: RegexParser/CudfRegexTranspiler,
+# stringFunctions.scala :: GpuRLike/GpuRegExpExtract/GpuRegExpReplace]
+#
+# The reference ships a full Java-regex → cuDF transpiler.  The TPU story
+# (SURVEY §2.2 N5): simple patterns transpile to the device LIKE /
+# predicate kernels at analysis time (plan/analysis.py), everything else
+# evaluates host-side through Python's ``re`` (close to Java regex for
+# the common syntax; known divergences: possessive quantifiers and
+# \p{...} classes are unsupported and raise at analysis).
+# ---------------------------------------------------------------------------
+
+_RE_META = set(".^$*+?{}[]|()\\")
+
+
+def regex_as_simple(pattern: str):
+    """(kind, literal) for patterns expressible as device predicates:
+    'eq' (^lit$), 'startswith' (^lit), 'endswith' (lit$), 'contains'
+    (bare literal) — else None."""
+    if any(ch in _RE_META for ch in
+           pattern.replace("^", "", 1).rstrip("$")
+           if ch not in "^$") or "\\" in pattern:
+        return None
+    anchored_l = pattern.startswith("^")
+    anchored_r = pattern.endswith("$") and not pattern.endswith("\\$")
+    body = pattern[1 if anchored_l else 0:
+                   -1 if anchored_r else len(pattern)]
+    if any(ch in _RE_META for ch in body):
+        return None
+    if anchored_l and anchored_r:
+        return ("eq", body)
+    if anchored_l:
+        return ("startswith", body)
+    if anchored_r:
+        return ("endswith", body)
+    return ("contains", body)
+
+
+def check_regex_supported(pattern: str) -> None:
+    """Reject Java-only constructs python `re` would misinterpret:
+    possessive quantifiers (a*+) and \\p{...} classes — scanned with
+    escape/char-class awareness so '[*+]' or '\\*+' stay legal."""
+    import re as _re
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    i, in_class = 0, False
+    while i < len(pattern):
+        ch = pattern[i]
+        if ch == "\\":
+            if i + 1 < len(pattern) and pattern[i + 1] in "pP":
+                raise AnalysisException(
+                    f"regex construct \\{pattern[i + 1]}{{...}} "
+                    f"(Java-only) is not supported: {pattern!r}")
+            i += 2
+            continue
+        if in_class:
+            in_class = ch != "]"
+            i += 1
+            continue
+        if ch == "[":
+            in_class = True
+        elif (ch in "*+?" and i + 1 < len(pattern)
+                and pattern[i + 1] == "+"):
+            # '{n}+' needs no special case: re.compile rejects it below
+            raise AnalysisException(
+                f"possessive quantifier '{ch}+' (Java-only) is not "
+                f"supported: {pattern!r}")
+        i += 1
+    try:
+        _re.compile(pattern)
+    except _re.error as e:
+        raise AnalysisException(f"invalid regex {pattern!r}: {e}")
+
+
+@dataclasses.dataclass
+class RLike(Expression):
+    """Host-evaluated regex match (Java Pattern.find semantics)."""
+
+    child: Expression
+    pattern: str
+    dtype: T.DataType = dataclasses.field(default_factory=T.BooleanType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_cpu(self, batch):
+        import re as _re
+        rx = _re.compile(self.pattern)
+        c = self.child.eval_cpu(batch)
+        out = np.fromiter((rx.search(str(v)) is not None for v in c.data),
+                          bool, len(c.data))
+        return HostCol(self.dtype, out, c.validity)
+
+
+@dataclasses.dataclass
+class RegexpExtract(Expression):
+    """regexp_extract: group ``idx`` of the first match, '' if none."""
+
+    child: Expression
+    pattern: str
+    idx: int
+    dtype: T.DataType = dataclasses.field(default_factory=T.StringType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_cpu(self, batch):
+        import re as _re
+        rx = _re.compile(self.pattern)
+        c = self.child.eval_cpu(batch)
+        out = np.empty(len(c.data), object)
+        for i, v in enumerate(c.data):
+            m = rx.search(str(v))
+            out[i] = (m.group(self.idx) or "") if m else ""
+            if out[i] is None:
+                out[i] = ""
+        return HostCol(self.dtype, out, c.validity)
+
+
+def _java_repl_to_py(repl: str) -> str:
+    """Translate Java's $1 group references to python's \\1."""
+    out = []
+    i = 0
+    while i < len(repl):
+        ch = repl[i]
+        if ch == "$" and i + 1 < len(repl) and repl[i + 1].isdigit():
+            out.append("\\" + repl[i + 1])
+            i += 2
+            continue
+        if ch == "\\" and i + 1 < len(repl):
+            out.append("\\\\" if repl[i + 1] == "\\" else repl[i + 1])
+            i += 2
+            continue
+        out.append(ch)
+        i += 1
+    return "".join(out)
+
+
+@dataclasses.dataclass
+class RegexpReplace(Expression):
+    """regexp_replace with Java $n references in the replacement."""
+
+    child: Expression
+    pattern: str
+    replacement: str
+    dtype: T.DataType = dataclasses.field(default_factory=T.StringType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_cpu(self, batch):
+        import re as _re
+        rx = _re.compile(self.pattern)
+        repl = _java_repl_to_py(self.replacement)
+        c = self.child.eval_cpu(batch)
+        out = np.empty(len(c.data), object)
+        for i, v in enumerate(c.data):
+            out[i] = rx.sub(repl, str(v))
+        return HostCol(self.dtype, out, c.validity)
+
+
+@dataclasses.dataclass
+class Split(Expression):
+    """split(str, regex, limit) → array<string> (host; array<string> has
+    no device representation)."""
+
+    child: Expression
+    pattern: str
+    limit: int = -1
+
+    @property
+    def dtype(self):
+        return T.ArrayType(T.StringT)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_cpu(self, batch):
+        import re as _re
+        rx = _re.compile(self.pattern)
+        c = self.child.eval_cpu(batch)
+        out = np.empty(len(c.data), object)
+        for i, v in enumerate(c.data):
+            s = str(v)
+            if self.limit > 0:
+                out[i] = rx.split(s, maxsplit=self.limit - 1)
+            else:
+                parts = rx.split(s)
+                if self.limit == 0:
+                    # Java Pattern.split(limit=0) drops trailing empties
+                    while parts and parts[-1] == "":
+                        parts.pop()
+                out[i] = parts
+        return HostCol(self.dtype, out, c.validity)
+
+
+# ---------------------------------------------------------------------------
+# reverse / lpad / rpad — device kernels on the byte matrix
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class StringReverse(Expression):
+    """Byte-wise reverse (matches Spark for ASCII; multi-byte UTF-8
+    sequences reverse bytewise on device — CPU path is char-correct)."""
+
+    child: Expression
+    dtype: T.DataType = dataclasses.field(default_factory=T.StringType)
+    incompat = "byte-based reverse differs from Spark on non-ASCII"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        b, w = c.data.shape
+        j = jnp.arange(w, dtype=jnp.int32)[None, :]
+        src = jnp.clip(c.lengths[:, None] - 1 - j, 0, max(w - 1, 0))
+        out = jnp.take_along_axis(c.data, src, axis=1)
+        out = jnp.where(j < c.lengths[:, None], out, 0).astype(jnp.uint8)
+        return DeviceColumn(self.dtype, out, c.validity, c.lengths)
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        out = np.array([str(v)[::-1] for v in c.data], object)
+        return HostCol(self.dtype, out, c.validity)
+
+
+@dataclasses.dataclass
+class StringPad(Expression):
+    """lpad/rpad to ``target`` bytes with a cyclic pad string.
+
+    [REF: stringFunctions.scala :: GpuStringLPad/GpuStringRPad] —
+    byte-indexed on device (ASCII-exact; the CPU oracle is also
+    byte-based so both paths agree)."""
+
+    child: Expression
+    target: int
+    pad: str
+    left: bool
+    dtype: T.DataType = dataclasses.field(default_factory=T.StringType)
+    incompat = "byte-based padding differs from Spark on non-ASCII"
+
+    @property
+    def name(self):
+        return "Lpad" if self.left else "Rpad"
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        c = self.child.eval_tpu(batch)
+        b, w = c.data.shape
+        L = max(int(self.target), 0)
+        width = max(L, 1)
+        pad_b = self.pad.encode()
+        padv = jnp.asarray(np.frombuffer(pad_b or b"\0", np.uint8))
+        plen = max(len(pad_b), 1)
+        j = jnp.arange(width, dtype=jnp.int32)[None, :]
+        ln = jnp.minimum(c.lengths, L)[:, None]  # kept source bytes
+        # empty pad: truncation-only (result = str[:L]); else result is
+        # exactly L bytes with the pad cycling through the gap
+        out_len = jnp.full((b, 1), L, jnp.int32) if pad_b else ln
+        grown = jnp.pad(c.data, ((0, 0), (0, max(width - w, 0)))) \
+            if width > w else c.data
+        if self.left:
+            shift = out_len - ln
+            src = jnp.clip(j - shift, 0, grown.shape[1] - 1)
+            data_part = jnp.take_along_axis(grown, src, axis=1)
+            pad_part = padv[(j % plen).astype(jnp.int32)]
+            out = jnp.where(j < shift, pad_part, data_part)
+        else:
+            data_part = grown[:, :width]
+            pad_part = padv[((j - ln) % plen).astype(jnp.int32)]
+            out = jnp.where(j < ln, data_part, pad_part)
+        out = jnp.where(j < out_len, out, 0).astype(jnp.uint8)
+        return DeviceColumn(self.dtype, out, c.validity,
+                            out_len[:, 0])
+
+    def eval_cpu(self, batch):
+        c = self.child.eval_cpu(batch)
+        L = max(int(self.target), 0)
+        pad_b = self.pad.encode()
+        out = np.empty(len(c.data), object)
+        for i, v in enumerate(c.data):
+            sb = str(v).encode()
+            if len(sb) >= L or not pad_b:
+                r = sb[:L]
+            else:
+                fill = (pad_b * ((L - len(sb)) // len(pad_b) + 1))[
+                    :L - len(sb)]
+                r = (fill + sb) if self.left else (sb + fill)
+            out[i] = r.decode(errors="replace")
+        return HostCol(self.dtype, out, c.validity)

@@ -1,0 +1,192 @@
+"""Timezone database as device lookup tables.
+
+[REF: spark-rapids-jni :: src/main/cpp/src/GpuTimeZoneDB — the reference
+ loads the JVM's zone rules into device tables and does transition
+ binary search per row; SURVEY §2.2 N9]
+
+TPU redesign: each zone's TZif file (the OS tzdata, same source as the
+JVM's rules) parses into two sorted arrays — transition instants (int64
+seconds) and utc offsets (int32 seconds) — uploaded once per zone and
+cached.  Per-row lookup is one ``searchsorted`` + gather, fully
+vectorized on device.
+
+Semantics notes (documented divergences, same caveats as the reference):
+* ``to_utc_timestamp`` resolves DST gaps/overlaps by the transition
+  table keyed on local wall seconds (overlap → the post-transition
+  offset); Java picks the pre-transition offset in overlaps, so results
+  can differ by the DST delta inside the (≤1h) overlap window.
+* Instants beyond the file's last transition use the last offset (the
+  TZif footer's forward rule string is not evaluated).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import struct
+import threading
+from typing import Dict, List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.column import DeviceColumn
+from spark_rapids_tpu.columnar.host import HostCol
+from spark_rapids_tpu.ops.expressions import Expression
+
+_SENTINEL = -(1 << 62)
+
+
+def _tz_path(name: str) -> str:
+    import zoneinfo
+    for base in zoneinfo.TZPATH:
+        p = os.path.join(base, name)
+        if os.path.exists(p):
+            return p
+    raise ValueError(f"unknown timezone {name!r}")
+
+
+def parse_tzif(name: str) -> Tuple[np.ndarray, np.ndarray]:
+    """TZif v1/v2/v3 → (transitions int64[T+1], offsets int32[T+1]).
+
+    Entry 0 is a -inf sentinel carrying the zone's pre-history offset,
+    so ``searchsorted(..., 'right') - 1`` is always a valid index."""
+    with open(_tz_path(name), "rb") as f:
+        raw = f.read()
+
+    def parse_block(buf, off, time_size):
+        fmt = ">i" if time_size == 4 else ">q"
+        magic, version = buf[off:off + 4], buf[off + 4:off + 5]
+        assert magic == b"TZif", name
+        (isutcnt, isstdcnt, leapcnt, timecnt, typecnt,
+         charcnt) = struct.unpack(">6I", buf[off + 20:off + 44])
+        p = off + 44
+        trans = np.frombuffer(
+            buf, dtype=np.dtype(fmt), count=timecnt, offset=p
+        ).astype(np.int64)
+        p += timecnt * time_size
+        idxs = np.frombuffer(buf, np.uint8, timecnt, p)
+        p += timecnt
+        utoffs = np.zeros(typecnt, np.int32)
+        isdst = np.zeros(typecnt, np.uint8)
+        for t in range(typecnt):
+            utoff, dst, _ = struct.unpack(">iBB", buf[p:p + 6])
+            utoffs[t] = utoff
+            isdst[t] = dst
+            p += 6
+        p += charcnt + leapcnt * (time_size + 4) + isstdcnt + isutcnt
+        return (trans, idxs, utoffs, isdst), p
+
+    (trans, idxs, utoffs, isdst), end = parse_block(raw, 0, 4)
+    if raw[4:5] in (b"2", b"3"):
+        (trans, idxs, utoffs, isdst), _ = parse_block(raw, end, 8)
+    # pre-history offset: first non-dst type, else type 0 (RFC 8536 §3.2)
+    std = np.nonzero(isdst == 0)[0]
+    first_off = int(utoffs[std[0]] if len(std) else utoffs[0]) \
+        if len(utoffs) else 0
+    transitions = np.concatenate(
+        [np.array([_SENTINEL], np.int64), trans])
+    offsets = np.concatenate(
+        [np.array([first_off], np.int32),
+         utoffs[idxs].astype(np.int32) if len(trans) else
+         np.zeros(0, np.int32)])
+    return transitions, offsets
+
+
+class _TzCache:
+    """Host + device LUTs per zone name (process lifetime)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._host: Dict[str, tuple] = {}
+        self._dev: Dict[str, tuple] = {}
+
+    def host(self, name: str):
+        with self._lock:
+            if name not in self._host:
+                trans, offs = parse_tzif(name)
+                # local-time keyed table for the to_utc direction
+                local = trans.astype(np.int64) + offs.astype(np.int64)
+                self._host[name] = (trans, offs, local)
+            return self._host[name]
+
+    def device(self, name: str):
+        trans, offs, local = self.host(name)
+        with self._lock:
+            if name not in self._dev:
+                self._dev[name] = (jnp.asarray(trans), jnp.asarray(offs),
+                                   jnp.asarray(local))
+            return self._dev[name]
+
+
+TZ_CACHE = _TzCache()
+
+
+def _floor_div_us(ts_us, xp):
+    return xp.floor_divide(ts_us, 1_000_000)
+
+
+@dataclasses.dataclass
+class FromUTCTimestamp(Expression):
+    """from_utc_timestamp(ts, tz): the UTC instant re-rendered as the
+    zone's wall time [REF: GpuTimeZoneDB::convert_timestamp_to_utc
+    inverse]."""
+
+    child: Expression
+    tz: str
+    dtype: T.DataType = dataclasses.field(default_factory=T.TimestampType)
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        trans, offs, _ = TZ_CACHE.device(self.tz)
+        c = self.child.eval_tpu(batch)
+        secs = _floor_div_us(c.data.astype(jnp.int64), jnp)
+        idx = jnp.searchsorted(trans, secs, side="right") - 1
+        off = jnp.take(offs, idx).astype(jnp.int64)
+        return DeviceColumn(self.dtype, c.data + off * 1_000_000,
+                            c.validity)
+
+    def eval_cpu(self, batch):
+        trans, offs, _ = TZ_CACHE.host(self.tz)
+        c = self.child.eval_cpu(batch)
+        secs = _floor_div_us(c.data.astype(np.int64), np)
+        idx = np.searchsorted(trans, secs, side="right") - 1
+        off = offs[idx].astype(np.int64)
+        return HostCol(self.dtype, c.data + off * 1_000_000, c.validity)
+
+
+@dataclasses.dataclass
+class ToUTCTimestamp(Expression):
+    """to_utc_timestamp(ts, tz): wall time in the zone → UTC instant
+    (gap/overlap caveat in the module docstring)."""
+
+    child: Expression
+    tz: str
+    dtype: T.DataType = dataclasses.field(default_factory=T.TimestampType)
+    incompat = ("DST overlap resolves to the post-transition offset "
+                "(Java uses pre-transition)")
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_tpu(self, batch):
+        _, offs, local = TZ_CACHE.device(self.tz)
+        c = self.child.eval_tpu(batch)
+        secs = _floor_div_us(c.data.astype(jnp.int64), jnp)
+        idx = jnp.searchsorted(local, secs, side="right") - 1
+        off = jnp.take(offs, idx).astype(jnp.int64)
+        return DeviceColumn(self.dtype, c.data - off * 1_000_000,
+                            c.validity)
+
+    def eval_cpu(self, batch):
+        _, offs, local = TZ_CACHE.host(self.tz)
+        c = self.child.eval_cpu(batch)
+        secs = _floor_div_us(c.data.astype(np.int64), np)
+        idx = np.searchsorted(local, secs, side="right") - 1
+        off = offs[idx].astype(np.int64)
+        return HostCol(self.dtype, c.data - off * 1_000_000, c.validity)

@@ -223,6 +223,19 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
     if op == "datediff":
         return D.DateDiff(resolve(u.children[0], schema),
                           resolve(u.children[1], schema))
+    if op in ("from_utc_timestamp", "to_utc_timestamp"):
+        from spark_rapids_tpu.ops.timezone import (
+            TZ_CACHE, FromUTCTimestamp, ToUTCTimestamp)
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.TimestampType):
+            child = cast_to(child, T.TimestampT)
+        tz = str(u.payload)
+        # validate the zone AND build the device LUT eagerly — inside a
+        # jit trace the constants would leak as tracers into the cache
+        TZ_CACHE.device(tz)
+        cls = (FromUTCTimestamp if op == "from_utc_timestamp"
+               else ToUTCTimestamp)
+        return cls(child, tz)
     if op in ("upper", "lower", "length"):
         return S.string_unary(op, resolve(u.children[0], schema))
     if op in ("trim", "ltrim", "rtrim"):
@@ -250,6 +263,48 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
     if op == "substring":
         pos, ln = u.payload
         return S.Substring(resolve(u.children[0], schema), pos, ln)
+    if op == "rlike":
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.StringType):
+            raise AnalysisException("rlike needs a string operand")
+        pattern = u.payload
+        S.check_regex_supported(pattern)
+        simple = S.regex_as_simple(pattern)
+        if simple:
+            # simple patterns transpile to device predicates — the
+            # RegexParser fast path [REF: CudfRegexTranspiler]
+            kind, lit = simple
+            if kind == "eq":
+                return S.string_comparison(
+                    "eq", child, E.Literal(lit, T.StringT))
+            return S.string_predicate(kind, child,
+                                      E.Literal(lit, T.StringT))
+        return S.RLike(child, pattern)
+    if op == "regexp_extract":
+        pattern, idx = u.payload
+        S.check_regex_supported(pattern)
+        return S.RegexpExtract(resolve(u.children[0], schema), pattern,
+                               idx)
+    if op == "regexp_replace":
+        pattern, repl = u.payload
+        S.check_regex_supported(pattern)
+        return S.RegexpReplace(resolve(u.children[0], schema), pattern,
+                               repl)
+    if op == "split":
+        pattern, limit = u.payload
+        S.check_regex_supported(pattern)
+        return S.Split(resolve(u.children[0], schema), pattern, limit)
+    if op == "reverse":
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.StringType):
+            raise AnalysisException("reverse needs a string operand")
+        return S.StringReverse(child)
+    if op in ("lpad", "rpad"):
+        ln, pad = u.payload
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, T.StringType):
+            raise AnalysisException(f"{op} needs a string operand")
+        return S.StringPad(child, int(ln), str(pad), op == "lpad")
     if op in ("startswith", "endswith", "contains"):
         return S.string_predicate(op, resolve(u.children[0], schema),
                                   resolve(u.children[1], schema))
@@ -258,6 +313,9 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
     if op == "hash":
         from spark_rapids_tpu.ops.hashing import Murmur3Hash
         return Murmur3Hash([resolve(c, schema) for c in u.children])
+    if op == "xxhash64":
+        from spark_rapids_tpu.ops.hashing import XxHash64
+        return XxHash64([resolve(c, schema) for c in u.children])
     if op == "input_file_name":
         return E.InputFileName()
     if op == "pyudf":

@@ -69,6 +69,9 @@ class ParquetRelation(LogicalPlan):
     partition_values: Optional[List[dict]] = None
     partition_fields: Tuple = ()
     file_name_col: bool = False
+    # dynamic partition pruning: (build-side Project plan yielding the
+    # join key column, partition column name) — filled by the optimizer
+    dpp: Optional[tuple] = None
 
 
 @dataclasses.dataclass

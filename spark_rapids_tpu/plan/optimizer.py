@@ -185,8 +185,8 @@ def _make_remap(index_map, fn_idx):
     return remap
 
 
-def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
-    plan = _rewrite_children(plan)
+def optimize(plan: L.LogicalPlan, conf=None) -> L.LogicalPlan:
+    plan = _rewrite_children(plan, conf)
 
     if isinstance(plan, (L.Project, L.Aggregate)):
         filters, rel = _filter_chain(plan.child)
@@ -234,15 +234,86 @@ def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
                 new_rel = dataclasses.replace(rel, filters=pushed)
                 return _rebuild_chain(filters, new_rel)
 
+    if isinstance(plan, L.Join):
+        from spark_rapids_tpu import conf as C
+        if conf is None or conf.get(C.DPP_ENABLED):
+            threshold = (conf.get(C.BROADCAST_THRESHOLD) if conf
+                         else 10 << 20)
+            plan = _dynamic_partition_pruning(plan, threshold)
+
     return plan
 
 
-def _rewrite_children(plan: L.LogicalPlan) -> L.LogicalPlan:
+def _estimated_plan_bytes(plan) -> Optional[int]:
+    """Rough output-size upper bound of a logical plan (None=unknown)."""
+    import os
+    if isinstance(plan, L.InMemoryRelation):
+        return plan.table.nbytes
+    if isinstance(plan, L.ParquetRelation):
+        try:
+            return sum(os.path.getsize(p) for p in plan.paths) * 4
+        except OSError:
+            return None
+    if isinstance(plan, (L.Filter, L.Project, L.Sample, L.Limit,
+                         L.Sort)):
+        return _estimated_plan_bytes(plan.children[0])
+    return None
+
+
+def _dynamic_partition_pruning(join: L.Join,
+                               threshold: int) -> L.Join:
+    """Attach a DPP subquery to a partitioned probe-side scan.
+
+    [REF: GpuSubqueryBroadcastExec / DPP integration, SURVEY §2.1 #26]
+    When one join side is a hive-partitioned file relation whose join
+    key IS a partition column, the other side's distinct keys (computed
+    once, host-side, before the scan pumps) prune entire files.  Valid
+    for join types that drop probe rows without a match."""
+    candidates = []
+    if join.join_type in ("inner", "left_semi", "right"):
+        candidates.append(("left", join.left, join.left_keys,
+                           join.right, join.right_keys))
+    if join.join_type in ("inner", "left"):
+        candidates.append(("right", join.right, join.right_keys,
+                           join.left, join.left_keys))
+    for side, probe, probe_keys, build, build_keys in candidates:
+        filters, rel = _filter_chain(probe)
+        if (rel is None or not rel.partition_values or rel.dpp is not None
+                or rel.columns is not None):
+            continue
+        # the subquery executes host-side before the scan pumps — only
+        # worth it (and only safe) for broadcast-sized build sides, the
+        # same gate Spark uses for DPP-without-broadcast-reuse
+        est = _estimated_plan_bytes(build)
+        if threshold <= 0 or est is None or est > threshold:
+            continue
+        n_data = (len(rel.schema.fields) - len(rel.partition_fields)
+                  - (1 if rel.file_name_col else 0))
+        for ki, key in enumerate(probe_keys):
+            if not isinstance(key, E.BoundReference):
+                continue
+            if not (n_data <= key.index
+                    < n_data + len(rel.partition_fields)):
+                continue
+            col_name = rel.schema.fields[key.index].name
+            bkey = build_keys[ki]
+            sub = L.Project(
+                build, [bkey],
+                T.StructType((T.StructField("_dpp_key", bkey.dtype),)))
+            new_rel = dataclasses.replace(rel, dpp=(sub, col_name))
+            new_probe = _rebuild_chain(filters, new_rel)
+            if side == "left":
+                return dataclasses.replace(join, left=new_probe)
+            return dataclasses.replace(join, right=new_probe)
+    return join
+
+
+def _rewrite_children(plan: L.LogicalPlan, conf=None) -> L.LogicalPlan:
     if isinstance(plan, L.Union):
-        return L.Union([optimize(c) for c in plan.inputs])
+        return L.Union([optimize(c, conf) for c in plan.inputs])
     if isinstance(plan, L.Join):
-        return dataclasses.replace(plan, left=optimize(plan.left),
-                                   right=optimize(plan.right))
+        return dataclasses.replace(plan, left=optimize(plan.left, conf),
+                                   right=optimize(plan.right, conf))
     if hasattr(plan, "child"):
-        return dataclasses.replace(plan, child=optimize(plan.child))
+        return dataclasses.replace(plan, child=optimize(plan.child, conf))
     return plan

@@ -173,17 +173,74 @@ class TpuHostShuffleExchangeExec(TpuExec):
             weakref.finalize(self, env.remove_shuffle, sid)
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        yield from self.execute_pid_range(partition, partition + 1)
+
+    # -- AQE stats + shaped reads [REF: GpuAQEShuffleReadExec] -----------
+    def aqe_partition_stats(self):
+        """Per-reduce-partition byte sizes, summed from the shuffle
+        files' section tables (no data read)."""
+        import os
+        import struct
         self._materialize()
+        env = ShuffleEnv.get()
+        sizes = np.zeros(self.nparts, np.int64)
+        for m in self._map_parts:
+            path = env.map_file(self._shuffle_id, m)
+            with open(path, "rb") as f:
+                f.read(8)  # magic + nparts
+                while True:
+                    tbl = f.read(8 * self.nparts)
+                    if not tbl:
+                        break
+                    rec = np.frombuffer(tbl, np.int64)
+                    sizes += rec
+                    f.seek(int(rec.sum()), os.SEEK_CUR)
+        return "bytes", sizes
+
+    def _read_concat(self, parts) -> tuple:
         env = ShuffleEnv.get()
         reader = ShuffleReader(env, self._shuffle_id, self._map_parts,
                                self.schema)
+        records = []
         with self.timer("readTime"):
-            n, cols = _concat_views(
-                self.schema, reader.read_partition(partition))
+            for p in parts:
+                records.extend(reader.read_partition(p))
+        return _concat_views(self.schema, records)
+
+    def execute_pid_range(self, lo: int, hi: int
+                          ) -> Iterator[DeviceBatch]:
+        self._materialize()
+        n, cols = self._read_concat(range(lo, hi))
         if n == 0:
             return
         with self.timer("transferTime"):
             out = _to_device(self.schema, cols, n, self.min_bucket)
         self.metric("numOutputRows").add(n)
+        self.metric("numOutputBatches").add(1)
+        yield out
+
+    def execute_split(self, p: int, j: int, k: int
+                      ) -> Iterator[DeviceBatch]:
+        """Slice j of k of a skewed partition: host-side interleaved row
+        slice before the H2D (same rank rule as the device exchange)."""
+        self._materialize()
+        n, cols = self._read_concat([p])
+        if n == 0:
+            return
+        idx = np.arange(j, n, k)
+        sliced = []
+        for c in cols:
+            data = c.data[:n][idx]
+            validity = (None if c.validity is None
+                        else c.validity[:n][idx])
+            lengths = (None if c.lengths is None
+                       else c.lengths[:n][idx])
+            sliced.append(HostColView(c.dtype, data, validity, lengths))
+        m = len(idx)
+        if m == 0:
+            return
+        with self.timer("transferTime"):
+            out = _to_device(self.schema, sliced, m, self.min_bucket)
+        self.metric("numOutputRows").add(m)
         self.metric("numOutputBatches").add(1)
         yield out

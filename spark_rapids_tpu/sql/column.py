@@ -189,6 +189,10 @@ class Column:
         """SQL LIKE ('%', '_', backslash escape), literal pattern."""
         return Column(UExpr("like", pattern, (self._u,)))
 
+    def rlike(self, pattern: str) -> "Column":
+        """Regex match (simple patterns run on device; the rest host)."""
+        return Column(UExpr("rlike", pattern, (self._u,)))
+
     def __str__(self):
         return str(self._u)
 

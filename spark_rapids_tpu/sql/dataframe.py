@@ -554,7 +554,7 @@ class DataFrame:
     def _execute_plan(self):
         from spark_rapids_tpu.plan.optimizer import optimize
         conf = self.session.rapids_conf()
-        cpu = plan_physical(optimize(self._plan), conf)
+        cpu = plan_physical(optimize(self._plan, conf), conf)
         result = apply_overrides(cpu, conf)
         return result.plan
 
@@ -653,7 +653,7 @@ class DataFrame:
     def explain(self, extended: bool = False):
         from spark_rapids_tpu.plan.optimizer import optimize
         conf = self.session.rapids_conf()
-        cpu = plan_physical(optimize(self._plan), conf)
+        cpu = plan_physical(optimize(self._plan, conf), conf)
         result = apply_overrides(cpu, conf)
         print(result.plan.tree_string())
         if extended:

@@ -44,6 +44,14 @@ trim = _unary("trim")
 ltrim = _unary("ltrim")
 rtrim = _unary("rtrim")
 
+def from_utc_timestamp(c, tz: str) -> Column:
+    return Column(UExpr("from_utc_timestamp", tz, (_cu(c),)))
+
+
+def to_utc_timestamp(c, tz: str) -> Column:
+    return Column(UExpr("to_utc_timestamp", tz, (_cu(c),)))
+
+
 pow = _binary("pow")  # noqa: A001
 date_add = _binary("date_add")
 date_sub = _binary("date_sub")
@@ -78,6 +86,40 @@ concat = concat_impl
 def hash(*cols) -> Column:  # noqa: A001
     """Spark murmur3 hash (seed 42)."""
     return Column(UExpr("hash", None, tuple(_cu(c) for c in cols)))
+
+
+def xxhash64(*cols) -> Column:
+    """Spark xxhash64 (seed 42) → long."""
+    return Column(UExpr("xxhash64", None, tuple(_cu(c) for c in cols)))
+
+
+def rlike(c, pattern: str) -> Column:
+    return Column(UExpr("rlike", pattern, (_cu(c),)))
+
+
+def regexp_extract(c, pattern: str, idx: int = 1) -> Column:
+    return Column(UExpr("regexp_extract", (pattern, idx), (_cu(c),)))
+
+
+def regexp_replace(c, pattern: str, replacement: str) -> Column:
+    return Column(UExpr("regexp_replace", (pattern, replacement),
+                        (_cu(c),)))
+
+
+def split(c, pattern: str, limit: int = -1) -> Column:
+    return Column(UExpr("split", (pattern, limit), (_cu(c),)))
+
+
+def reverse(c) -> Column:
+    return Column(UExpr("reverse", None, (_cu(c),)))
+
+
+def lpad(c, length: int, pad: str = " ") -> Column:
+    return Column(UExpr("lpad", (length, pad), (_cu(c),)))
+
+
+def rpad(c, length: int, pad: str = " ") -> Column:
+    return Column(UExpr("rpad", (length, pad), (_cu(c),)))
 
 
 def replace(c, search: str, replacement: str) -> Column:

@@ -1,0 +1,177 @@
+"""AQE shuffle-read coalescing/skew-splitting + dynamic partition pruning.
+
+[REF: GpuAQEShuffleReadExec, GpuSubqueryBroadcastExec families;
+ SURVEY §2.1 #26]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, cpu_session, tpu_session)
+
+
+def _find(node, name):
+    if type(node).__name__ == name:
+        return node
+    for c in node.children:
+        r = _find(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+# -- AQE --------------------------------------------------------------------
+
+def test_aqe_coalesces_small_partitions():
+    n = 1000
+    t = pa.table({"k": pa.array(np.arange(n, dtype=np.int64) % 97),
+                  "v": pa.array(np.ones(n))})
+    # 64 tiny shuffle partitions; advisory size big → few coalesced reads
+    s = tpu_session({"spark.sql.adaptive.enabled": True,
+                     "spark.sql.adaptive.advisoryPartitionSizeInBytes":
+                         1 << 20})
+    df = s.createDataFrame(t).repartition(64, "k")
+    out = df.toArrow()
+    assert out.num_rows == n
+    aqe = _find(df._last_plan, "TpuAQEShuffleReadExec")
+    assert aqe is not None
+    assert aqe.num_partitions() < 64  # reads were coalesced
+    assert aqe.metrics["coalescedReads"].value >= 1
+
+
+def test_aqe_split_machinery_exact_rows():
+    # split reads are only planned for round-robin exchanges (no
+    # co-partitioning contract); exercise the machinery directly
+    from spark_rapids_tpu.exec.aqe import TpuAQEShuffleReadExec
+    from spark_rapids_tpu.exec.basic import CpuScanExec, TpuScanExec
+    from spark_rapids_tpu.exec.exchange import TpuShuffleExchangeExec
+    from spark_rapids_tpu.plan.analysis import resolve
+    from spark_rapids_tpu.sql.column import UExpr
+    from spark_rapids_tpu.columnar.column import device_to_host
+    import pyarrow as pa2
+
+    n = 5000
+    t = pa.table({"k": pa.array(np.zeros(n, dtype=np.int64)),
+                  "v": pa.array(np.arange(n, dtype=np.float64))})
+    s = tpu_session()
+    df = s.createDataFrame(t)
+    scan = TpuScanExec(t, df.schema, 1)
+    key = resolve(UExpr("attr", "k"), df.schema)
+    ex = TpuShuffleExchangeExec(scan, 8, [key])
+    aqe = TpuAQEShuffleReadExec(ex, target_bytes=1000 * 18,
+                                row_bytes=18, allow_split=True)
+    got = []
+    for p in range(aqe.num_partitions()):
+        for b in aqe.execute(p):
+            got.extend(device_to_host(b).column("v").to_pylist())
+    assert aqe.metrics["splitSkewedPartitions"].value == 1
+    assert sorted(got) == sorted(t.column("v").to_pylist())
+
+
+def test_aqe_hash_exchange_never_splits_groups():
+    # co-partitioning contract: a skewed grouping key must stay whole
+    # through repartition+applyInPandas even with AQE on
+    from spark_rapids_tpu.columnar import dtypes as T
+    n = 4000
+    t = pa.table({"k": pa.array(np.zeros(n, dtype=np.int32)),
+                  "v": pa.array(np.ones(n))})
+
+    def gsum(g):
+        import pandas as pd
+        return pd.DataFrame({"k": [g["k"].iloc[0]],
+                             "c": [float(len(g))]})
+
+    schema = T.StructType((T.StructField("k", T.IntegerT),
+                           T.StructField("c", T.DoubleT)))
+    s = tpu_session({"spark.sql.adaptive.enabled": True,
+                     "spark.sql.adaptive.advisoryPartitionSizeInBytes":
+                         1000})
+    rows = s.createDataFrame(t).groupBy("k").applyInPandas(
+        gsum, schema).collect()
+    assert len(rows) == 1 and rows[0].c == n, rows
+
+
+def test_aqe_off_keeps_partitions():
+    t = pa.table({"k": pa.array(np.arange(100, dtype=np.int64))})
+    s = tpu_session({"spark.sql.adaptive.enabled": False})
+    df = s.createDataFrame(t).repartition(16, "k")
+    df.toArrow()
+    assert _find(df._last_plan, "TpuAQEShuffleReadExec") is None
+    assert "ShuffleExchange" in df._last_plan.tree_string()
+
+
+def test_aqe_oracle_equality():
+    rng = np.random.default_rng(5)
+    t = pa.table({"k": pa.array(rng.integers(0, 50, 2000)),
+                  "v": pa.array(rng.normal(size=2000))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).repartition(32, "k")
+        .groupBy("k").agg(F.sum("v").alias("sv")),
+        ignore_order=True, approx_float=True)
+
+
+# -- DPP --------------------------------------------------------------------
+
+@pytest.fixture()
+def fact_dir(tmp_path):
+    n = 2000
+    t = pa.table({
+        "part": pa.array((np.arange(n) % 10).astype(np.int64)),
+        "x": pa.array(np.arange(n, dtype=np.int64)),
+    })
+    out = str(tmp_path / "fact")
+    cpu_session().createDataFrame(t).write.partitionBy("part").parquet(out)
+    return out
+
+
+def _dim(s):
+    return s.createDataFrame(pa.table({
+        "part": pa.array([2, 5], type=pa.int64()),
+        "name": pa.array(["two", "five"]),
+    }))
+
+
+def test_dpp_prunes_files(fact_dir):
+    s = tpu_session()
+    fact = s.read.parquet(fact_dir)
+    df = fact.join(_dim(s), on="part", how="inner")
+    out = df.toArrow()
+    assert out.num_rows == 400  # 2 of 10 partitions survive
+    scan = _find(df._last_plan, "TpuParquetScanExec")
+    assert scan is not None
+    assert scan.metrics["dppPrunedFiles"].value == 8, (
+        scan.metrics["dppPrunedFiles"].value)
+
+
+def test_dpp_oracle_equality(fact_dir):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.parquet(fact_dir).join(_dim(s), on="part")
+        .groupBy("name").agg(F.sum("x").alias("sx")),
+        ignore_order=True)
+
+
+def test_dpp_disabled(fact_dir):
+    s = tpu_session(
+        {"spark.sql.optimizer.dynamicPartitionPruning.enabled": False})
+    fact = s.read.parquet(fact_dir)
+    df = fact.join(_dim(s), on="part", how="inner")
+    out = df.toArrow()
+    assert out.num_rows == 400
+    scan = _find(df._last_plan, "TpuParquetScanExec")
+    assert scan.metric("dppPrunedFiles").value == 0
+
+
+def test_dpp_left_join_prunes_right_only(fact_dir):
+    # left outer join: the LEFT side must NOT be pruned
+    s = tpu_session()
+    fact = s.read.parquet(fact_dir)
+    df = fact.join(_dim(s), on="part", how="left")
+    out = df.toArrow()
+    assert out.num_rows == 2000  # all left rows kept
+    matched = [r for r in out.column("name").to_pylist()
+               if r is not None]
+    assert len(matched) == 400

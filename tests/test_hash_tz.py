@@ -1,0 +1,134 @@
+"""xxhash64 (three cross-checked impls) + timezone LUT conversions.
+
+[REF: spark-rapids-jni xxhash64.cu test vectors pattern,
+ GpuTimeZoneDB tests; SURVEY §2.2 N9]
+"""
+
+import datetime
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops import hashing as HH
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+def gen_table(seed=0, n=300):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "i": dg.IntegerGen().generate(rng, n),
+        "l": dg.LongGen().generate(rng, n),
+        "d": dg.DoubleGen().generate(rng, n),
+        "f": dg.FloatGen().generate(rng, n),
+        "s": dg.StringGen().generate(rng, n),
+        "b": dg.BooleanGen().generate(rng, n),
+    })
+
+
+def test_xxhash64_device_matches_oracle():
+    t = gen_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.xxhash64(col("i"), col("l"), col("d"), col("f"),
+                       col("s"), col("b")).alias("h")))
+
+
+def test_xxhash64_matches_scalar_reference():
+    # the vectorized oracle must equal the independent scalar python
+    # implementation row by row, nulls skipped in the seed chain
+    t = gen_table(7, 64)
+    s = tpu_session()
+    got = s.createDataFrame(t).select(
+        F.xxhash64(col("i"), col("s"), col("d")).alias("h")).toArrow()
+    rows = t.to_pylist()
+    for r, h in zip(rows, got.column("h").to_pylist()):
+        expect = HH.spark_xxhash_py(
+            [r["i"], r["s"], r["d"]],
+            [T.IntegerT, T.StringT, T.DoubleT])
+        assert h == expect, (r, h, expect)
+
+
+def test_xxhash64_string_all_lengths():
+    # every code path: 32B stripes, 8B words, 4B word, tail bytes
+    strs = ["x" * i for i in range(0, 70)]
+    t = pa.table({"s": pa.array(strs)})
+    s = tpu_session()
+    got = s.createDataFrame(t).select(
+        F.xxhash64(col("s")).alias("h")).toArrow()
+    for v, h in zip(strs, got.column("h").to_pylist()):
+        assert h == HH.spark_xxhash_py([v], [T.StringT]), (len(v), h)
+
+
+def test_xxhash64_specials():
+    t = pa.table({"d": pa.array([float("nan"), -0.0, 0.0, None,
+                                 float("inf")])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.xxhash64(col("d")).alias("h")))
+
+
+# -- timezone ---------------------------------------------------------------
+
+def _ts_table(start=1950, end=2030, n=500, seed=3):
+    rng = np.random.default_rng(seed)
+    lo = int(datetime.datetime(start, 1, 1,
+                               tzinfo=datetime.timezone.utc).timestamp())
+    hi = int(datetime.datetime(end, 1, 1,
+                               tzinfo=datetime.timezone.utc).timestamp())
+    secs = rng.integers(lo, hi, n)
+    us = secs * 1_000_000 + rng.integers(0, 1_000_000, n)
+    return pa.table({"ts": pa.array(us, type=pa.int64()).cast(
+        pa.timestamp("us", tz="UTC"))})
+
+
+@pytest.mark.parametrize("tz", ["America/Los_Angeles", "Asia/Tokyo",
+                                "Europe/Berlin", "UTC"])
+def test_from_utc_timestamp_matches_zoneinfo(tz):
+    import zoneinfo
+    t = _ts_table()
+    s = tpu_session()
+    out = s.createDataFrame(t).select(
+        col("ts"), F.from_utc_timestamp(col("ts"), tz).alias("w")
+    ).toArrow()
+    zi = zoneinfo.ZoneInfo(tz)
+    for ts, w in zip(out.column("ts").to_pylist(),
+                     out.column("w").to_pylist()):
+        off = zi.utcoffset(ts).total_seconds()
+        expect = ts + datetime.timedelta(seconds=off)
+        # both stay tz-naive-shifted instants rendered in UTC
+        assert (w - ts).total_seconds() == off, (ts, w, off)
+        del expect
+
+
+def test_from_to_utc_round_trip():
+    # away from DST boundaries the two directions invert exactly
+    t = _ts_table(1995, 2025, 300, 9)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.to_utc_timestamp(
+                F.from_utc_timestamp(col("ts"), "Asia/Tokyo"),
+                "Asia/Tokyo").alias("rt"), col("ts")),
+        conf={"spark.rapids.sql.incompatibleOps.enabled": True})
+
+
+def test_from_utc_device_equals_oracle():
+    t = _ts_table(1960, 2035, 400, 11)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.from_utc_timestamp(col("ts"),
+                                 "America/Los_Angeles").alias("w")))
+
+
+def test_unknown_zone_raises():
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    t = _ts_table(2000, 2001, 5)
+    s = tpu_session()
+    with pytest.raises((AnalysisException, ValueError)):
+        s.createDataFrame(t).select(
+            F.from_utc_timestamp(col("ts"), "Not/AZone"))

@@ -1,0 +1,142 @@
+"""Regex ops (device fast path + host fallback), split, reverse, pads.
+
+[REF: integration_tests/src/main/python/regexp_test.py,
+ string_test.py families; SURVEY §2.1 #13]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.plan.analysis import AnalysisException
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+def str_table():
+    return pa.table({
+        "s": pa.array(["hello world", "Hello", "", "abc123xyz",
+                       None, "aaa", "phone: 555-1234", "x%y_z"]),
+        "i": pa.array(list(range(8)), type=pa.int32()),
+    })
+
+
+def test_rlike_simple_patterns_on_device():
+    t = str_table()
+    # ^lit / lit$ / bare literal / ^lit$ all transpile to device ops
+    for pattern in ("^hello", "world$", "123", "^aaa$"):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s, p=pattern: s.createDataFrame(t).select(
+                "s", F.rlike(col("s"), p).alias("m")))
+
+
+def test_rlike_simple_is_device_resident():
+    t = str_table()
+    s = tpu_session()  # test mode: fallback would raise
+    out = s.createDataFrame(t).filter(col("s").rlike("^hello")).toArrow()
+    assert out.column("s").to_pylist() == ["hello world"]
+
+
+def test_rlike_complex_falls_back():
+    t = str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "s", F.rlike(col("s"), r"\d{3}-\d{4}").alias("m")),
+        allow_non_tpu=["Project", "Filter", "InMemoryScan"])
+
+
+def test_rlike_java_only_construct_raises():
+    t = str_table()
+    s = tpu_session()
+    with pytest.raises(AnalysisException, match="Java-only"):
+        s.createDataFrame(t).select(F.rlike(col("s"), r"a*+b"))
+
+
+def test_regexp_extract():
+    t = str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.regexp_extract(col("s"), r"(\d+)-(\d+)", 2).alias("e")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_regexp_replace():
+    t = str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.regexp_replace(col("s"), r"(\d+)", "N$1").alias("r")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_split_then_explode():
+    t = pa.table({"s": pa.array(["a,b,c", "x", "", "p,q"])})
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    parts = s.createDataFrame(t).select(
+        F.split(col("s"), ",").alias("p")).toArrow()
+    assert parts.column("p").to_pylist() == [
+        ["a", "b", "c"], ["x"], [""], ["p", "q"]]
+
+
+def test_split_limit():
+    t = pa.table({"s": pa.array(["a:b:c:d"])})
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    out = s.createDataFrame(t).select(
+        F.split(col("s"), ":", 2).alias("p")).toArrow()
+    assert out.column("p").to_pylist() == [["a", "b:c:d"]]
+
+
+def test_split_limit_zero_drops_trailing_empties():
+    t = pa.table({"s": pa.array(["a,b,,", "x,"])})
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    out = s.createDataFrame(t).select(
+        F.split(col("s"), ",", 0).alias("p")).toArrow()
+    assert out.column("p").to_pylist() == [["a", "b"], ["x"]]
+    keep = s.createDataFrame(t).select(
+        F.split(col("s"), ",", -1).alias("p")).toArrow()
+    assert keep.column("p").to_pylist() == [["a", "b", "", ""], ["x", ""]]
+
+
+def test_regex_class_with_quantifier_chars_allowed():
+    # '[*+]' is a valid class, not a possessive quantifier
+    t = pa.table({"s": pa.array(["a+b", "ab"])})
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    out = s.createDataFrame(t).filter(col("s").rlike(r"[*+]")).toArrow()
+    assert out.column("s").to_pylist() == ["a+b"]
+
+
+def test_reverse_device():
+    t = str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.reverse(col("s")).alias("r")),
+        conf={"spark.rapids.sql.incompatibleOps.enabled": True})
+
+
+def test_lpad_rpad_device():
+    t = str_table()
+    conf = {"spark.rapids.sql.incompatibleOps.enabled": True}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.lpad(col("s"), 6, "*").alias("l"),
+            F.rpad(col("s"), 6, "-+").alias("r")),
+        conf=conf)
+
+
+def test_pad_truncates_and_empty_pad():
+    t = pa.table({"s": pa.array(["abcdef", "x"])})
+    conf = {"spark.rapids.sql.incompatibleOps.enabled": True}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.lpad(col("s"), 3, "#").alias("t"),
+            F.lpad(col("s"), 5, "").alias("e")),
+        conf=conf)
+
+
+def test_rlike_filter_pushes_into_query():
+    t = str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t)
+        .filter(col("s").rlike("o"))
+        .groupBy().agg(F.count("*").alias("c")))
