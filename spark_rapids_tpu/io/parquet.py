@@ -149,16 +149,24 @@ class CpuParquetScanExec(CpuExec):
         return [f.name for f in fields[:end]]
 
     def _read_file(self, fi: int) -> pa.Table:
-        """Read one file's pruned columns + append partition/file cols."""
+        """Read one file's pruned columns + append partition/file cols.
+
+        Columns missing from a file (schema evolution: added after the
+        file was written) materialize as nulls — Delta/Spark semantics."""
         path = self.paths[fi]
         cols = self._data_columns()
         if self.relation.format == "orc":
             import pyarrow.orc as po
-            tbl = po.ORCFile(path).read(columns=cols)
+            orc = po.ORCFile(path)
+            present = set(orc.schema.names)
+            read_cols = [c for c in cols if c in present]
+            tbl = orc.read(columns=read_cols)
         else:
+            pf = pq.ParquetFile(path)
+            present = set(pf.schema_arrow.names)
+            read_cols = [c for c in cols if c in present]
             filters = self.relation.filters
             if filters:
-                pf = pq.ParquetFile(path)
                 colmap = {pf.metadata.schema.column(i).name: i
                           for i in range(pf.metadata.num_columns)}
                 keep = [rg for rg in range(pf.metadata.num_row_groups)
@@ -166,10 +174,20 @@ class CpuParquetScanExec(CpuExec):
                                          colmap, filters)]
                 self.metric("prunedRowGroups").add(
                     pf.metadata.num_row_groups - len(keep))
-                tbl = (pf.read_row_groups(keep, columns=cols) if keep
-                       else pf.schema_arrow.empty_table().select(cols))
+                tbl = (pf.read_row_groups(keep, columns=read_cols)
+                       if keep
+                       else pf.schema_arrow.empty_table().select(
+                           read_cols))
             else:
-                tbl = pq.read_table(path, columns=cols)
+                tbl = pq.read_table(path, columns=read_cols)
+        if len(read_cols) < len(cols):
+            by_name = {f.name: f for f in self.schema.fields}
+            for c in cols:
+                if c not in present:
+                    tbl = tbl.append_column(
+                        c, pa.nulls(tbl.num_rows,
+                                    type=T.to_arrow(by_name[c].dtype)))
+            tbl = tbl.select(cols)
         n = tbl.num_rows
         if self.relation.partition_values is not None:
             pv = self.relation.partition_values[fi]

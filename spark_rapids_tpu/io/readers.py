@@ -116,8 +116,27 @@ class DataFrameReader:
             partition_values=list(part_values) or None,
             partition_fields=part_fields))
 
+    def format(self, fmt: str) -> "DataFrameReader":
+        self._format = str(fmt).lower()
+        return self
+
+    def load(self, path):
+        fmt = getattr(self, "_format", "parquet")
+        if fmt == "delta":
+            return self.delta(path)
+        if fmt in ("parquet", "orc", "csv", "json", "text", "avro"):
+            return getattr(self, fmt)(path)
+        raise ValueError(f"unknown read format {fmt!r}")
+
     def parquet(self, path):
         return self._file_relation(path, "parquet")
+
+    def delta(self, path):
+        """Delta Lake table read via transaction-log replay
+        [REF: GpuDeltaLog / GpuDeltaParquetFileFormat]."""
+        from spark_rapids_tpu.io.delta import delta_relation
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+        return DataFrame(self.session, delta_relation(path))
 
     def orc(self, path):
         """[REF: GpuOrcScan.scala] — host pyarrow.orc decode + H2D."""
