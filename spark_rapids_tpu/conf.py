@@ -340,6 +340,17 @@ BROADCAST_THRESHOLD = (
     .create_with_default(10 << 20)
 )
 
+UDF_COMPILER_ENABLED = (
+    conf("spark.rapids.sql.udfCompiler.enabled")
+    .doc("Compile simple python UDFs (arithmetic, comparisons, "
+         "conditionals, basic string methods) into device expressions "
+         "via AST lowering — the compiled UDF fuses into the XLA "
+         "program instead of crossing the arrow bridge. UDFs outside "
+         "the subset silently fall back to the bridge.")
+    .boolean()
+    .create_with_default(False)
+)
+
 ADAPTIVE_ENABLED = (
     conf("spark.sql.adaptive.enabled")
     .doc("Adaptive query execution: shuffle-read coalescing of small "

@@ -135,7 +135,11 @@ def _apply_action(state: dict, action: dict) -> None:
 
 def _read_checkpoint(path: str, state: dict) -> None:
     import pyarrow.parquet as pq
-    tbl = pq.read_table(path)
+    pf = pq.ParquetFile(path)
+    # project away per-file stats/txn/commitInfo — only actions matter
+    want = [c for c in ("metaData", "protocol", "add", "remove")
+            if c in pf.schema_arrow.names]
+    tbl = pf.read(columns=want)
     for row in tbl.to_pylist():
         action = {k: v for k, v in row.items() if v is not None}
         _apply_action(state, action)
@@ -169,6 +173,14 @@ def load_snapshot(table_path: str) -> DeltaSnapshot:
             ver = int(fn[:-5])
             if ver >= start_version:
                 versions.append((ver, fn))
+    versions.sort()
+    # Delta readers must verify commit contiguity — a gap means a
+    # missing commit and a silently wrong snapshot
+    for i, (ver, _) in enumerate(versions):
+        if ver != start_version + i:
+            raise DeltaProtocolError(
+                f"delta log has a gap: expected version "
+                f"{start_version + i}, found {ver}")
     for _, fn in sorted(versions):
         with open(os.path.join(log_dir, fn)) as f:
             for line in f:

@@ -179,7 +179,7 @@ class CpuParquetScanExec(CpuExec):
                        else pf.schema_arrow.empty_table().select(
                            read_cols))
             else:
-                tbl = pq.read_table(path, columns=read_cols)
+                tbl = pf.read(columns=read_cols)  # reuse the open file
         if len(read_cols) < len(cols):
             by_name = {f.name: f for f in self.schema.fields}
             for c in cols:

@@ -173,9 +173,17 @@ class DataFrame:
 
     def _select_with_pyudfs(self, cols) -> "DataFrame":
         """Spark's ExtractPythonUDFs analog: one PythonEval node appends
-        every UDF result column, then a Project picks the output."""
+        every UDF result column, then a Project picks the output.
+
+        With ``spark.rapids.sql.udfCompiler.enabled`` the AST compiler
+        first tries to lower each UDF onto the expression tree
+        [REF: udf-compiler/ :: CatalystExpressionBuilder]; compiled UDFs
+        become plain device expressions and skip the bridge entirely."""
+        from spark_rapids_tpu import conf as C
         from spark_rapids_tpu.exec.python_udf import PyUDFSpec
         from spark_rapids_tpu.ops.expressions import BoundReference
+        compile_enabled = bool(self.session.rapids_conf().get(
+            C.UDF_COMPILER_ENABLED))
         base_schema = self.schema
         nc = len(base_schema)
         udfs = []
@@ -193,13 +201,23 @@ class DataFrame:
             u = _to_column(c)._u
             alias = u.payload if u.op == "alias" else None
             name = alias or f"{fname}({', '.join(map(str, args))})"
+            if compile_enabled:
+                from spark_rapids_tpu.sql.udf_compiler import (
+                    UdfCompileError, compile_udf)
+                try:
+                    expr = compile_udf(fn, args, dt)
+                    out_specs.append(("compiled", expr, name, dt))
+                    continue
+                except (UdfCompileError, AN.AnalysisException):
+                    pass  # outside the subset → arrow bridge
             udfs.append(PyUDFSpec(fn, args, dt, vectorized, name))
             out_specs.append(("udf", len(udfs) - 1, name, dt))
         ext_fields = (list(base_schema.fields)
                       + [T.StructField(f"_udf{i}", u.dtype, True)
                          for i, u in enumerate(udfs)])
         ext_schema = T.StructType(tuple(ext_fields))
-        plan = L.PythonEval(self._plan, udfs, ext_schema)
+        plan = (L.PythonEval(self._plan, udfs, ext_schema) if udfs
+                else self._plan)
         exprs, fields = [], []
         for spec in out_specs:
             if spec[0] == "plain":
@@ -215,6 +233,10 @@ class DataFrame:
                 exprs.append(e)
                 fields.append(T.StructField(self._output_name(u, e),
                                             e.dtype))
+            elif spec[0] == "compiled":
+                _, e, name, dt = spec
+                exprs.append(e)
+                fields.append(T.StructField(name, dt, True))
             else:
                 _, i, name, dt = spec
                 exprs.append(BoundReference(nc + i, dt, True))

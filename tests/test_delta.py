@@ -239,6 +239,22 @@ def test_delta_not_a_table(tmp_path):
         s.read.delta(str(tmp_path / "nope"))
 
 
+def test_delta_version_gap_raises(tmp_path):
+    d = str(tmp_path / "gap")
+    log = os.path.join(d, "_delta_log")
+    os.makedirs(log)
+    _write_part(d, "p.parquet", [1], [1.0])
+    _commit(log, 0, [_meta(),
+                     {"add": {"path": "p.parquet", "partitionValues": {},
+                              "size": 1, "modificationTime": 0,
+                              "dataChange": True}}])
+    _commit(log, 2, [{"remove": {"path": "p.parquet",
+                                 "dataChange": True}}])  # missing v1
+    s = tpu_session()
+    with pytest.raises(DeltaProtocolError, match="gap"):
+        s.read.delta(d).toArrow()
+
+
 def test_delta_empty_table(tmp_path):
     d = str(tmp_path / "empty")
     log = os.path.join(d, "_delta_log")

@@ -1,0 +1,146 @@
+"""Python UDF → device expression compiler.
+
+[REF: udf-compiler test families; SURVEY §2.1 #27]
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.sql.udf_compiler import UdfCompileError, compile_udf
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+CONF = {"spark.rapids.sql.udfCompiler.enabled": True}
+
+
+def base_table(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "a": pa.array(rng.integers(-50, 50, n)),
+        "b": pa.array(rng.normal(size=n)),
+        "s": pa.array([f"Str{i%7}" for i in range(n)]),
+    })
+
+
+def _plan_has_bridge(df) -> bool:
+    df.toArrow()
+    return "ArrowEvalPython" in df._last_plan.tree_string()
+
+
+def test_arith_udf_compiles_to_device():
+    t = base_table()
+    u = F.udf(lambda x: x * 2 + 1, "long")
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select("a", u(col("a")).alias("y"))
+    assert not _plan_has_bridge(df)  # no bridge exec in the plan
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: ss.createDataFrame(t).select(
+            "a", u(col("a")).alias("y")), conf=CONF)
+
+
+def test_conditional_udf_compiles():
+    t = base_table(1)
+    u = F.udf(lambda x: x if x > 0 else -x, "long")
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(u(col("a")).alias("y"))
+    assert not _plan_has_bridge(df)
+    out = df.toArrow()
+    assert all(v >= 0 for v in out.column("y").to_pylist())
+
+
+def test_two_arg_and_math_udf():
+    t = base_table(2)
+    u = F.udf(lambda x, y: max(abs(x), y * y), "double")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            u(col("a"), col("b")).alias("m")),
+        conf=CONF, approx_float=True)
+
+
+def test_string_method_udf():
+    t = base_table(3)
+    u = F.udf(lambda s: s.upper(), "string")
+    s = tpu_session({**CONF,
+                     "spark.rapids.sql.incompatibleOps.enabled": True})
+    df = s.createDataFrame(t).select(u(col("s")).alias("u"))
+    assert not _plan_has_bridge(df)
+    assert df.toArrow().column("u").to_pylist()[0].startswith("STR")
+
+
+def test_none_check_udf():
+    t = pa.table({"x": pa.array([1, None, 3], type=pa.int64())})
+    u = F.udf(lambda v: v is None, "boolean")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(u(col("x")).alias("n")),
+        conf=CONF)
+
+
+def test_def_form_compiles():
+    t = base_table(4)
+
+    @F.udf(returnType="double")
+    def half(x):
+        """Docstrings are fine."""
+        return x / 2
+
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(half(col("a")).alias("h"))
+    assert not _plan_has_bridge(df)
+
+
+def test_unsupported_falls_back_to_bridge():
+    t = base_table(5)
+    u = F.udf(lambda x: sum(range(int(x) % 3)), "long")  # loop: no
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(u(col("a")).alias("y"))
+    assert _plan_has_bridge(df)  # bridge exec present, still correct
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: ss.createDataFrame(t).select(
+            u(col("a")).alias("y")), conf=CONF)
+
+
+def test_disabled_always_bridges():
+    t = base_table(6)
+    u = F.udf(lambda x: x + 1, "long")
+    s = tpu_session()  # compiler off by default
+    df = s.createDataFrame(t).select(u(col("a")).alias("y"))
+    assert _plan_has_bridge(df)
+
+
+def test_two_lambdas_one_line_falls_back():
+    t = base_table(7)
+    a, b = (lambda v: v + 1), (lambda v: v - 1)
+    ub = F.udf(b, "long")
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select("a", ub(col("a")).alias("y"))
+    assert _plan_has_bridge(df)  # ambiguous source → bridge, not wrong
+    out = df.toArrow()
+    assert (out.column("y").to_pylist()
+            == [v - 1 for v in out.column("a").to_pylist()])
+    del a
+
+
+def test_int_with_base_falls_back():
+    t = pa.table({"s": pa.array(["1f", "ff"])})
+    u = F.udf(lambda s: int(s, 16), "long")
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(u(col("s")).alias("y"))
+    assert _plan_has_bridge(df)
+    assert df.toArrow().column("y").to_pylist() == [31, 255]
+
+
+def test_compile_udf_unit():
+    from spark_rapids_tpu.ops.expressions import BoundReference
+    e = compile_udf(lambda x: x + 1,
+                    [BoundReference(0, T.LongT)], T.LongT)
+    assert type(e).__name__ in ("Add", "Cast")
+    with pytest.raises(UdfCompileError):
+        compile_udf(lambda x: [x], [BoundReference(0, T.LongT)],
+                    T.LongT)
+    with pytest.raises(UdfCompileError):
+        compile_udf(lambda x, y: x, [BoundReference(0, T.LongT)],
+                    T.LongT)
