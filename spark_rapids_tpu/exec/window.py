@@ -112,10 +112,86 @@ def _scan_minmax(data_s, contrib, pb, kind, dt):
     return segmented_scan(red, masked, pb), None, None
 
 
+def _bounded_window_sum(values, pb, rn, lo: int, hi: int, acc_dt):
+    """Sliding ROWS-frame sum via inclusive-prefix differences.
+
+    [REF: cudf rolling window kernels — re-designed as two gathers over
+    one segmented prefix, the TPU-idiom rolling primitive]
+    frame of row i = rows [i+lo, i+hi] clamped to i's partition."""
+    n = values.shape[0]
+    prefix = segmented_scan(jnp.add, values.astype(acc_dt), pb)
+    i = jnp.arange(n, dtype=jnp.int32)
+    part_start = i - (rn - 1)
+    part_len = broadcast_last(rn, pb)
+    part_end = part_start + part_len - 1
+    end = jnp.clip(i + hi, part_start - 1, part_end)
+    start = jnp.clip(i + lo, part_start, part_end + 1)
+    end_v = jnp.where(end >= part_start,
+                      jnp.take(prefix, jnp.clip(end, 0, n - 1)),
+                      jnp.zeros((), acc_dt))
+    start_v = jnp.where(start > part_start,
+                        jnp.take(prefix, jnp.clip(start - 1, 0, n - 1)),
+                        jnp.zeros((), acc_dt))
+    return jnp.where(end >= start, end_v - start_v,
+                     jnp.zeros((), acc_dt))
+
+
 def _eval_agg(wf: L.WindowFunctionSpec, data_s, valid_s, live_s, pb,
-              peer_b) -> DeviceColumn:
+              peer_b, rn) -> DeviceColumn:
     kind, frame = wf.kind, wf.frame
     contrib = valid_s & live_s
+
+    if frame == "rows_bounded":
+        lo, hi = wf.frame_lo, wf.frame_hi
+        n_contrib = _bounded_window_sum(contrib.astype(jnp.int64), pb,
+                                        rn, lo, hi, jnp.int64)
+        if kind == "count":
+            return DeviceColumn(T.LongT, n_contrib, None)
+
+        def frame_sum(vals, acc_dt):
+            """NaN/Inf-safe bounded-frame float sum: a prefix difference
+            over a poisoned prefix would turn NaN-NaN/Inf-Inf into NaN
+            for frames that EXCLUDE the special row, so specials are
+            counted per frame (int prefixes can't poison) and the sum
+            runs over finite values only."""
+            if not np.issubdtype(acc_dt, np.floating):
+                masked = jnp.where(contrib, vals.astype(acc_dt),
+                                   jnp.zeros((), acc_dt))
+                return _bounded_window_sum(masked, pb, rn, lo, hi,
+                                           acc_dt)
+            v = vals.astype(acc_dt)
+            isnan = jnp.isnan(v)
+            ispinf = jnp.isposinf(v)
+            isninf = jnp.isneginf(v)
+            finite = contrib & ~(isnan | ispinf | isninf)
+
+            def cnt(mask):
+                return _bounded_window_sum(
+                    (contrib & mask).astype(jnp.int64), pb, rn, lo, hi,
+                    jnp.int64)
+
+            s = _bounded_window_sum(
+                jnp.where(finite, v, jnp.zeros((), acc_dt)), pb, rn,
+                lo, hi, acc_dt)
+            n_nan, n_pinf, n_ninf = cnt(isnan), cnt(ispinf), cnt(isninf)
+            s = jnp.where(n_pinf > 0, jnp.asarray(np.inf, acc_dt), s)
+            s = jnp.where(n_ninf > 0, jnp.asarray(-np.inf, acc_dt), s)
+            s = jnp.where((n_nan > 0) | ((n_pinf > 0) & (n_ninf > 0)),
+                          jnp.asarray(np.nan, acc_dt), s)
+            return s
+
+        if kind == "sum":
+            acc_dt = T.to_numpy_dtype(wf.dtype)
+            s = frame_sum(data_s, acc_dt)
+            return DeviceColumn(wf.dtype, s, n_contrib > 0)
+        if kind == "avg":
+            s = frame_sum(data_s, jnp.float64)
+            denom = jnp.where(n_contrib > 0, n_contrib, 1)
+            return DeviceColumn(T.DoubleT,
+                                s / denom.astype(jnp.float64),
+                                n_contrib > 0)
+        raise NotImplementedError(
+            f"bounded-frame window {kind}")  # tagged out in overrides
 
     def proj(x):
         """Frame projection: running value → frame value per row."""
@@ -209,7 +285,7 @@ def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
         sl = None if lengths_s is None else shift(lengths_s, 0)
         return DeviceColumn(wf.dtype, sd, sv, sl)
 
-    return _eval_agg(wf, data_s, valid_s, live_s, pb, peer_b)
+    return _eval_agg(wf, data_s, valid_s, live_s, pb, peer_b, rn)
 
 
 def _window_impl(batch: DeviceBatch, pby: Sequence[Expression],
@@ -398,6 +474,14 @@ class CpuWindowExec(CpuExec):
                         valid = (vc.validity is None
                                  or bool(vc.validity[src]))
                         vals[i] = vc.data[src] if valid else None
+            elif wf.frame == "rows_bounded":
+                fobj = _AGG_CLS[wf.kind](wf.child)
+                for i in range(lo, hi):
+                    acc = _new_acc(fobj)
+                    for j in range(max(lo, i + wf.frame_lo),
+                                   min(hi - 1, i + wf.frame_hi) + 1):
+                        _acc_update(acc, fobj, vc, j)
+                    vals[i] = _acc_final(acc, fobj)
             else:  # aggregates
                 fobj = _AGG_CLS[wf.kind](wf.child)
                 acc = _new_acc(fobj)
@@ -446,6 +530,11 @@ def _tag_window(meta):
             meta.will_not_work(
                 f"window function {wf.kind} has no TPU implementation")
             continue
+        if wf.frame == "rows_bounded" and wf.kind not in (
+                "sum", "count", "avg"):
+            meta.will_not_work(
+                f"bounded-frame window {wf.kind} not supported on "
+                "device (prefix-difference covers sum/count/avg only)")
         if wf.child is not None:
             meta.tag_expressions([wf.child])
             if wf.kind in ("min", "max", "first") and isinstance(

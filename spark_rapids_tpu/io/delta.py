@@ -181,7 +181,7 @@ def load_snapshot(table_path: str) -> DeltaSnapshot:
             raise DeltaProtocolError(
                 f"delta log has a gap: expected version "
                 f"{start_version + i}, found {ver}")
-    for _, fn in sorted(versions):
+    for _, fn in versions:
         with open(os.path.join(log_dir, fn)) as f:
             for line in f:
                 line = line.strip()

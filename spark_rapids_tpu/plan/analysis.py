@@ -390,19 +390,28 @@ def resolve_window(u: UExpr, schema: T.StructType):
             asc, nf = d == "asc", n == "nulls_first"
             o = o.children[0]
         orders.append(L.SortOrder(resolve(o, schema), asc, nf))
+    frame_lo = frame_hi = 0
     if spec.frame is None:
         frame = "range_current" if orders else "partition"
     else:
         kind, lo, hi = spec.frame
+        bounded = (lo != Window.unboundedPreceding
+                   and hi != Window.unboundedFollowing)
         if kind == "rows" and lo == Window.unboundedPreceding and hi == 0:
             frame = "rows_current"
         elif (kind == "rows" and lo == Window.unboundedPreceding
               and hi == Window.unboundedFollowing):
             frame = "partition"
+        elif kind == "rows" and bounded and lo <= hi:
+            # sliding frame, e.g. rowsBetween(-3, 0) — rolling kernels
+            # [REF: cudf rolling / GpuWindowExpression bounded frames]
+            frame = "rows_bounded"
+            frame_lo, frame_hi = int(lo), int(hi)
         else:
             raise AnalysisException(
                 f"unsupported window frame {spec.frame} (supported: "
-                "unboundedPreceding..currentRow, unbounded..unbounded)")
+                "ROWS unboundedPreceding..currentRow, "
+                "unbounded..unbounded, and bounded rowsBetween(a, b))")
 
     if fu.op == "winfn":
         kind = fu.payload[0]
@@ -448,7 +457,8 @@ def resolve_window(u: UExpr, schema: T.StructType):
             dtype = A.Sum(child).result_dtype
         else:
             dtype = child.dtype
-        wf = L.WindowFunctionSpec(kind, child, dtype, frame=frame)
+        wf = L.WindowFunctionSpec(kind, child, dtype, frame=frame,
+                                  frame_lo=frame_lo, frame_hi=frame_hi)
         name = f"{kind}({fu.children[0] if fu.children else '1'})"
     else:
         raise AnalysisException(

@@ -128,6 +128,10 @@ class WindowFunctionSpec:
     dtype: T.DataType
     offset: int = 1          # lag/lead
     frame: str = "partition"
+    # rows_bounded frame offsets relative to the current row
+    # (negative = preceding), e.g. rowsBetween(-2, 0) → lo=-2, hi=0
+    frame_lo: int = 0
+    frame_hi: int = 0
 
 
 @dataclasses.dataclass

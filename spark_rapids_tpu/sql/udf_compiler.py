@@ -72,7 +72,6 @@ def _fn_ast(fn: Callable):
 
 _BINOPS = {
     ast.Add: E.Add, ast.Sub: E.Subtract, ast.Mult: E.Multiply,
-    ast.Mod: E.Remainder,
 }
 _CMPOPS = {
     ast.Eq: E.EqualTo, ast.Lt: E.LessThan,
@@ -104,6 +103,8 @@ class _Lowerer:
             if isinstance(node.op, ast.Pow):
                 return E.Pow(cast_to(l, T.DoubleT),
                              cast_to(r, T.DoubleT))
+            if isinstance(node.op, ast.Mod):
+                return self._py_mod(l, r)
             cls = _BINOPS.get(type(node.op))
             if cls is None:
                 raise UdfCompileError(
@@ -114,7 +115,8 @@ class _Lowerer:
             if isinstance(node.op, ast.USub):
                 return E.UnaryMinus(self.lower(node.operand))
             if isinstance(node.op, ast.Not):
-                return E.Not(self.lower(node.operand))
+                return E.Not(self._require_bool(
+                    self.lower(node.operand), "not"))
             raise UdfCompileError("unary operator not supported")
         if isinstance(node, ast.Compare):
             if len(node.ops) != 1:
@@ -144,14 +146,15 @@ class _Lowerer:
                     f"comparison {type(op).__name__} not supported")
             return cls(l, r)
         if isinstance(node, ast.BoolOp):
-            parts = [self.lower(v) for v in node.values]
+            parts = [self._require_bool(self.lower(v), "and/or")
+                     for v in node.values]
             cls = E.And if isinstance(node.op, ast.And) else E.Or
             out = parts[0]
             for p in parts[1:]:
                 out = cls(out, p)
             return out
         if isinstance(node, ast.IfExp):
-            cond = self.lower(node.test)
+            cond = self._require_bool(self.lower(node.test), "if/else")
             t, f = self.lower(node.body), self.lower(node.orelse)
             ct = common_type(t.dtype, f.dtype)
             return E.CaseWhen([(cond, cast_to(t, ct))], cast_to(f, ct))
@@ -159,6 +162,33 @@ class _Lowerer:
             return self._call(node)
         raise UdfCompileError(
             f"AST node {type(node).__name__} not supported")
+
+    @staticmethod
+    def _require_bool(e: E.Expression, where: str) -> E.Expression:
+        """Python truthiness over non-booleans (`1 if x else 0` with int
+        x) has no columnar equivalent — the device And/CaseWhen are
+        bitwise.  Outside booleans → fall back to the bridge."""
+        if not isinstance(e.dtype, (T.BooleanType, T.NullType)):
+            raise UdfCompileError(
+                f"non-boolean condition in {where} (python truthiness "
+                "does not compile)")
+        return e
+
+    def _py_mod(self, l: E.Expression, r: E.Expression) -> E.Expression:
+        """Python % (sign follows divisor) from the engine's Java-sign
+        Remainder: rem + divisor when signs disagree and rem != 0.
+        (x % 0: python raises, the compiled form is null — same
+        error-vs-null caveat as null inputs, see module docstring.)"""
+        from spark_rapids_tpu.plan.analysis import (
+            cast_to, common_type, literal)
+        ct = common_type(l.dtype, r.dtype)
+        l, r = cast_to(l, ct), cast_to(r, ct)
+        rem = E.Remainder(l, r)
+        zero = cast_to(literal(0), ct)
+        signs_differ = E.Or(
+            E.And(E.LessThan(rem, zero), E.GreaterThan(r, zero)),
+            E.And(E.GreaterThan(rem, zero), E.LessThan(r, zero)))
+        return E.CaseWhen([(signs_differ, E.Add(rem, r))], rem)
 
     def _call(self, node: ast.Call) -> E.Expression:
         from spark_rapids_tpu.plan.analysis import cast_to, common_type
@@ -209,7 +239,8 @@ def compile_udf(fn: Callable, args: List[E.Expression],
         raise UdfCompileError(
             f"UDF takes {len(names)} args, called with {len(args)}")
     expr = _Lowerer(dict(zip(names, args))).lower(body)
-    if expr.dtype != result_dtype and not isinstance(
-            expr.dtype, T.NullType):
+    if expr.dtype != result_dtype:
+        # cast_to constant-folds Literal(None) onto the declared type,
+        # so NullType results also land with the right column dtype
         expr = cast_to(expr, result_dtype)
     return expr

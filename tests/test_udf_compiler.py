@@ -144,3 +144,32 @@ def test_compile_udf_unit():
     with pytest.raises(UdfCompileError):
         compile_udf(lambda x, y: x, [BoundReference(0, T.LongT)],
                     T.LongT)
+
+
+def test_compiled_modulo_python_semantics():
+    t = pa.table({"x": pa.array([-3, 3, -7, 7, 0], type=pa.int64())})
+    u = F.udf(lambda x: x % 7, "long")
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(u(col("x")).alias("m"))
+    assert not _plan_has_bridge(df)
+    assert df.toArrow().column("m").to_pylist() == [4, 3, 0, 0, 0]
+
+
+def test_truthiness_condition_falls_back():
+    t = base_table(8)
+    u = F.udf(lambda x: 1 if x else 0, "long")  # int truthiness
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(u(col("a")).alias("y"))
+    assert _plan_has_bridge(df)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda ss: ss.createDataFrame(t).select(
+            u(col("a")).alias("y")), conf=CONF)
+
+
+def test_none_returning_udf_compiles_with_declared_type():
+    t = base_table(20, 9)
+    u = F.udf(lambda x: None, "long")
+    s = tpu_session(CONF)
+    df = s.createDataFrame(t).select(u(col("a")).alias("n"))
+    assert not _plan_has_bridge(df)
+    assert df.toArrow().column("n").to_pylist() == [None] * 20

@@ -227,7 +227,9 @@ def test_window_avg_double():
 
 def test_window_unsupported_frame_raises():
     t = gen_table(11, n=20)
-    w = Window.partitionBy("k").orderBy("o").rowsBetween(-2, 2)
+    # currentRow..unboundedFollowing is still unsupported
+    w = (Window.partitionBy("k").orderBy("o")
+         .rowsBetween(0, Window.unboundedFollowing))
 
     def build(s):
         return s.createDataFrame(t).select(
@@ -245,3 +247,80 @@ def test_window_string_minmax_falls_back():
         lambda s: s.createDataFrame(t).select(
             "k", "s", F.first("s").over(w).alias("fs")),
         "Window")
+
+
+def test_bounded_rows_frame_trailing():
+    # rolling 3-row trailing window (2 preceding .. current)
+    t = gen_table(11)
+    w = (Window.partitionBy("k").orderBy("o", "v")
+         .rowsBetween(-2, Window.currentRow))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.sum("v").over(w).alias("rsum"),
+            F.count("v").over(w).alias("rcnt"),
+            F.avg("v").over(w).alias("ravg")),
+        approx_float=True)
+
+
+def test_bounded_rows_frame_centered_and_following():
+    t = gen_table(12)
+    wc = (Window.partitionBy("k").orderBy("o", "v").rowsBetween(-1, 1))
+    wf = (Window.partitionBy("k").orderBy("o", "v").rowsBetween(1, 3))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o",
+            F.sum("v").over(wc).alias("c3"),
+            F.count("v").over(wf).alias("f3")),
+        approx_float=True)
+
+
+def test_bounded_rows_frame_empty_at_edges():
+    # frame strictly behind the current row: first rows get null sum
+    t = gen_table(13)
+    w = (Window.partitionBy("k").orderBy("o", "v").rowsBetween(-3, -2))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", F.sum("v").over(w).alias("behind")),
+        approx_float=True)
+
+
+def test_bounded_rows_frame_nulls_in_values():
+    t = pa.table({
+        "k": pa.array([0, 0, 0, 0, 1, 1], type=pa.int32()),
+        "o": pa.array([1, 2, 3, 4, 1, 2], type=pa.int32()),
+        "v": pa.array([1.0, None, 3.0, None, 5.0, 6.0]),
+    })
+    w = (Window.partitionBy("k").orderBy("o").rowsBetween(-1, 0))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", F.sum("v").over(w).alias("s"),
+            F.count("v").over(w).alias("c")))
+
+
+def test_bounded_rows_frame_minmax_falls_back():
+    from spark_rapids_tpu.utils.harness import cpu_session
+    t = gen_table(14)
+    w = (Window.partitionBy("k").orderBy("o", "v").rowsBetween(-2, 0))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", F.min("v").over(w).alias("m")),
+        allow_non_tpu=["Window", "InMemoryScan", "Project"])
+
+
+def test_bounded_rows_frame_nan_inf_isolated():
+    # a NaN/Inf row must not poison frames that exclude it
+    t = pa.table({
+        "k": pa.array([0] * 6, type=pa.int32()),
+        "o": pa.array(list(range(6)), type=pa.int32()),
+        "v": pa.array([float("nan"), 1.0, 2.0, float("inf"), 5.0, 6.0]),
+    })
+    w = Window.partitionBy("k").orderBy("o").rowsBetween(-1, 0)
+    c, out = assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "o", F.sum("v").over(w).alias("s"),
+            F.avg("v").over(w).alias("a")))
+    rows = {r["o"]: r["s"] for r in out.to_pylist()}
+    assert rows[2] == 3.0          # frame (1,2): finite
+    assert rows[5] == 11.0         # frame (4,5): finite after the Inf
+    assert rows[3] == float("inf")
