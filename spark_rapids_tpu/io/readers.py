@@ -122,9 +122,8 @@ class DataFrameReader:
 
     def load(self, path):
         fmt = getattr(self, "_format", "parquet")
-        if fmt == "delta":
-            return self.delta(path)
-        if fmt in ("parquet", "orc", "csv", "json", "text", "avro"):
+        if fmt in ("delta", "iceberg", "parquet", "orc", "csv", "json",
+                   "text", "avro"):
             return getattr(self, fmt)(path)
         raise ValueError(f"unknown read format {fmt!r}")
 
@@ -143,10 +142,27 @@ class DataFrameReader:
         return self._file_relation(path, "orc")
 
     def avro(self, path):
-        raise NotImplementedError(
-            "avro is not supported in this environment (no avro decoder "
-            "library is bundled); convert to parquet/orc, or use "
-            "csv/json for text formats")
+        """Flat-record avro via the built-in container codec
+        [REF: GpuAvroScan.scala — host-parsed there too]."""
+        import pyarrow as pa
+        from spark_rapids_tpu.io.avro import avro_to_arrow
+        paths = _expand(path)
+        tbl = pa.concat_tables([avro_to_arrow(p) for p in paths],
+                               promote_options="permissive")
+        if self._schema is not None:
+            # honor a user schema like the other formats: cast columns
+            # onto the declared types, in declared order
+            tbl = tbl.select(self._schema.field_names()).cast(pa.schema(
+                [(f.name, T.to_arrow(f.dtype))
+                 for f in self._schema.fields]))
+        return self.session.createDataFrame(tbl)
+
+    def iceberg(self, path):
+        """Iceberg table read via metadata/manifest replay
+        [REF: GpuIcebergParquetReader]."""
+        from spark_rapids_tpu.io.iceberg import iceberg_relation
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+        return DataFrame(self.session, iceberg_relation(path))
 
     def text(self, path):
         """Each line as one 'value' string column (spark.read.text)."""

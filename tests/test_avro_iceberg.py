@@ -1,0 +1,271 @@
+"""Avro container codec + read.avro + Iceberg table read.
+
+[REF: avro_test.py / iceberg test families; SURVEY §2.1 #20/#31].
+Avro files are written with the built-in encoder and Iceberg tables are
+hand-assembled to the public spec — the format is the contract.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.io.avro import (
+    AvroError, avro_to_arrow, read_container, write_container)
+from spark_rapids_tpu.io.iceberg import IcebergProtocolError
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, cpu_session, tpu_session)
+
+
+# -- avro codec -------------------------------------------------------------
+
+REC_SCHEMA = {
+    "type": "record", "name": "r", "fields": [
+        {"name": "i", "type": "int"},
+        {"name": "l", "type": "long"},
+        {"name": "d", "type": "double"},
+        {"name": "s", "type": "string"},
+        {"name": "b", "type": "boolean"},
+        {"name": "opt", "type": ["null", "long"]},
+        {"name": "arr", "type": {"type": "array", "items": "int"}},
+        {"name": "m", "type": {"type": "map", "values": "string"}},
+    ]}
+
+ROWS = [
+    {"i": 1, "l": -(1 << 40), "d": 2.5, "s": "héllo", "b": True,
+     "opt": None, "arr": [1, 2, 3], "m": {"a": "x"}},
+    {"i": -7, "l": 0, "d": float(-0.0), "s": "", "b": False,
+     "opt": 99, "arr": [], "m": {}},
+]
+
+
+@pytest.mark.parametrize("codec", ["null", "deflate"])
+def test_avro_round_trip(tmp_path, codec):
+    p = str(tmp_path / "t.avro")
+    write_container(p, REC_SCHEMA, ROWS, codec=codec)
+    schema, recs = read_container(p)
+    assert schema["name"] == "r"
+    assert recs == ROWS
+
+
+def test_avro_corrupt_magic(tmp_path):
+    p = str(tmp_path / "bad.avro")
+    with open(p, "wb") as f:
+        f.write(b"nope")
+    with pytest.raises(AvroError):
+        read_container(p)
+
+
+def test_read_avro_flat(tmp_path):
+    schema = {"type": "record", "name": "t", "fields": [
+        {"name": "x", "type": "long"},
+        {"name": "y", "type": ["null", "double"]},
+        {"name": "day", "type": {"type": "int", "logicalType": "date"}},
+        {"name": "ts", "type": {"type": "long",
+                                "logicalType": "timestamp-micros"}},
+        {"name": "name", "type": "string"},
+    ]}
+    rows = [{"x": i, "y": None if i == 1 else i * 1.5,
+             "day": 19000 + i, "ts": 1_600_000_000_000_000 + i,
+             "name": f"n{i}"} for i in range(4)]
+    p = str(tmp_path / "flat.avro")
+    write_container(p, schema, rows)
+    tbl = avro_to_arrow(p)
+    assert tbl.column("x").to_pylist() == [0, 1, 2, 3]
+    assert tbl.column("y").to_pylist()[1] is None
+    s = tpu_session()
+    out = s.read.avro(p).filter(col("x") > 1).select("x", "name")
+    assert out.toArrow().column("name").to_pylist() == ["n2", "n3"]
+
+
+# -- iceberg ----------------------------------------------------------------
+
+ICE_SCHEMA = {
+    "type": "struct", "schema-id": 0, "fields": [
+        {"id": 1, "name": "id", "type": "long", "required": True},
+        {"id": 2, "name": "v", "type": "double", "required": False},
+        {"id": 3, "name": "part", "type": "long", "required": False},
+    ]}
+
+MANIFEST_SCHEMA = {
+    "type": "record", "name": "manifest_entry", "fields": [
+        {"name": "status", "type": "int"},
+        {"name": "data_file", "type": {
+            "type": "record", "name": "data_file", "fields": [
+                {"name": "content", "type": "int"},
+                {"name": "file_path", "type": "string"},
+                {"name": "file_format", "type": "string"},
+                {"name": "partition", "type": {
+                    "type": "record", "name": "r102", "fields": [
+                        {"name": "part", "type": ["null", "long"]}]}},
+                {"name": "record_count", "type": "long"},
+            ]}},
+    ]}
+
+MLIST_SCHEMA = {
+    "type": "record", "name": "manifest_file", "fields": [
+        {"name": "manifest_path", "type": "string"},
+        {"name": "manifest_length", "type": "long"},
+    ]}
+
+
+def _make_iceberg(tmp_path, entries, partitioned=True,
+                  snapshot_id=10):
+    d = str(tmp_path / "ice")
+    meta = os.path.join(d, "metadata")
+    os.makedirs(meta)
+    os.makedirs(os.path.join(d, "data"), exist_ok=True)
+    manifest = os.path.join(meta, "m1.avro")
+    write_container(manifest, MANIFEST_SCHEMA, entries, codec="deflate")
+    mlist = os.path.join(meta, "snap-10.avro")
+    write_container(mlist, MLIST_SCHEMA, [
+        {"manifest_path": manifest,
+         "manifest_length": os.path.getsize(manifest)}])
+    md = {
+        "format-version": 2,
+        "table-uuid": "u",
+        "location": d,
+        "current-schema-id": 0,
+        "schemas": [ICE_SCHEMA],
+        "default-spec-id": 0,
+        "partition-specs": [{"spec-id": 0, "fields": (
+            [{"name": "part", "transform": "identity",
+              "source-id": 3, "field-id": 1000}] if partitioned
+            else [])}],
+        "current-snapshot-id": snapshot_id,
+        "snapshots": [{"snapshot-id": 10, "manifest-list": mlist}],
+    }
+    with open(os.path.join(meta, "v1.metadata.json"), "w") as f:
+        json.dump(md, f)
+    with open(os.path.join(meta, "version-hint.text"), "w") as f:
+        f.write("1")
+    return d
+
+
+def _data_file(d, name, ids, vs):
+    p = os.path.join(d, "data", name)
+    pq.write_table(pa.table({
+        "id": pa.array(ids, type=pa.int64()),
+        "v": pa.array(vs, type=pa.float64())}), p)
+    return p
+
+
+def _entry(path, part, status=1):
+    return {"status": status, "data_file": {
+        "content": 0, "file_path": path, "file_format": "PARQUET",
+        "partition": {"part": part}, "record_count": 1}}
+
+
+def test_iceberg_basic_read(tmp_path):
+    d = str(tmp_path / "ice")
+    os.makedirs(os.path.join(d, "data"))
+    f1 = _data_file(d, "f1.parquet", [1, 2], [1.0, 2.0])
+    f2 = _data_file(d, "f2.parquet", [3], [3.0])
+    _make_iceberg(tmp_path, [_entry(f1, 7), _entry(f2, 8)])
+    s = tpu_session()
+    out = s.read.format("iceberg").load(d).orderBy("id").toArrow()
+    assert out.column("id").to_pylist() == [1, 2, 3]
+    assert out.column("part").to_pylist() == [7, 7, 8]
+
+
+def test_iceberg_deleted_entries_skipped(tmp_path):
+    d = str(tmp_path / "ice")
+    os.makedirs(os.path.join(d, "data"))
+    f1 = _data_file(d, "f1.parquet", [1], [1.0])
+    f2 = _data_file(d, "f2.parquet", [2], [2.0])
+    _make_iceberg(tmp_path, [_entry(f1, 1),
+                             _entry(f2, 1, status=2)])
+    s = tpu_session()
+    assert s.read.iceberg(d).toArrow().column("id").to_pylist() == [1]
+
+
+def test_iceberg_group_by_partition_oracle(tmp_path):
+    d = str(tmp_path / "ice")
+    os.makedirs(os.path.join(d, "data"))
+    f1 = _data_file(d, "f1.parquet", [1, 2], [1.0, 2.0])
+    f2 = _data_file(d, "f2.parquet", [3, 4], [3.0, 4.0])
+    _make_iceberg(tmp_path, [_entry(f1, 1), _entry(f2, 2)])
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.read.iceberg(d).groupBy("part").agg(
+            F.sum("v").alias("sv")),
+        ignore_order=True)
+
+
+def test_iceberg_delete_files_gated(tmp_path):
+    d = str(tmp_path / "ice")
+    os.makedirs(os.path.join(d, "data"))
+    f1 = _data_file(d, "f1.parquet", [1], [1.0])
+    bad = {"status": 1, "data_file": {
+        "content": 1, "file_path": f1, "file_format": "PARQUET",
+        "partition": {"part": None}, "record_count": 1}}
+    _make_iceberg(tmp_path, [bad])
+    s = tpu_session()
+    with pytest.raises(IcebergProtocolError, match="delete files"):
+        s.read.iceberg(d).toArrow()
+
+
+def test_iceberg_nonidentity_transform_gated(tmp_path):
+    d = _make_iceberg(tmp_path, [])
+    # rewrite spec with a bucket transform
+    meta = os.path.join(d, "metadata", "v1.metadata.json")
+    with open(meta) as f:
+        md = json.load(f)
+    md["partition-specs"][0]["fields"] = [
+        {"name": "part_bucket", "transform": "bucket[16]",
+         "source-id": 3, "field-id": 1000}]
+    with open(meta, "w") as f:
+        json.dump(md, f)
+    s = tpu_session()
+    with pytest.raises(IcebergProtocolError, match="transform"):
+        s.read.iceberg(d).toArrow()
+
+
+def test_iceberg_empty_table(tmp_path):
+    d = _make_iceberg(tmp_path, [], snapshot_id=None)
+    s = tpu_session()
+    out = s.read.iceberg(d).toArrow()
+    assert out.num_rows == 0
+    assert "id" in out.column_names
+
+
+def test_iceberg_catalog_metadata_naming(tmp_path):
+    # '<version>-<uuid>.metadata.json' without version-hint: latest
+    # version wins, uuid digits must not affect selection
+    d = _make_iceberg(tmp_path, [])
+    meta = os.path.join(d, "metadata")
+    os.remove(os.path.join(meta, "version-hint.text"))
+    src = os.path.join(meta, "v1.metadata.json")
+    with open(src) as f:
+        md = json.load(f)
+    os.remove(src)
+    stale = dict(md)
+    stale["current-snapshot-id"] = None
+    with open(os.path.join(
+            meta, "00001-99999999aaaa.metadata.json"), "w") as f:
+        json.dump(stale, f)
+    with open(os.path.join(
+            meta, "00002-00000000bbbb.metadata.json"), "w") as f:
+        json.dump(md, f)
+    from spark_rapids_tpu.io.iceberg import _latest_metadata
+    assert _latest_metadata(d).endswith("00002-00000000bbbb"
+                                        ".metadata.json")
+
+
+def test_read_avro_user_schema(tmp_path):
+    schema = {"type": "record", "name": "t", "fields": [
+        {"name": "x", "type": "long"},
+        {"name": "y", "type": "double"}]}
+    p = str(tmp_path / "u.avro")
+    write_container(p, schema, [{"x": 1, "y": 2.0}])
+    from spark_rapids_tpu.columnar import dtypes as T
+    st = T.StructType((T.StructField("x", T.IntegerT),
+                       T.StructField("y", T.FloatT)))
+    s = tpu_session()
+    out = s.read.schema(st).format("avro").load(p).toArrow()
+    assert out.schema.field("x").type == pa.int32()
+    assert out.schema.field("y").type == pa.float32()

@@ -126,9 +126,9 @@ def test_text_reader(tmp_path):
     assert out.column("value").to_pylist() == ["alpha", "beta", "gamma"]
 
 
-def test_avro_gated(tmp_path):
+def test_avro_missing_file_raises(tmp_path):
     s = tpu_session()
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(FileNotFoundError):
         s.read.avro(str(tmp_path / "x.avro"))
 
 
