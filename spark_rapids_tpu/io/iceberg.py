@@ -156,7 +156,7 @@ def load_snapshot(table_path: str):
             part = df.get("partition") or {}
             files.append((_resolve_path(df["file_path"], table_path),
                           dict(part)))
-    return schema, part_cols, sorted(files)
+    return schema, part_cols, sorted(files, key=lambda t: t[0])
 
 
 def iceberg_relation(table_path: str):

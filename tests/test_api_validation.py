@@ -1,0 +1,7 @@
+"""API drift guard [REF: api_validation/; SURVEY §2.1 #37]."""
+
+from spark_rapids_tpu.utils.api_validation import validate
+
+
+def test_api_surface_clean():
+    assert validate() == []
