@@ -392,7 +392,22 @@ def host_to_device(table: pa.Table, bucket: Optional[int] = None,
 def device_to_host(batch: DeviceBatch, already_compact: bool = False) -> pa.Table:
     """DeviceBatch -> pyarrow.Table (compacts first).
 
-    All device buffers are pulled with ONE overlapped transfer round
+    The fault injector's transfer chokepoint wraps the WHOLE transfer
+    body, so a transient injected fault retries the actual D2H — the
+    recovery the shim exists to prove [REF: faultinj analog, N15]."""
+    from spark_rapids_tpu.runtime.faultinj import (
+        INJECTOR, retry_device_call)
+    if INJECTOR.armed:
+        def call():
+            INJECTOR.on_transfer()
+            return _device_to_host_impl(batch, already_compact)
+        return retry_device_call(call)
+    return _device_to_host_impl(batch, already_compact)
+
+
+def _device_to_host_impl(batch: DeviceBatch,
+                         already_compact: bool) -> pa.Table:
+    """All device buffers are pulled with ONE overlapped transfer round
     trip: sequential ``np.asarray`` pulls cost a full device round trip
     EACH (measured ~40-90 ms per pull through the axon tunnel), so every
     buffer is prefetched with ``copy_to_host_async`` first and the row

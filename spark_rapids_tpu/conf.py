@@ -442,6 +442,36 @@ FAULT_INJECT = (
     .create_with_default(-1)
 )
 
+INJECT_EXECUTE_AT = (
+    conf("spark.rapids.tpu.test.injectExecuteErrorAt")
+    .doc("Raise an injected device error at the Nth kernel execution "
+         "(resilience test hook, the faultinj analog). -1 disables.")
+    .category("test")
+    .internal()
+    .integer()
+    .create_with_default(-1)
+)
+
+INJECT_TRANSFER_AT = (
+    conf("spark.rapids.tpu.test.injectTransferErrorAt")
+    .doc("Raise an injected device error at the Nth device→host "
+         "transfer. -1 disables.")
+    .category("test")
+    .internal()
+    .integer()
+    .create_with_default(-1)
+)
+
+INJECT_TRANSIENT_COUNT = (
+    conf("spark.rapids.tpu.test.injectTransientCount")
+    .doc("How many injected device errors are transient (retried once "
+         "by the engine) before they turn terminal.")
+    .category("test")
+    .internal()
+    .integer()
+    .create_with_default(0)
+)
+
 
 class RapidsConf:
     """Immutable-ish view over a raw key->value dict, validated at init.

@@ -17,6 +17,8 @@ from typing import Callable, Dict, Tuple
 
 import jax
 
+from spark_rapids_tpu.runtime.faultinj import INJECTOR, retry_device_call
+
 _CACHE: Dict[tuple, Callable] = {}
 # partitions pump on a thread pool: without a lock, racing threads each
 # build their own jit wrapper for the same key and XLA compiles twice
@@ -43,11 +45,22 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
     """Return the jitted kernel for key, building+jitting it on first use.
 
     jax.jit itself is lazy (tracing happens at first call), so holding the
-    lock across build+insert is cheap."""
+    lock across build+insert is cheap.  Every call passes the fault
+    injector's execute chokepoint [REF: faultinj analog, SURVEY N15] —
+    an attribute check when disarmed, a configured raise when armed."""
     with _CACHE_LOCK:
         fn = _CACHE.get(key)
         if fn is None:
-            fn = jax.jit(builder())
+            jfn = jax.jit(builder())
+
+            def fn(*args, __jfn=jfn, **kw):
+                if INJECTOR.armed:
+                    def call():
+                        INJECTOR.on_execute()
+                        return __jfn(*args, **kw)
+                    return retry_device_call(call)
+                return __jfn(*args, **kw)
+
             _CACHE[key] = fn
         return fn
 

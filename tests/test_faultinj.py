@@ -1,0 +1,91 @@
+"""Device-call fault injection [REF: spark-rapids-jni faultinj;
+SURVEY §2.2 N15, §5.3 failure-detection policy]."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.runtime.faultinj import INJECTOR, InjectedDeviceError
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import tpu_session
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    INJECTOR.reset()
+    yield
+    INJECTOR.reset()
+
+
+def table(n=500):
+    rng = np.random.default_rng(0)
+    return pa.table({"k": pa.array((np.arange(n) % 5).astype(np.int32)),
+                     "v": pa.array(rng.normal(size=n))})
+
+
+def _query(s, t):
+    return s.createDataFrame(t).filter(col("v") > -10).groupBy("k").agg(
+        F.sum("v").alias("sv"))
+
+
+def test_terminal_execute_error_fails_query():
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 2})
+    with pytest.raises(InjectedDeviceError, match="execute"):
+        _query(s, t).toArrow()
+
+
+def test_transient_execute_error_recovers():
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 2,
+                     "spark.rapids.tpu.test.injectTransientCount": 1})
+    out = _query(s, t).toArrow()
+    clean = tpu_session()
+    expect = _query(clean, t).toArrow()
+    got = {r["k"]: r["sv"] for r in out.to_pylist()}
+    want = {r["k"]: r["sv"] for r in expect.to_pylist()}
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-9
+
+
+def test_terminal_transfer_error_fails_query():
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectTransferErrorAt": 1})
+    with pytest.raises(InjectedDeviceError, match="transfer"):
+        _query(s, t).toArrow()
+
+
+def test_transient_transfer_error_recovers():
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectTransferErrorAt": 1,
+                     "spark.rapids.tpu.test.injectTransientCount": 1})
+    assert _query(s, t).toArrow().num_rows == 5
+
+
+def test_disarmed_runs_clean():
+    t = table()
+    s = tpu_session()
+    assert _query(s, t).toArrow().num_rows == 5
+
+
+def test_persistent_transient_exhausts_retries():
+    # budget > engine retry attempts models a persistent fault
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 1,
+                     "spark.rapids.tpu.test.injectTransientCount": 5})
+    with pytest.raises(InjectedDeviceError) as ei:
+        _query(s, t).toArrow()
+    assert ei.value.transient  # retries exhausted on a transient fault
+
+
+def test_clean_session_does_not_disarm():
+    t = table()
+    armed = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 4})
+    armed.createDataFrame(t)  # arming happens at planning
+    _ = _query(armed, t)._execute_plan()
+    assert INJECTOR.armed
+    clean = tpu_session()
+    clean.createDataFrame(t).select("k").toArrow()  # other session plans
+    assert INJECTOR.armed  # untouched by the clean conf
