@@ -179,7 +179,8 @@ jax.tree_util.register_pytree_node(DeviceBatch, _batch_flatten, _batch_unflatten
 def _compact_impl(batch: DeviceBatch) -> DeviceBatch:
     # Stable argsort on "dead" flag moves live rows to the front preserving
     # order.  One lax.sort; vectorizes fine on TPU.
-    order = jnp.argsort((~batch.sel).astype(jnp.int8), stable=True)
+    from spark_rapids_tpu.shims import get_shim
+    order = get_shim().stable_argsort((~batch.sel).astype(jnp.int8))
     cols = tuple(c.gather(order) for c in batch.columns)
     count = jnp.sum(batch.sel.astype(jnp.int32))
     sel = jnp.arange(batch.capacity, dtype=jnp.int32) < count
@@ -422,10 +423,10 @@ def _device_to_host_impl(batch: DeviceBatch,
             bufs.append(c.validity)
         if c.lengths is not None:
             bufs.append(c.lengths)
+    from spark_rapids_tpu.shims import get_shim
+    shim = get_shim()
     for b in bufs:
-        try:
-            b.copy_to_host_async()
-        except AttributeError:
+        if not shim.async_copy_to_host(b):
             break
     n = int(np.count_nonzero(np.asarray(batch.sel)))
     arrays = []

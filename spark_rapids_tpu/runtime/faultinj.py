@@ -80,6 +80,9 @@ class _Injector:
             self._exec_at = -1
         else:
             self._transfer_at = -1
+        # forget the config so an identical injection conf RE-ARMS on
+        # its next planning — per-query deterministic injection
+        self._config = None
 
     def _fire(self, where: str, n: int) -> None:
         transient = self._transients_fired < self._transient_budget

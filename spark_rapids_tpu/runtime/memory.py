@@ -88,11 +88,10 @@ class SpillableBatch:
         b = self._batch
         leaves, treedef = jax.tree.flatten(b)
         # one overlapped transfer round trip (see columnar.device_to_host)
+        from spark_rapids_tpu.shims import get_shim
+        shim = get_shim()
         for x in leaves:
-            try:
-                x.copy_to_host_async()
-            except AttributeError:
-                pass
+            shim.async_copy_to_host(x)
         self._host = ([np.asarray(x) for x in leaves], treedef)
         self._batch = None
         self._host_accounted = True

@@ -1,0 +1,102 @@
+"""Shim layer — one engine over multiple jax/runtime versions.
+
+[REF: sql-plugin-api/../ShimLoader.scala, per-version SparkShimImpl;
+ SURVEY §2.1 #2] — the reference ships one jar supporting many Spark
+versions through service-provider shims picked by version at runtime.
+This engine's moving substrate is jax/XLA rather than Spark, so the
+same mechanism binds here: a ``Shim`` provider per supported jax
+version range, selected once at import, carrying every
+version-sensitive behavior behind a stable interface.  Adding support
+for a new jax release = adding a provider, not editing call sites.
+
+Current hooks (each one exists because call sites genuinely vary or
+have varied across jax releases):
+* ``async_copy_to_host(buf)`` — overlapped D2H prefetch
+  (``copy_to_host_async``; a no-op provider keeps older/exotic array
+  types working — the try/except that previously lived at call sites).
+* ``stable_argsort(x)`` — stable ascending argsort (the ``stable=``
+  kwarg is newer than some supported versions).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class Shim:
+    """Base provider — implements hooks for the newest supported jax."""
+
+    version_range = ("0.5", None)  # [min, max) — None = open-ended
+    name = "jax-current"
+
+    def async_copy_to_host(self, buf) -> bool:
+        """Start an async D2H copy; False when unsupported for buf."""
+        try:
+            buf.copy_to_host_async()
+            return True
+        except AttributeError:
+            return False
+
+    def stable_argsort(self, x):
+        import jax.numpy as jnp
+        return jnp.argsort(x, stable=True)
+
+
+class LegacyJaxShim(Shim):
+    """jax < 0.5: no ``stable=`` kwarg on ``jnp.argsort`` — go through
+    ``lax.sort`` (stable variadic sort, API constant across versions)."""
+
+    version_range = ("0.4", "0.5")
+    name = "jax-legacy-0.4"
+
+    def stable_argsort(self, x):
+        import jax
+        import jax.numpy as jnp
+        iota = jnp.arange(x.shape[0], dtype=jnp.int32)
+        _, perm = jax.lax.sort((x, iota), num_keys=1, is_stable=True)
+        return perm
+
+
+_PROVIDERS = [Shim, LegacyJaxShim]
+_active: Optional[Shim] = None
+
+
+def _version_tuple(v: str):
+    out = []
+    for part in v.split(".")[:3]:
+        digits = "".join(ch for ch in part if ch.isdigit())
+        out.append(int(digits) if digits else 0)
+    return tuple(out)
+
+
+def _in_range(version: str, rng) -> bool:
+    lo, hi = rng
+    v = _version_tuple(version)
+    if lo is not None and v < _version_tuple(lo):
+        return False
+    if hi is not None and v >= _version_tuple(hi):
+        return False
+    return True
+
+
+def get_shim() -> Shim:
+    """Select the provider matching the running jax version (cached).
+
+    [REF: ShimLoader.getShimVersion — same pick-by-version contract]"""
+    global _active
+    if _active is None:
+        import jax
+        for cls in _PROVIDERS:
+            if _in_range(jax.__version__, cls.version_range):
+                _active = cls()
+                break
+        else:
+            raise RuntimeError(
+                f"no shim provider for jax {jax.__version__}; supported "
+                f"ranges: {[c.version_range for c in _PROVIDERS]}")
+    return _active
+
+
+def reset_shim() -> None:
+    global _active
+    _active = None

@@ -89,3 +89,13 @@ def test_clean_session_does_not_disarm():
     clean = tpu_session()
     clean.createDataFrame(t).select("k").toArrow()  # other session plans
     assert INJECTOR.armed  # untouched by the clean conf
+
+
+def test_rearm_with_identical_conf():
+    # after a terminal fire self-disarms, the same conf must re-arm
+    t = table()
+    conf = {"spark.rapids.tpu.test.injectExecuteErrorAt": 1}
+    for _ in range(2):
+        s = tpu_session(conf)
+        with pytest.raises(InjectedDeviceError):
+            _query(s, t).toArrow()

@@ -1,0 +1,46 @@
+"""Shim layer: version-selected providers [REF: ShimLoader.scala;
+SURVEY §2.1 #2]."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.shims import (
+    LegacyJaxShim, Shim, _in_range, get_shim, reset_shim)
+
+
+def test_active_shim_matches_running_jax():
+    import jax
+    shim = get_shim()
+    assert _in_range(jax.__version__, shim.version_range)
+
+
+def test_version_range_selection():
+    assert _in_range("0.9.0", Shim.version_range)
+    assert not _in_range("0.9.0", LegacyJaxShim.version_range)
+    assert _in_range("0.4.30", LegacyJaxShim.version_range)
+    assert not _in_range("0.4.30", Shim.version_range)
+
+
+def test_stable_argsort_equivalence():
+    # both providers must implement the same contract
+    x = np.array([3, 1, 3, 2, 1], np.int8)
+    import jax.numpy as jnp
+    a = np.asarray(Shim().stable_argsort(jnp.asarray(x)))
+    b = np.asarray(LegacyJaxShim().stable_argsort(jnp.asarray(x)))
+    assert list(a) == list(b) == [1, 4, 3, 0, 2]
+
+
+def test_async_copy_tolerates_plain_objects():
+    assert Shim().async_copy_to_host(object()) is False
+
+
+def test_unsupported_version_raises(monkeypatch):
+    reset_shim()
+    try:
+        with monkeypatch.context() as m:
+            m.setattr("jax.__version__", "0.1.0")
+            with pytest.raises(RuntimeError, match="no shim provider"):
+                get_shim()
+    finally:
+        reset_shim()  # real version re-selected on next use
+    assert get_shim() is not None
