@@ -2,8 +2,8 @@
 
 Prints ONE JSON line.  Primary metric: q6 end-to-end throughput.  Extra
 fields: per-query TPC-H SF1 times (q1/q3/q5/q10, oracle-checked at small
-scale first), device sustained bandwidth (chained kernels — cannot exceed
-the roofline by construction), tudo shuffle-serializer throughput, and
+scale first), device sustained bandwidth (pull-synced chained kernels; null when
+the measurement is invalid), tudo shuffle-serializer throughput, and
 TWO baselines: ``vs_baseline`` against a VECTORIZED numpy/pyarrow CPU
 implementation of q6 (honest external baseline), plus
 ``vs_cpu_oracle_path`` against this engine's row-oriented oracle
@@ -12,6 +12,8 @@ implementation of q6 (honest external baseline), plus
 
 import datetime
 import json
+import os
+import sys
 import time
 
 import numpy as np
@@ -234,12 +236,11 @@ def q6_kernel_bytes(table: pa.Table) -> int:
                 "l_extendedprice"))
 
 
-def sustained_device_gb_per_s(q, in_bytes) -> float:
-    """Chained-kernel sustained bandwidth: each rep's input depends on
-    the previous rep's output, so reps execute serially and the mean
-    includes real execution — it CANNOT exceed the HBM roofline the way
-    a dispatch-only timing can.  ``in_bytes`` must be the bytes the
-    kernel actually reads (see q6_kernel_bytes), not the whole table."""
+def sustained_device_gb_per_s(q, in_bytes):
+    """Pull-synced sustained bandwidth estimate, or None when the
+    measurement is invalid (kernel time under the tunnel's noise floor
+    or above the roofline).  ``in_bytes`` must be the bytes the kernel
+    actually reads (see q6_kernel_bytes), not the whole table."""
     import jax
     import jax.numpy as jnp
     from spark_rapids_tpu.exec.base import fuse_upstream
@@ -249,25 +250,58 @@ def sustained_device_gb_per_s(q, in_bytes) -> float:
                for b in src.execute(p)]
     b0 = batches[0]
 
-    def step(batch, bias):
-        # bias (prev result * 0) forces a data dependency between reps
-        cols = (type(batch.columns[0])(
-            batch.columns[0].dtype, batch.columns[0].data + bias,
-            batch.columns[0].validity),) + tuple(batch.columns[1:])
-        nb = type(batch)(batch.schema, cols, batch.sel, batch.compacted)
-        out = kplan._reduce_batch(nb, pre, pre_key, final=True)
-        return out.columns[0].data[0] * 0.0
+    # the chained bias must be (a) added to a column the kernel READS
+    # (an unread column's add is dead-code-eliminated, silently breaking
+    # the chain), and (b) a runtime-zero XLA cannot constant-fold —
+    # ``out * 0.0`` folds to 0 and DCEs the whole reduction (observed:
+    # a reported 12.6 TB/s, 15x the roofline).
+    price_ix = next(i for i, f in enumerate(b0.schema.fields)
+                    if f.name == "l_extendedprice")
 
+    def step(batch, bias):
+        cols = list(batch.columns)
+        c = cols[price_ix]
+        cols[price_ix] = type(c)(c.dtype, c.data + bias, c.validity)
+        nb = type(batch)(batch.schema, tuple(cols), batch.sel,
+                         batch.compacted)
+        out = kplan._reduce_batch(nb, pre, pre_key, final=True)
+        rev = out.columns[0].data[0]
+        return jnp.where(jnp.isnan(rev), rev, jnp.float64(0.0))
+
+    # Through the axon tunnel ``block_until_ready`` does NOT actually
+    # block (measured: 39 us/rep "completions" for a 470 MB read), so
+    # every rep synchronizes by PULLING the scalar result, and the
+    # tunnel's pull round trip (measured ~110 ms) is subtracted via a
+    # trivial-kernel baseline measured the same way.
     step_j = jax.jit(step)
+    tiny_j = jax.jit(lambda x: x + 1.0)
     bias = jnp.float64(0.0)
-    bias = jax.block_until_ready(step_j(b0, bias))  # compile
+    float(step_j(b0, bias))  # compile + sync
+    float(tiny_j(bias))
     reps = 10
     t0 = time.perf_counter()
     for _ in range(reps):
-        bias = step_j(b0, bias)
-    jax.block_until_ready(bias)
-    dt = (time.perf_counter() - t0) / reps
-    return in_bytes / dt / 1e9
+        bias = jnp.float64(float(tiny_j(bias)))
+    rt = (time.perf_counter() - t0) / reps
+    bias = jnp.float64(0.0)
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        bias = jnp.float64(float(step_j(b0, bias)))
+    per = (time.perf_counter() - t0) / reps
+    kt = per - rt
+    if kt <= 0:
+        return None
+    gbps = in_bytes / kt / 1e9
+    # a v5e chip peaks near ~819 GB/s HBM: exceeding it means the
+    # measurement (not the hardware) is wrong — report the failure
+    # instead of an impossible number
+    roofline = float(os.environ.get("TPUQ_ROOFLINE_GBPS", "850"))
+    if gbps >= roofline:
+        print(f"[bench] sustained measurement invalid: {gbps:.0f} GB/s "
+              f"exceeds the {roofline:.0f} GB/s roofline "
+              f"({kt * 1e6:.0f} us/rep)", file=sys.stderr, flush=True)
+        return None
+    return gbps
 
 
 def tudo_serialize_gb_per_s() -> float:
@@ -289,6 +323,49 @@ def tudo_serialize_gb_per_s() -> float:
     return nbytes / t / 1e9
 
 
+SF1_QUERY_BUDGET_S = int(os.environ.get(
+    "TPUQ_BENCH_QUERY_BUDGET_S", "1500"))
+
+# ONE definition each for the breadth queries and their conf — the
+# subprocess child and the in-process oracle checks must measure the
+# same configuration
+TPCH_BUILDERS = {"q1": q1, "q3": q3, "q5": q5, "q10": q10}
+TPCH_SF1_CONF = {"spark.rapids.sql.enabled": True,
+                 "spark.rapids.tpu.batchRows": 1 << 16}
+
+
+def _sf1_query_main(name: str) -> None:
+    """Child-process entry: warm + time one SF1 query, print the time."""
+    from spark_rapids_tpu.sql.session import TpuSession
+    build = TPCH_BUILDERS[name]
+    sf1 = gen_tpch(1.0)
+    dfq = build(TpuSession(dict(TPCH_SF1_CONF)), sf1)
+    dfq.toArrow()  # warm (compile)
+    t, _ = timed(lambda: dfq.toArrow(), reps=2)
+    print(f"TPCH_SF1_SECONDS={t:.3f}")
+
+
+def _sf1_query_subprocess(name: str, mark):
+    import subprocess
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--sf1-query", name],
+            capture_output=True, text=True,
+            timeout=SF1_QUERY_BUDGET_S)
+    except subprocess.TimeoutExpired:
+        mark(f"{name}: timed out after {SF1_QUERY_BUDGET_S}s "
+             "(compile budget)")
+        return None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("TPCH_SF1_SECONDS="):
+            return round(float(line.split("=", 1)[1]), 3)
+    # crashed child: surface the failure, don't blur it into a timeout
+    mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
+         + (out.stderr or "")[-500:].replace("\n", " | "))
+    return None
+
+
 def main():
     from spark_rapids_tpu.sql.session import TpuSession
 
@@ -308,9 +385,19 @@ def main():
     q.toArrow()  # warmup the full path (incl. first D2H)
     t_tpu, out_tpu = timed(lambda: q.toArrow())
 
-    plan = q._execute_plan()
-    t_pump, _ = timed(lambda: [b for p in range(plan.num_partitions())
-                               for b in plan.execute(p)])
+    # pump the SAME plan's device subtree (D2H transition stripped):
+    # measures the engine's dispatch+internal-sync cost without the
+    # final arrow conversion.  (block_until_ready does not truly block
+    # through the tunnel, so this is a pump time, not kernel time — the
+    # sustained-bandwidth probe above owns that measurement.)
+    plan = q._last_plan
+    dev = plan.children[0] if plan.children else plan
+
+    def pump_device():
+        return [b for p in range(dev.num_partitions())
+                for b in dev.execute(p)]
+
+    t_pump, _ = timed(pump_device)
 
     # honest external baseline: vectorized numpy q6 on the same host
     t_np, r_np = timed(lambda: q6_numpy_vectorized(table), reps=3)
@@ -330,8 +417,6 @@ def main():
     # buckets, and compile time grows superlinearly with bucket size —
     # one small bucket compiles once (~tens of seconds per kernel,
     # persistently cached) and every batch reuses it.
-    import sys
-
     def mark(msg):
         print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
@@ -346,15 +431,16 @@ def main():
         b = build(cpu_s, small).toArrow()
         checked[name] = _rows_equal(a, b, tol=1e-6)
         mark(f"{name} small oracle check: {checked[name]}")
-    sf1 = gen_tpch(1.0)
     times = {}
-    for name, build in builders.items():
-        dfq = build(TpuSession(dict(tpch_conf)), sf1)
-        dfq.toArrow()  # warm (compile)
-        mark(f"{name} sf1 warmed")
-        t, _ = timed(lambda: dfq.toArrow(), reps=2)
-        times[name] = round(t, 3)
-        mark(f"{name} sf1: {t:.2f}s")
+    for name in builders:
+        # each SF1 query runs in a SUBPROCESS with a hard deadline: a
+        # first-ever compile of a heavy kernel set can exceed any
+        # sensible bench budget (and the in-flight remote compile is
+        # not interruptible in-process).  Timed-out queries record null
+        # and the bench still completes; the persistent XLA cache keeps
+        # whatever finished compiling, so later runs get further.
+        times[name] = _sf1_query_subprocess(name, mark)
+        mark(f"{name} sf1: {times[name]}s")
 
     print(json.dumps({
         "metric": "tpch_q6_throughput",
@@ -364,8 +450,14 @@ def main():
         "baseline": "vectorized numpy q6, same host",
         "vs_cpu_oracle_path": round(t_cpu / t_tpu, 2),
         "gb_per_s": round(in_bytes / t_tpu / 1e9, 2),
-        "device_sustained_gb_per_s": round(kernel_gbps, 2),
-        "device_time_frac": round(t_pump / t_tpu, 3),
+        "device_sustained_gb_per_s": (
+            None if kernel_gbps is None else round(kernel_gbps, 2)),
+        # raw components instead of a ratio: both are min-of-3 through
+        # the tunnel, whose per-dispatch jitter (~4.4 ms x ~10
+        # dispatches) is the same order as the 70-110 ms totals — a
+        # ratio of the two reads as broken when it crosses 1.0
+        "e2e_ms": round(t_tpu * 1e3, 1),
+        "plan_pump_ms": round(t_pump * 1e3, 1),
         "input_bytes": in_bytes,
         "tpch_sf1_seconds": times,
         "tpch_small_oracle_ok": checked,
@@ -374,4 +466,8 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    import sys as _sys
+    if len(_sys.argv) == 3 and _sys.argv[1] == "--sf1-query":
+        _sf1_query_main(_sys.argv[2])
+    else:
+        main()

@@ -80,9 +80,6 @@ class _Injector:
             self._exec_at = -1
         else:
             self._transfer_at = -1
-        # forget the config so an identical injection conf RE-ARMS on
-        # its next planning — per-query deterministic injection
-        self._config = None
 
     def _fire(self, where: str, n: int) -> None:
         transient = self._transients_fired < self._transient_budget
@@ -124,7 +121,13 @@ def configure_from_conf(conf) -> None:
     ex = int(conf.get(C.INJECT_EXECUTE_AT))
     tr = int(conf.get(C.INJECT_TRANSFER_AT))
     tc = int(conf.get(C.INJECT_TRANSIENT_COUNT))
-    if (ex >= 0 or tr >= 0) and INJECTOR._config != (ex, tr, tc):
+    if ex < 0 and tr < 0:
+        return
+    # reconfigure on a CHANGED config, or re-arm an identical config
+    # whose fires are fully spent (per-query determinism) — but never
+    # while any chokepoint of the current config is still armed, which
+    # would reset another in-flight query's injection pattern
+    if INJECTOR._config != (ex, tr, tc) or not INJECTOR.armed:
         INJECTOR.configure(ex, tr, tc)
 
 
