@@ -340,6 +340,27 @@ BROADCAST_THRESHOLD = (
     .create_with_default(10 << 20)
 )
 
+JOIN_TARGET_ROWS = (
+    conf("spark.rapids.tpu.join.targetRows")
+    .doc("Row-capacity cap for one in-core sort-merge join. When either "
+         "gathered side exceeds this many rows the join proactively "
+         "hash-sub-partitions both sides ([REF: GpuSubPartitionHashJoin] "
+         "— but size-driven, not OOM-reactive), recursing with fresh "
+         "hash seeds on still-oversized sub-partitions, so sort/search "
+         "kernels stay at or below the cap (exception: a single hot key "
+         "cannot be spread by any key hash; after bounded recursion "
+         "such a pair joins in-core, and the build side of a broadcast "
+         "join is bounded by the broadcast byte threshold rather than "
+         "this row cap — its streamed side honors the cap via bounded "
+         "groups). XLA compile cost grows "
+         "superlinearly with bucket size, so this bounds cold-compile "
+         "time as well as memory. Join outputs are also re-batched to "
+         "spark.rapids.tpu.batchRows chunks so downstream kernels never "
+         "inherit an oversized bucket.")
+    .integer()
+    .create_with_default(1 << 18)
+)
+
 UDF_COMPILER_ENABLED = (
     conf("spark.rapids.sql.udfCompiler.enabled")
     .doc("Compile simple python UDFs (arithmetic, comparisons, "

@@ -432,7 +432,9 @@ class TpuSortMergeJoinExec(TpuExec):
                  condition: Optional[Expression], schema: T.StructType,
                  left: TpuExec, right: TpuExec,
                  partitioned: bool = False, using: bool = True,
-                 broadcast: Optional[str] = None):
+                 broadcast: Optional[str] = None,
+                 sub_partition_rows: int = 1 << 18,
+                 out_batch_rows: Optional[int] = None):
         super().__init__(schema, left, right)
         self.join_type = join_type
         self.left_keys = list(left_keys)
@@ -445,6 +447,12 @@ class TpuSortMergeJoinExec(TpuExec):
         # "right"/"left": that side is a TpuBroadcastExchangeExec; the
         # OTHER side streams partition-by-partition
         self.broadcast = broadcast
+        # proactive sub-partition cap (spark.rapids.tpu.join.targetRows):
+        # no sort/search kernel compiles above ~this row capacity
+        self.sub_partition_rows = sub_partition_rows
+        # join outputs re-batch to this bucket (spark.rapids.tpu.batchRows)
+        # so downstream kernels never compile at the expanded bucket size
+        self.out_batch_rows = out_batch_rows
 
     def node_string(self):
         part = " partitioned" if self.partitioned else ""
@@ -481,6 +489,33 @@ class TpuSortMergeJoinExec(TpuExec):
         mgr = get_manager()
         total = (sum(b.nbytes() for b in l_list)
                  + sum(b.nbytes() for b in r_list))
+        # proactive bound [REF: GpuSubPartitionHashJoin — there the
+        # trigger is build-size driven, not OOM-reactive]: if either
+        # side's gathered capacity exceeds the row cap, sub-partition
+        # up front — an in-core attempt would compile sort/search
+        # kernels at a bucket whose cold compile alone can exceed any
+        # query budget (capacities are static shape info: no host sync)
+        if not nokey and self.sub_partition_rows and not self.broadcast:
+            side_cap = max(sum(b.capacity for b in l_list) or 1,
+                           sum(b.capacity for b in r_list) or 1)
+            if side_cap > self.sub_partition_rows:
+                self.metric("subPartitionJoins").add(1)
+                yield from self._sub_partition_join(
+                    l_list, r_list, jt, total, mgr)
+                return
+        # broadcast joins: the broadcast side is threshold-capped and
+        # gathered once (re-splitting it per stream partition would
+        # repeat identical work P times), but the STREAMED side still
+        # honors the row cap — it needs no hash split, since the other
+        # side is fully present: process it in bounded groups, each
+        # group's rows decided independently (inner/left/semi/anti)
+        if (not nokey and self.sub_partition_rows and self.broadcast
+                and (sum(b.capacity
+                         for b in (l_list if self.broadcast == "right"
+                                   else r_list))
+                     > self.sub_partition_rows)):
+            yield from self._broadcast_streamed(l_list, r_list, jt, mgr)
+            return
         try:
             # in-core: both sides + the expanded output live together
             with mgr.transient(2 * total):
@@ -488,7 +523,9 @@ class TpuSortMergeJoinExec(TpuExec):
                 rb = _concat_or_empty(self.children[1].schema, r_list)
                 with self.timer():
                     if nokey:
-                        yield self._apply_condition(self._cross(lb, rb))
+                        cb, ctotal = self._cross(lb, rb)
+                        cb = self._apply_condition(cb)
+                        yield from self._rebatch(cb, ctotal)
                     else:
                         yield from self._merge_join(lb, rb, jt)
                 return
@@ -499,8 +536,48 @@ class TpuSortMergeJoinExec(TpuExec):
         yield from self._sub_partition_join(l_list, r_list, jt, total,
                                             mgr)
 
-    def _sub_partition_join(self, l_list, r_list, jt, total, mgr
+    def _broadcast_streamed(self, l_list, r_list, jt, mgr
                             ) -> Iterator[DeviceBatch]:
+        """Row-cap the streamed side of a broadcast join by joining it
+        in bounded groups against the (small, fully-present) broadcast
+        batch.  Correct for the join types the planner broadcasts
+        (inner/left/left_semi/left_anti with broadcast=right; inner with
+        broadcast=left): each streamed row's output depends only on the
+        broadcast side, so groups are independent."""
+        from spark_rapids_tpu.parallel.shuffle import slice_batch
+        cap = self.sub_partition_rows
+        stream = l_list if self.broadcast == "right" else r_list
+        groups: List[List[DeviceBatch]] = [[]]
+        acc = 0
+        for b in stream:
+            # a single gathered batch can itself exceed the cap (the
+            # default batchRows bucket is larger than targetRows):
+            # row-slice it — batches here are compacted, so each pow-2
+            # chunk keeps a contiguous live prefix
+            chunks = ([b] if b.capacity <= cap else
+                      [slice_batch(b, lo, cap)
+                       for lo in range(0, b.capacity, cap)])
+            for c in chunks:
+                if groups[-1] and acc + c.capacity > cap:
+                    groups.append([])
+                    acc = 0
+                groups[-1].append(c)
+                acc += c.capacity
+        bc = _concat_or_empty(
+            self.children[1 if self.broadcast == "right" else 0].schema,
+            r_list if self.broadcast == "right" else l_list)
+        for g in groups:
+            gb = _concat_or_empty(
+                self.children[0 if self.broadcast == "right" else 1]
+                .schema, g)
+            lb, rb = ((gb, bc) if self.broadcast == "right"
+                      else (bc, gb))
+            with mgr.transient(2 * (gb.nbytes() + bc.nbytes())):
+                with self.timer():
+                    yield from self._merge_join(lb, rb, jt)
+
+    def _sub_partition_join(self, l_list, r_list, jt, total, mgr,
+                            depth: int = 0) -> Iterator[DeviceBatch]:
         """Oversized inputs: recursive hash split [REF:
         GpuSubPartitionHashJoin].  Both sides re-hash on the join keys
         with a DIFFERENT murmur3 seed (rows of one exchange partition
@@ -512,12 +589,21 @@ class TpuSortMergeJoinExec(TpuExec):
             make_pid_fn, split_to_spillables)
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
-        k = max(2, min(64, int(np.ceil(total / max(mgr.budget // 4, 1)))))
+        # k satisfies BOTH ceilings: memory (pair fits the arbiter
+        # budget) and rows (no kernel compiles above the row cap)
+        k_mem = int(np.ceil(total / max(mgr.budget // 4, 1)))
+        side_cap = max(sum(b.capacity for b in l_list) or 1,
+                       sum(b.capacity for b in r_list) or 1)
+        k_rows = (int(np.ceil(side_cap / self.sub_partition_rows))
+                  if self.sub_partition_rows else 1)
+        k = max(2, min(256, max(k_mem, k_rows)))
         canon = tuple(
             type(le.dtype) is not type(re.dtype)
             and isinstance(le.dtype, _INT_FAMILY)
             for le, re in zip(self.left_keys, self.right_keys))
-        SUB_SEED = 0x53504C54  # != Spark shuffle seed 42
+        # != Spark shuffle seed 42; varies per recursion level so a
+        # skewed sub-partition's keys re-spread on the re-split
+        SUB_SEED = 0x53504C54 + depth
 
         def split(batches, keys, schema):
             pid_fn = cached_kernel(
@@ -546,6 +632,26 @@ class TpuSortMergeJoinExec(TpuExec):
                 continue
             pair_bytes = (sum(s.nbytes for s in l_slices[i])
                           + sum(s.nbytes for s in r_slices[i]))
+            # key skew can defeat one split level (a low-cardinality key
+            # set hashing into one bucket): re-split the oversized pair
+            # with the next seed.  Depth-capped — a single hot KEY can
+            # never spread by key hash; past the cap the pair joins
+            # in-core (bounded number of oversized compiles, documented
+            # limitation) rather than recursing forever.  Capacity is
+            # read off the spillable (no restore); the registrations
+            # stay open until the recursion/join is done so the arbiter
+            # keeps seeing (and can spill) the pair's bytes.
+            if (self.sub_partition_rows and depth < 3
+                    and max(sum(s.capacity for s in l_slices[i]) or 1,
+                            sum(s.capacity for s in r_slices[i]) or 1)
+                    > self.sub_partition_rows):
+                yield from self._sub_partition_join(
+                    [s.get() for s in l_slices[i]],
+                    [s.get() for s in r_slices[i]],
+                    jt, pair_bytes, mgr, depth + 1)
+                for s in l_slices[i] + r_slices[i]:
+                    s.close()
+                continue
             # clamped: one pair can exceed a tiny budget after pow-2
             # padding; full-pool pressure is the reservation's ceiling
             with mgr.transient(min(2 * max(pair_bytes, 1), mgr.budget)):
@@ -629,7 +735,8 @@ class TpuSortMergeJoinExec(TpuExec):
         if jt in ("left_semi", "left_anti"):
             keep = (m > 0) if jt == "left_semi" else (m == 0)
             out = lb.with_sel(lb.sel & keep)
-            yield self._project_semi(out)
+            yield from self._rebatch(self._project_semi(out),
+                                     out.capacity)
             return
 
         counts = m
@@ -680,14 +787,58 @@ class TpuSortMergeJoinExec(TpuExec):
                                 out_live, jt)
         if jt == "inner":
             out = self._apply_condition(out)
-        yield out
+        yield from self._rebatch(out, total)
+
+    def _rebatch(self, out: DeviceBatch, total: int
+                 ) -> Iterator[DeviceBatch]:
+        """Slice an expanded join output into batchRows-bucket chunks.
+
+        Downstream kernels (aggregates, windows, sorts) compile per
+        (op, schema, bucket): handing them one giant expansion bucket
+        would re-pay the superlinear compile the proactive sub-partition
+        just avoided.  One jitted dynamic-slice per chunk (single
+        dispatch — ``lo`` is traced, so every chunk reuses one
+        executable); all-dead tail chunks are skipped via the host-known
+        ``total``."""
+        cap = self.out_batch_rows
+        if not cap or out.capacity <= cap:
+            yield out
+            return
+        # buckets are pow-2: a pow-2 chunk always divides the capacity,
+        # so no dynamic_slice start ever clamps (a clamped final slice
+        # would silently duplicate rows)
+        cap = 1 << (int(cap).bit_length() - 1)
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+
+        def build():
+            def run(b, lo):
+                def cut(x):
+                    return jax.lax.dynamic_slice_in_dim(x, lo, cap, 0)
+                cols = tuple(
+                    DeviceColumn(
+                        c.dtype, cut(c.data),
+                        None if c.validity is None else cut(c.validity),
+                        None if c.lengths is None else cut(c.lengths),
+                        None if c.evalid is None else cut(c.evalid))
+                    for c in b.columns)
+                return DeviceBatch(b.schema, cols, cut(b.sel))
+            return run
+
+        fn = cached_kernel(
+            ("join_rebatch", fingerprint(out.schema), out.capacity, cap),
+            build)
+        for i in range(max(1, -(-int(total) // cap))):
+            yield fn(out, i * cap)
 
     def _execute_swapped(self, partition: int = 0):
         """right outer = left outer with sides swapped, columns remapped."""
         inner = TpuSortMergeJoinExec(
             "left", self.right_keys, self.left_keys, self.condition,
             self._swapped_schema(), self.children[1], self.children[0],
-            self.partitioned, using=self.using)
+            self.partitioned, using=self.using,
+            sub_partition_rows=self.sub_partition_rows,
+            out_batch_rows=self.out_batch_rows)
         n_lc = len(self.children[0].schema)
         n_rc = len(self.children[1].schema)
         if not self.using:
@@ -725,7 +876,7 @@ class TpuSortMergeJoinExec(TpuExec):
               if i not in lkey]
         return T.StructType(tuple(fields + rf + lf))
 
-    def _cross(self, lb, rb) -> DeviceBatch:
+    def _cross(self, lb, rb) -> Tuple[DeviceBatch, int]:
         nl = int(jnp.sum(lb.sel.astype(jnp.int32)))
         nr = int(jnp.sum(rb.sel.astype(jnp.int32)))
         total = nl * nr
@@ -734,8 +885,8 @@ class TpuSortMergeJoinExec(TpuExec):
         l_idx = (j // max(nr, 1)).astype(jnp.int32)
         r_idx = (j % max(nr, 1)).astype(jnp.int32)
         out_live = j < total
-        return self._materialize(lb, rb, l_idx, r_idx, out_live, out_live,
-                                 out_live, "cross")
+        return self._materialize(lb, rb, l_idx, r_idx, out_live,
+                                 out_live, out_live, "cross"), total
 
     def _project_semi(self, lb: DeviceBatch) -> DeviceBatch:
         """semi/anti output: [keys, left-rest] for USING joins,
@@ -825,6 +976,8 @@ def _convert_join(cpu, ch, conf):
     from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.distributed import ici_active
     jt = cpu.join_type
+    bounds = dict(sub_partition_rows=conf.get(C.JOIN_TARGET_ROWS),
+                  out_batch_rows=conf.batch_rows)
     # broadcast the small side when stats say it fits [REF:
     # GpuBroadcastHashJoinExec; Spark's JoinSelection] — no exchange on
     # either side, build side gathered once and reused per partition
@@ -838,12 +991,12 @@ def _convert_join(cpu, ch, conf):
             return TpuSortMergeJoinExec(
                 jt, cpu.left_keys, cpu.right_keys, cpu.condition,
                 cpu.schema, ch[0], TpuBroadcastExchangeExec(ch[1]),
-                using=cpu.using, broadcast="right")
+                using=cpu.using, broadcast="right", **bounds)
         if lsize is not None and lsize <= thresh and jt == "inner":
             return TpuSortMergeJoinExec(
                 jt, cpu.left_keys, cpu.right_keys, cpu.condition,
                 cpu.schema, TpuBroadcastExchangeExec(ch[0]), ch[1],
-                using=cpu.using, broadcast="left")
+                using=cpu.using, broadcast="left", **bounds)
     if (ici_active(conf) and jt != "cross" and cpu.left_keys):
         # distributed: co-partition both sides through the ICI exchange
         # on the key hash, then join partition-by-partition (the
@@ -863,7 +1016,8 @@ def _convert_join(cpu, ch, conf):
         return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
                                     cpu.right_keys, cpu.condition,
                                     cpu.schema, lex, rex,
-                                    partitioned=True, using=cpu.using)
+                                    partitioned=True, using=cpu.using,
+                                    **bounds)
     return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
                                 cpu.right_keys, cpu.condition, cpu.schema,
-                                ch[0], ch[1], using=cpu.using)
+                                ch[0], ch[1], using=cpu.using, **bounds)

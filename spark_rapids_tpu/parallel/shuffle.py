@@ -242,6 +242,7 @@ def slice_batch(batch: DeviceBatch, lo: int, cap: int) -> DeviceBatch:
     cols = tuple(
         DeviceColumn(c.dtype, cut(c.data),
                      None if c.validity is None else cut(c.validity),
-                     None if c.lengths is None else cut(c.lengths))
+                     None if c.lengths is None else cut(c.lengths),
+                     None if c.evalid is None else cut(c.evalid))
         for c in batch.columns)
     return DeviceBatch(batch.schema, cols, cut(batch.sel))

@@ -68,6 +68,9 @@ class SpillableBatch:
         self.schema = batch.schema
         self.compacted = batch.compacted
         self.nbytes = batch.nbytes()
+        # static row capacity, readable without restoring a spilled
+        # batch (the join's skew re-check must not force an unspill)
+        self.capacity = batch.capacity
         if reserve:
             manager.reserve(self.nbytes)
         manager._register(self)

@@ -190,3 +190,53 @@ def test_using_join_unchanged():
         lambda s: s.createDataFrame(l).join(s.createDataFrame(r2), "k",
                                             "inner"),
         ignore_order=True)
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi",
+                                 "left_anti"])
+def test_broadcast_streamed_side_row_capped(how):
+    """The streamed side of a broadcast join honors join.targetRows:
+    it joins in bounded groups against the broadcast batch instead of
+    compiling kernels at the full streamed-side bucket."""
+    rng = np.random.default_rng(41)
+    n = 40_000
+    l = pa.table({"k": pa.array(rng.integers(0, 300, n)),
+                  "v": pa.array(rng.uniform(-5, 5, n))})
+    r = pa.table({"k": pa.array(np.arange(300, dtype=np.int64)),
+                  "w": pa.array(rng.integers(0, 9, 300))})
+    conf = {"spark.rapids.tpu.join.targetRows": 8192,
+            "spark.rapids.tpu.batchRows": 4096}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            how),
+        conf=conf, ignore_order=True, approx_float=True)
+
+
+def test_broadcast_streamed_output_capacities_capped():
+    from spark_rapids_tpu.utils.harness import tpu_session as _ts
+    rng = np.random.default_rng(43)
+    n = 40_000
+    l = pa.table({"k": pa.array(rng.integers(0, 300, n)),
+                  "v": pa.array(rng.uniform(-5, 5, n))})
+    r = pa.table({"k": pa.array(np.arange(300, dtype=np.int64)),
+                  "w": pa.array(rng.integers(0, 9, 300))})
+    s = _ts({"spark.rapids.tpu.join.targetRows": 8192,
+             "spark.rapids.tpu.batchRows": 4096})
+    df = s.createDataFrame(l).join(s.createDataFrame(r), "k", "inner")
+    plan = df._execute_plan()
+
+    def find(node, name):
+        if type(node).__name__ == name:
+            return node
+        for c in node.children:
+            got = find(c, name)
+            if got is not None:
+                return got
+        return None
+
+    j = find(plan, "TpuSortMergeJoinExec")
+    assert j.broadcast == "right"
+    caps = [b.capacity for p in range(j.num_partitions())
+            for b in j.execute(p)]
+    assert len(caps) > 1
+    assert max(caps) <= 8192, caps

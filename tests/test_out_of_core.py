@@ -128,3 +128,84 @@ def test_sub_partitioned_right_join():
         lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
                                             "right"),
         conf=conf, ignore_order=True, approx_float=True)
+
+
+# -- proactive (size-driven) sub-partitioning + output re-batching ----------
+# [REF: GpuSubPartitionHashJoin — the reference's trigger is build-size
+# driven; VERDICT r3 #1: never compile a sort/join kernel above the cap]
+
+@pytest.mark.parametrize("how", ["inner", "left", "full", "right"])
+def test_proactive_sub_partition_join_matches_oracle(how):
+    l, r = _join_tables(n=30_000, m=24_000, seed=21)
+    conf = {"spark.sql.autoBroadcastJoinThreshold": 0,
+            "spark.rapids.tpu.join.targetRows": 4096,
+            "spark.rapids.tpu.batchRows": 8192}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            how),
+        conf=conf, ignore_order=True, approx_float=True)
+
+
+def test_proactive_trigger_is_row_driven_not_oom():
+    """With a roomy memory pool, the row cap alone must route the join
+    through sub-partitioning (q10's 75-min compile had no OOM)."""
+    l, r = _join_tables(n=50_000, m=40_000, seed=22)
+    s = tpu_session({"spark.sql.autoBroadcastJoinThreshold": 0,
+                     "spark.rapids.tpu.join.targetRows": 8192,
+                     "spark.rapids.tpu.batchRows": 8192})
+    df = s.createDataFrame(l).join(s.createDataFrame(r), "k", "inner")
+    out = df.toArrow()
+    assert out.num_rows > 0
+    j = _find(df._last_plan, "TpuSortMergeJoinExec")
+    assert j.metric("subPartitionJoins").value == 1
+    mgr = M.get_manager()
+    assert mgr.metrics["spillToHostBytes"] == 0, (
+        "row-driven trigger must not require memory pressure")
+
+
+def test_join_output_rebatched_to_batch_rows():
+    """A high-multiplicity join's expanded output arrives as
+    batchRows-bucket chunks, not one giant bucket."""
+    rng = np.random.default_rng(23)
+    n = 20_000
+    left = pa.table({"k": pa.array(rng.integers(0, 50, n)),
+                     "v": pa.array(rng.uniform(-1, 1, n))})
+    right = pa.table({"k": pa.array(np.arange(50).repeat(8)),
+                      "w": pa.array(np.arange(400, dtype=np.int64))})
+    s = tpu_session({"spark.sql.autoBroadcastJoinThreshold": 0,
+                     "spark.rapids.tpu.batchRows": 16384})
+    ldf = s.createDataFrame(left)
+    rdf = s.createDataFrame(right)
+    df = ldf.join(rdf, "k", "inner")
+    plan = df._execute_plan()
+    j = _find(plan, "TpuSortMergeJoinExec")
+    caps = [b.capacity for p in range(j.num_partitions())
+            for b in j.execute(p)]
+    # ~160k output rows: must arrive as 16k-capacity chunks
+    assert len(caps) > 1
+    assert max(caps) <= 16384, caps
+    out = df.toArrow()
+    cpu = tpu_session({"spark.rapids.sql.enabled": False})
+    exp = (cpu.createDataFrame(left).join(cpu.createDataFrame(right),
+                                          "k", "inner").toArrow())
+    assert out.num_rows == exp.num_rows
+
+
+def test_skewed_sub_partition_recurses_and_matches():
+    """Low-cardinality keys defeat one split level; the re-split with a
+    fresh seed (and, for a single hot key, the bounded-depth in-core
+    fallback) must stay correct."""
+    rng = np.random.default_rng(31)
+    n = 20_000
+    for nkeys in (1, 3):  # 1 = unsplittable hot key; 3 = skew-spreads
+        left = pa.table({"k": pa.array(rng.integers(0, nkeys, n)),
+                         "v": pa.array(rng.uniform(-1, 1, n))})
+        right = pa.table({"k": pa.array(np.arange(nkeys, dtype=np.int64)),
+                          "w": pa.array(np.arange(nkeys, dtype=np.int64))})
+        conf = {"spark.sql.autoBroadcastJoinThreshold": 0,
+                "spark.rapids.tpu.join.targetRows": 4096,
+                "spark.rapids.tpu.batchRows": 8192}
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s: s.createDataFrame(left).join(
+                s.createDataFrame(right), "k", "inner"),
+            conf=conf, ignore_order=True, approx_float=True)
