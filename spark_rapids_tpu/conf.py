@@ -372,6 +372,64 @@ UDF_COMPILER_ENABLED = (
     .create_with_default(False)
 )
 
+EXECUTOR_ID = (
+    conf("spark.rapids.executor.id")
+    .doc("This process's executor index in a multi-executor run "
+         "(0-based). With executor.count > 1 the session joins the "
+         "global device mesh via jax.distributed and scans serve only "
+         "this executor's slice of source partitions.")
+    .category("distributed")
+    .startup_only()
+    .integer()
+    .create_with_default(0)
+)
+
+EXECUTOR_COUNT = (
+    conf("spark.rapids.executor.count")
+    .doc("Number of executor processes in the slice. >1 activates "
+         "multi-executor mode: requires shuffle.mode=ICI, the "
+         "jax.distributed coordinator address and the shuffle "
+         "rendezvous address.")
+    .category("distributed")
+    .startup_only()
+    .integer()
+    .create_with_default(1)
+)
+
+COORDINATOR_ADDRESS = (
+    conf("spark.rapids.executor.coordinator.address")
+    .doc("host:port of the jax.distributed coordinator (process 0 "
+         "binds it). Required when executor.count > 1.")
+    .category("distributed")
+    .startup_only()
+    .string()
+    .create_with_default("")
+)
+
+RENDEZVOUS_ADDRESS = (
+    conf("spark.rapids.shuffle.rendezvous.address")
+    .doc("host:port of the shuffle RendezvousCoordinator (driver-side "
+         "barrier service). ICI exchanges use it for cross-process "
+         "shape agreement and collective entry; required when "
+         "executor.count > 1. [REF: RapidsShuffleInternalManagerBase "
+         "— the MapOutputTracker-coordination analog]")
+    .category("distributed")
+    .startup_only()
+    .string()
+    .create_with_default("")
+)
+
+RENDEZVOUS_TIMEOUT = (
+    conf("spark.rapids.shuffle.rendezvous.timeoutSec")
+    .doc("Deadline for every rendezvous barrier. On expiry the "
+         "coordinator fails ALL waiters of the stage (fail-together: "
+         "nobody enters a collective that cannot complete — a hung "
+         "ICI collective would wedge the whole slice).")
+    .category("distributed")
+    .double()
+    .create_with_default(120.0)
+)
+
 ADAPTIVE_ENABLED = (
     conf("spark.sql.adaptive.enabled")
     .doc("Adaptive query execution: shuffle-read coalescing of small "

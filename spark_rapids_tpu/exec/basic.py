@@ -15,7 +15,7 @@ TPU-first notes:
 
 from __future__ import annotations
 
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -120,17 +120,26 @@ class TpuScanExec(TpuExec):
 
     def __init__(self, table: pa.Table, schema: T.StructType,
                  num_partitions: int = 1, batch_rows: int = 1 << 20,
-                 min_bucket: int = 1024):
+                 min_bucket: int = 1024,
+                 executor: Tuple[int, int] = (0, 1)):
         super().__init__(schema)
         self.table = table
         self._num_partitions = num_partitions
         self.batch_rows = batch_rows
         self.min_bucket = min_bucket
+        # (executor_id, executor_count): in multi-executor mode each
+        # process serves only source partitions p ≡ id (mod count) — the
+        # analog of the Spark scheduler assigning scan tasks to
+        # executors; the union over processes is exactly the table
+        self.executor = tuple(executor)
 
     def num_partitions(self) -> int:
         return self._num_partitions
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        eid, ecount = self.executor
+        if ecount > 1 and partition % ecount != eid:
+            return
         from spark_rapids_tpu.runtime.memory import (
             RetryOOM, SpillableBatch, get_manager)
         key = (self._num_partitions, self.batch_rows, self.min_bucket,

@@ -33,7 +33,24 @@ from spark_rapids_tpu.parallel import shuffle as SH
 from spark_rapids_tpu.parallel.mesh import make_mesh
 
 
-def _accumulate_shards(child: TpuExec, devices, d: int):
+def owned_partitions(plan) -> List[int]:
+    """Partitions an executor process serves of ``plan``: descend the
+    partition-preserving spine to the nearest ICI exchange and take its
+    local partitions; plans without an exchange serve every partition
+    (executor-sliced scans make non-owned ones empty)."""
+    node = plan
+    while True:
+        if isinstance(node, TpuIciShuffleExchangeExec):
+            return node.local_partitions()
+        if (node.children and node.num_partitions()
+                == node.children[0].num_partitions()):
+            node = node.children[0]
+            continue
+        return list(range(plan.num_partitions()))
+
+
+def _accumulate_shards(child: TpuExec, devices, d: int,
+                       partitions=None):
     """Stream child partitions onto mesh devices (round-robin) WITHOUT
     ever materializing the whole table on one device.
 
@@ -51,8 +68,13 @@ def _accumulate_shards(child: TpuExec, devices, d: int):
     rows = [0] * d
     widths = [0] * nstr
     has_val = [False] * nstr
-    for p in range(child.num_partitions()):
-        dev = p % d
+    if partitions is None:
+        partitions = range(child.num_partitions())
+    # round-robin by ENUMERATION index: owned partition ids can share a
+    # factor with d (executor slicing hands each process p ≡ id mod
+    # count), and `p % d` would then pile every batch on one device
+    for i, p in enumerate(partitions):
+        dev = i % d
         for b in child.execute(p):
             cb = compact(b)
             n = cb.num_rows_host()
@@ -73,22 +95,27 @@ def _accumulate_shards(child: TpuExec, devices, d: int):
 
 def _batch_from_shards(mesh, schema: T.StructType,
                        shards: List[DeviceBatch],
-                       local_b: int) -> DeviceBatch:
+                       local_b: int,
+                       global_devices: int = 0) -> DeviceBatch:
     """Per-device shard batches (identical structure, committed to their
     mesh devices) → ONE globally-sharded DeviceBatch, zero data movement
-    (``jax.make_array_from_single_device_arrays``)."""
+    (``jax.make_array_from_single_device_arrays``).
+
+    In multi-process mode ``shards`` holds only this process's LOCAL
+    shards (jax matches them to the global sharding by their committed
+    devices); ``global_devices`` then sizes the global shape."""
     import jax
     axis = mesh.axis_names[0]
     sharding = jax.sharding.NamedSharding(
         mesh, jax.sharding.PartitionSpec(axis))
-    d = len(shards)
+    d = global_devices or len(shards)
     flat = [jax.tree.flatten(s) for s in shards]
     treedef = flat[0][1]
     for _, td in flat[1:]:
         assert td == treedef, "shards must have identical structure"
     out_leaves = []
     for i in range(len(flat[0][0])):
-        arrs = [flat[dev][0][i] for dev in range(d)]
+        arrs = [flat[k][0][i] for k in range(len(shards))]
         shape = (d * local_b,) + arrs[0].shape[1:]
         out_leaves.append(jax.make_array_from_single_device_arrays(
             shape, sharding, arrs))
@@ -100,14 +127,16 @@ def _local_shard(batch: DeviceBatch, p: int) -> DeviceBatch:
     single-device batch (stays resident on device p)."""
     import jax
     leaves, treedef = jax.tree.flatten(batch)
-    cap = leaves[0].shape[0]
-    d = len(leaves[0].addressable_shards)
-    per = cap // d
+    per = int(leaves[0].addressable_shards[0].data.shape[0])
     lo = p * per
     out = []
     for leaf in leaves:
-        shard = next(s for s in leaf.addressable_shards
-                     if (s.index[0].start or 0) == lo)
+        shard = next((s for s in leaf.addressable_shards
+                      if (s.index[0].start or 0) == lo), None)
+        if shard is None:
+            raise RuntimeError(
+                f"partition {p} is not local to this process "
+                "(multi-executor pump must only pull owned partitions)")
         out.append(shard.data)
     return jax.tree.unflatten(treedef, out)
 
@@ -131,6 +160,21 @@ class TpuIciShuffleExchangeExec(TpuExec):
         self._empty = False
         import threading
         self._mat_lock = threading.Lock()
+        # multi-executor mode: rendezvous-coordinated collective entry.
+        # Stage ids are assigned at plan-conversion time — every process
+        # plans the same query with the same deterministic planner, so
+        # the Nth exchange here is the Nth exchange everywhere (the
+        # analog of the driver-assigned shuffle id).
+        from spark_rapids_tpu.parallel.executor import get_executor
+        self._ctx = get_executor()
+        self._stage = (self._ctx.next_stage_id()
+                       if self._ctx is not None else None)
+
+    def local_partitions(self) -> List[int]:
+        """Partition ids this process can serve (all, single-process)."""
+        if self._ctx is None:
+            return list(range(self.nparts))
+        return self._ctx.local_partition_ids(self.mesh)
 
     @property
     def nparts(self) -> int:
@@ -150,6 +194,8 @@ class TpuIciShuffleExchangeExec(TpuExec):
     def _materialize_locked(self) -> Optional[DeviceBatch]:
         if self._result is not None or self._empty:
             return self._result
+        if self._ctx is not None:
+            return self._materialize_multiproc()
         from spark_rapids_tpu.exec.basic import concat_device_batches
         from spark_rapids_tpu.runtime.memory import get_manager
         d = self.nparts
@@ -208,6 +254,125 @@ class TpuIciShuffleExchangeExec(TpuExec):
             # per-device collective working set: the [d*cap] layout and
             # the [d*cap] received block
             with mgr.transient(2 * d * cap * row_bytes):
+                with self.timer("collectiveTime"):
+                    shuffle_fn = cached_kernel(
+                        ("ici_shuffle", cap) + base_key,
+                        lambda: SH.build_shuffle_program(
+                            self.mesh, self.keys, d, cap,
+                            self.canon_int64))
+                    self._result = shuffle_fn(sharded)
+        return self._result
+
+    def _materialize_multiproc(self) -> Optional[DeviceBatch]:
+        """Rendezvous-coordinated collective shuffle across executor
+        processes [REF: RapidsShuffleInternalManagerBase; SURVEY §5.8].
+
+        1. accumulate this process's upstream slice onto LOCAL devices;
+        2. rendezvous ``:shape`` allgather — every process must build
+           byte-identical XLA programs, so shard capacity, string widths
+           and validity presence are agreed globally;
+        3. assemble the globally-sharded batch from local shards;
+        4. per-shard partition counts (plain local jit), rendezvous
+           ``:counts`` allgather → the global all_to_all cap;
+        5. ``:enter`` barrier, then every process calls the SAME jitted
+           collective program.  Any rendezvous deadline failure raises
+           in EVERY process (fail-together) — nobody blocks alone inside
+           a collective that cannot complete.
+        """
+        import jax
+        from spark_rapids_tpu.exec.basic import concat_device_batches
+        from spark_rapids_tpu.columnar.column import empty_batch
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        from spark_rapids_tpu.runtime.memory import get_manager
+        ctx = self._ctx
+        timeout = ctx.timeout
+        d = self.nparts
+        all_devices = list(self.mesh.devices.flatten())
+        local_ids = ctx.local_partition_ids(self.mesh)
+        local_devices = [all_devices[i] for i in local_ids]
+        schema = self.children[0].schema
+        with self.timer("partitionTime"):
+            # only the child partitions THIS process owns: a downstream
+            # exchange's partitions live on local devices only, and
+            # executor-sliced scans make the rest empty anyway
+            parts, rows, widths, has_val = _accumulate_shards(
+                self.children[0], local_devices, len(local_devices),
+                partitions=owned_partitions(self.children[0]))
+        base_key = (self.nparts, self.canon_int64,
+                    fingerprint(self.keys), fingerprint(schema))
+        # the payload carries the stage's structural fingerprint: stage
+        # ids are plan-conversion-ordered, so if executors ever run
+        # DIFFERENT queries (or the same queries in different order)
+        # the mismatch must fail loudly, not cross-match allgathers
+        fp = repr(base_key)
+        payload = {"rows": max(rows) if rows else 0,
+                   "total": sum(rows), "widths": widths,
+                   "has_val": has_val, "fp": fp}
+        replies = ctx.client.allgather(self._stage + ":shape", payload,
+                                       timeout)
+        if any(r["fp"] != fp for r in replies):
+            raise RuntimeError(
+                f"rendezvous stage {self._stage} mismatch across "
+                "executors (different queries or different order) — "
+                "every executor process must run the same queries in "
+                "the same order")
+        if sum(r["total"] for r in replies) == 0:
+            self._empty = True
+            return None
+        local_b = round_up_pow2(
+            max(max(r["rows"] for r in replies), 1), self.min_bucket)
+        widths = [max(ws) for ws in
+                  zip(*[r["widths"] for r in replies])] or list(widths)
+        has_val = [any(hv) for hv in
+                   zip(*[r["has_val"] for r in replies])] or list(has_val)
+        from spark_rapids_tpu.plan.overrides import _estimated_row_bytes
+        row_bytes = _estimated_row_bytes(
+            schema, str_width=max(widths, default=0))
+        mgr = get_manager()
+        shards: List[DeviceBatch] = []
+        # per-device working set, same accounting as the single-process
+        # path: this process hosts len(local_devices) shards of local_b
+        # rows each while building, then the [d*cap] layout + received
+        # block per local device during the collective
+        with mgr.transient(
+                2 * len(local_devices) * local_b * row_bytes):
+            with self.timer("partitionTime"):
+                for li, dev in enumerate(local_devices):
+                    batch_list = [b for b, _ in parts[li]]
+                    counts = [n for _, n in parts[li]]
+                    if not batch_list:
+                        batch_list = [jax.device_put(
+                            empty_batch(schema, 8), dev)]
+                        counts = [0]
+                    shard = concat_device_batches(
+                        schema, batch_list, counts=counts,
+                        bucket=local_b, min_width=widths,
+                        force_validity=has_val)
+                    shards.append(jax.device_put(shard, dev))
+                sharded = _batch_from_shards(self.mesh, schema, shards,
+                                             local_b, global_devices=d)
+            del parts, shards
+            with self.timer("partitionTime"):
+                # per-shard counts via a plain LOCAL jit: a
+                # cross-process count program's output shards would not
+                # be addressable
+                pid_fn = cached_kernel(
+                    ("ici_mp_pid",) + base_key,
+                    lambda: SH.make_pid_fn(self.keys, d,
+                                           self.canon_int64))
+                local_max = 0
+                for li in range(len(local_devices)):
+                    shard_b = _local_shard(sharded, local_ids[li])
+                    cnt = SH.local_partition_counts(
+                        shard_b, pid_fn(shard_b), d)
+                    local_max = max(local_max,
+                                    int(np.asarray(cnt).max()))
+            counts = ctx.client.allgather(self._stage + ":counts",
+                                          local_max, timeout)
+            cap = round_up_pow2(max(max(counts), 1), 8)
+            with mgr.transient(2 * d * cap * row_bytes):
+                ctx.client.barrier(self._stage + ":enter", timeout)
                 with self.timer("collectiveTime"):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,

@@ -978,11 +978,16 @@ def _convert_join(cpu, ch, conf):
     jt = cpu.join_type
     bounds = dict(sub_partition_rows=conf.get(C.JOIN_TARGET_ROWS),
                   out_batch_rows=conf.batch_rows)
+    # multi-executor: scans are executor-sliced, so a broadcast gather
+    # would capture only this process's slice — joins must co-partition
+    # through the ICI exchange instead
+    from spark_rapids_tpu.parallel.executor import get_executor
+    multiproc = get_executor() is not None
     # broadcast the small side when stats say it fits [REF:
     # GpuBroadcastHashJoinExec; Spark's JoinSelection] — no exchange on
     # either side, build side gathered once and reused per partition
     thresh = conf.get(C.BROADCAST_THRESHOLD)
-    if thresh and thresh > 0:
+    if thresh and thresh > 0 and not multiproc:
         rsize = cpu.children[1].estimated_size_bytes()
         lsize = cpu.children[0].estimated_size_bytes()
         if (rsize is not None and rsize <= thresh

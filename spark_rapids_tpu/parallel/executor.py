@@ -1,0 +1,97 @@
+"""Per-process executor context for multi-executor (multi-process) runs.
+
+[REF: sql-plugin/../Plugin.scala :: RapidsExecutorPlugin — the
+reference's executor plugin initializes the device runtime once per
+executor JVM; SURVEY §5.8 — the rendezvous turns Spark's
+independently-scheduled tasks into collective participants.]
+
+One ``ExecutorContext`` per process, created by ``TpuSession`` when
+``spark.rapids.executor.count > 1``:
+
+* joins the **global device mesh** via ``jax.distributed.initialize``
+  (each process addresses only its local devices; collectives span all),
+* holds the ``RendezvousClient`` every ICI exchange uses for shape
+  agreement and collective entry,
+* assigns deterministic per-process stage ids: all executors plan the
+  same query with the same deterministic planner, so the Nth exchange
+  materialized in one process is the Nth in every process (the analog of
+  Spark's driver-assigned shuffle ids).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import List, Optional
+
+from spark_rapids_tpu.parallel.rendezvous import RendezvousClient
+
+
+class ExecutorContext:
+    def __init__(self, process_id: int, num_processes: int,
+                 coordinator_address: str, rendezvous_address: str,
+                 timeout: float):
+        import jax
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        self.process_id = process_id
+        self.num_processes = num_processes
+        self.timeout = timeout
+        self.client = RendezvousClient(rendezvous_address, process_id)
+        self._stage_counter = itertools.count()
+
+    def next_stage_id(self) -> str:
+        """Deterministic across processes (same planner, same order)."""
+        return f"stage-{next(self._stage_counter)}"
+
+    def local_partition_ids(self, mesh) -> List[int]:
+        """Global mesh-partition indices whose device this process owns."""
+        import jax
+        pi = jax.process_index()
+        return [i for i, d in enumerate(mesh.devices.flatten())
+                if d.process_index == pi]
+
+
+_CTX: Optional[ExecutorContext] = None
+_LOCK = threading.Lock()
+
+
+def init_executor(conf) -> Optional[ExecutorContext]:
+    """Create (or return) the process's executor context per conf.
+
+    Idempotent; raises if a second session asks for a conflicting
+    topology (jax.distributed can only initialize once per process)."""
+    from spark_rapids_tpu import conf as C
+    global _CTX
+    count = int(conf.get(C.EXECUTOR_COUNT))
+    if count <= 1:
+        return None
+    coord = str(conf.get(C.COORDINATOR_ADDRESS)).strip()
+    rdv = str(conf.get(C.RENDEZVOUS_ADDRESS)).strip()
+    if not coord or not rdv:
+        raise ValueError(
+            "executor.count > 1 requires both "
+            "spark.rapids.executor.coordinator.address and "
+            "spark.rapids.shuffle.rendezvous.address")
+    if conf.shuffle_mode != "ICI":
+        raise ValueError(
+            "multi-executor mode requires spark.rapids.shuffle.mode=ICI "
+            f"(got {conf.shuffle_mode})")
+    pid = int(conf.get(C.EXECUTOR_ID))
+    timeout = float(conf.get(C.RENDEZVOUS_TIMEOUT))
+    with _LOCK:
+        if _CTX is not None:
+            if (_CTX.process_id, _CTX.num_processes) != (pid, count):
+                raise ValueError(
+                    "executor context already initialized as "
+                    f"({_CTX.process_id}/{_CTX.num_processes}); cannot "
+                    f"re-initialize as ({pid}/{count})")
+            _CTX.timeout = timeout
+            return _CTX
+        _CTX = ExecutorContext(pid, count, coord, rdv, timeout)
+        return _CTX
+
+
+def get_executor() -> Optional[ExecutorContext]:
+    return _CTX

@@ -200,8 +200,12 @@ def _tag_scan(meta: ExecMeta):
 
 
 def _convert_scan(cpu: B.CpuScanExec, children, conf):
+    from spark_rapids_tpu.parallel.executor import get_executor
+    ctx = get_executor()
+    executor = ((ctx.process_id, ctx.num_processes) if ctx is not None
+                else (0, 1))
     return B.TpuScanExec(cpu.table, cpu.schema, cpu.num_partitions(),
-                         cpu.batch_rows)
+                         cpu.batch_rows, executor=executor)
 
 
 EXEC_RULES[B.CpuScanExec] = ExecRule(
@@ -505,6 +509,69 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
     return node
 
 
+# multi-executor mode supports the partition-preserving pipeline around
+# ICI exchanges; global-gather operators would silently compute on one
+# process's slice only, so they fail loudly instead.  The name list
+# covers operators wrong-by-semantics even when partition-preserving
+# (windows need co-partitioning; broadcast captures one slice; the
+# non-collective shuffle exchanges are in-process only); the structural
+# checks below catch every gather point and partition-structure change,
+# including CPU-fallback nodes.
+_MULTIPROC_UNSUPPORTED = {
+    "TpuSortExec", "TpuGlobalLimitExec", "TpuTakeOrderedAndProjectExec",
+    "TpuWindowExec", "TpuBroadcastExchangeExec", "TpuExpandExec",
+    "TpuGenerateExec", "TpuPythonUDFExec", "TpuSampleExec",
+    "CpuSortExec", "CpuGlobalLimitExec", "CpuTakeOrderedAndProjectExec",
+    "CpuWindowExec", "CpuSampleExec", "CpuPythonUDFExec",
+    "TpuShuffleExchangeExec", "CpuShuffleExchangeExec",
+}
+
+
+def _validate_multiproc(plan) -> None:
+    from spark_rapids_tpu.exec.distributed import TpuIciShuffleExchangeExec
+    from spark_rapids_tpu.exec.join import CpuJoinExec, TpuSortMergeJoinExec
+
+    def bad(name, why):
+        raise NotImplementedError(
+            f"{name} is not supported in multi-executor mode "
+            f"(executor.count > 1): {why}. Run on a single executor, or "
+            "restructure the query around hash exchanges (agg / "
+            "co-partitioned equi-join pipelines are supported).")
+
+    def has_exchange(node):
+        return isinstance(node, TpuIciShuffleExchangeExec) or any(
+            has_exchange(c) for c in node.children)
+
+    def walk(node):
+        name = type(node).__name__
+        if name in _MULTIPROC_UNSUPPORTED:
+            bad(name, "it computes on one executor's slice only")
+        if isinstance(node, TpuSortMergeJoinExec) and not node.partitioned:
+            bad(name, "only co-partitioned (ICI-exchanged) equi-joins "
+                "are distributed; this join would match one slice "
+                "against another")
+        if isinstance(node, CpuJoinExec):
+            bad(name, "CPU-fallback joins gather one slice per process")
+        for c in node.children:
+            # structural guards (catch CPU fallbacks and any operator
+            # missed by name): a gather point collapses partitions this
+            # process only partly owns; a partition-structure change
+            # above an exchange breaks local-partition ownership
+            if (not isinstance(node, TpuIciShuffleExchangeExec)
+                    and c.num_partitions() > 1
+                    and node.num_partitions() == 1):
+                bad(name, "it gathers all partitions into one, but "
+                    "each executor holds only its slice")
+            if (has_exchange(c) and not isinstance(
+                    node, TpuIciShuffleExchangeExec)
+                    and node.num_partitions() != c.num_partitions()):
+                bad(name, "it re-groups partitions above a collective "
+                    "exchange, breaking local-partition ownership")
+            walk(c)
+
+    walk(plan)
+
+
 def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     """GpuOverrides.apply + GpuTransitionOverrides in one pass."""
     if not conf.sql_enabled:
@@ -522,6 +589,9 @@ def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     if isinstance(plan, TpuExec):
         plan = DeviceToHostExec(plan)
     plan = insert_coalesce(plan, conf)
+    from spark_rapids_tpu.parallel.executor import get_executor
+    if get_executor() is not None:
+        _validate_multiproc(plan)
     from spark_rapids_tpu import conf as C
     lore_tag = str(conf.get(C.LORE_TAG)).strip()
     if lore_tag:

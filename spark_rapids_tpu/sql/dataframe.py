@@ -630,19 +630,40 @@ class DataFrame:
         sem = get_semaphore(conf)
         waits: List[float] = []  # this query's waits only
 
+        parts = list(range(nparts))
+        from spark_rapids_tpu.parallel.executor import get_executor
+        if get_executor() is not None:
+            # multi-executor: every process must enter each collective
+            # in the SAME order — materialize exchanges sequentially
+            # (children-first = execution-dependency order) before the
+            # parallel pump, then pump only partitions whose mesh device
+            # is local to this process
+            from spark_rapids_tpu.exec.distributed import (
+                TpuIciShuffleExchangeExec, owned_partitions)
+
+            def pre_materialize(node):
+                for c in node.children:
+                    pre_materialize(c)
+                if isinstance(node, TpuIciShuffleExchangeExec):
+                    node._materialize()
+
+            with sem.hold(waited_out=waits):
+                pre_materialize(plan)
+            parts = owned_partitions(plan)
+
         def task(p: int) -> List[pa.Table]:
             with sem.hold(waited_out=waits):
                 return pump(p)
 
-        if nparts <= 1:
+        if len(parts) <= 1:
             # single task still holds a permit — a 1-partition query must
             # count against the concurrency cap like any other
-            chunks = [task(p) for p in range(nparts)]
+            chunks = [task(p) for p in parts]
         else:
             from concurrent.futures import ThreadPoolExecutor
-            workers = min(nparts, max(sem.permits * 2, 4))
+            workers = min(len(parts), max(sem.permits * 2, 4))
             with ThreadPoolExecutor(max_workers=workers) as pool:
-                chunks = list(pool.map(task, range(nparts)))
+                chunks = list(pool.map(task, parts))
         plan.metric("semaphoreWaitTime").add(sum(waits))
         return [t for chunk in chunks for t in chunk]
 

@@ -98,8 +98,13 @@ class TpuSession:
     builder = _BuilderDescriptor()
 
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
-        ensure_initialized()
         self.conf = RuntimeConf(conf or {})
+        # multi-executor mode joins the global mesh FIRST:
+        # jax.distributed.initialize must run before anything touches
+        # the XLA backend [REF: RapidsExecutorPlugin.init]
+        from spark_rapids_tpu.parallel.executor import init_executor
+        init_executor(self.conf.snapshot())
+        ensure_initialized()
 
     # -- data ingestion -----------------------------------------------------
     def createDataFrame(self, data, schema=None) -> "DataFrame":

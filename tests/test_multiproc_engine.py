@@ -1,0 +1,203 @@
+"""Multi-executor engine e2e: N OS processes run the SAME query through
+the public DataFrame API; their ICI exchanges rendezvous into one
+cross-process collective (VERDICT r3 missing #1 / SURVEY §5.8).
+
+2 processes × 2 virtual CPU devices = a 4-device global mesh.  Each
+process computes its executor slice; the union of per-process results
+must equal the CPU oracle on the full input.
+"""
+
+import multiprocessing as mp
+import os
+import socket
+import traceback
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _agg_table() -> pa.Table:
+    rng = np.random.default_rng(5)
+    n = 30_000
+    return pa.table({
+        "k": pa.array(rng.integers(0, 200, n)),
+        "v": pa.array(rng.integers(-1000, 1000, n)),
+    })
+
+
+def _join_tables():
+    rng = np.random.default_rng(6)
+    n, m = 20_000, 4_000
+    left = pa.table({
+        "k": pa.array(rng.integers(0, 2000, n)),
+        "v": pa.array(rng.integers(0, 10_000, n)),
+    })
+    right = pa.table({
+        "k": pa.array(rng.integers(0, 2500, m)),
+        "w": pa.array(rng.integers(-50, 50, m)),
+    })
+    return left, right
+
+
+def _engine_worker(pid, nprocs, jax_port, rdv_addr, q):
+    try:
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        from spark_rapids_tpu.sql import functions as F
+        from spark_rapids_tpu.sql.session import TpuSession
+
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.shuffle.mode": "ICI",
+            "spark.default.parallelism": 8,
+            "spark.rapids.executor.id": pid,
+            "spark.rapids.executor.count": nprocs,
+            "spark.rapids.executor.coordinator.address":
+                f"127.0.0.1:{jax_port}",
+            "spark.rapids.shuffle.rendezvous.address": rdv_addr,
+            "spark.rapids.shuffle.rendezvous.timeoutSec": 120.0,
+        })
+        agg = (s.createDataFrame(_agg_table())
+               .groupBy("k")
+               .agg(F.sum("v").alias("sv"), F.count("*").alias("c"))
+               .toArrow())
+        left, right = _join_tables()
+        join = (s.createDataFrame(left)
+                .join(s.createDataFrame(right), "k", "inner")
+                .toArrow())
+        q.put(("ok", pid, agg.to_pylist(), join.to_pylist()))
+    except Exception:  # pragma: no cover
+        q.put(("err", pid, traceback.format_exc(), None))
+
+
+def test_multiprocess_engine_agg_and_join_match_oracle():
+    from spark_rapids_tpu.parallel.rendezvous import RendezvousCoordinator
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    nprocs = 2
+    jax_port = _free_port()
+    coord = RendezvousCoordinator(num_processes=nprocs)
+    procs = [ctx.Process(target=_engine_worker,
+                         args=(i, nprocs, jax_port, coord.address, q))
+             for i in range(nprocs)]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nprocs):
+            results.append(q.get(timeout=420))
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        coord.shutdown()
+    errs = [r for r in results if r[0] == "err"]
+    assert not errs, errs[0][2]
+
+    # oracle: the same queries on the CPU path, full input, one process
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSession
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    exp_agg = (cpu.createDataFrame(_agg_table())
+               .groupBy("k")
+               .agg(F.sum("v").alias("sv"), F.count("*").alias("c"))
+               .toArrow().to_pylist())
+    left, right = _join_tables()
+    exp_join = (cpu.createDataFrame(left)
+                .join(cpu.createDataFrame(right), "k", "inner")
+                .toArrow().to_pylist())
+
+    got_agg = [row for r in results for row in r[2]]
+    got_join = [row for r in results for row in r[3]]
+
+    def norm(rows):
+        return sorted(tuple(r.values()) for r in rows)
+
+    # every group lands on exactly one executor: union must be exact
+    assert norm(got_agg) == norm(exp_agg)
+    assert norm(got_join) == norm(exp_join)
+    # both executors contributed (the slice actually spread)
+    assert all(len(r[2]) > 0 for r in results)
+    assert all(len(r[3]) > 0 for r in results)
+
+
+def _unsupported_worker(pid, nprocs, jax_port, rdv_addr, q):
+    try:
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        from spark_rapids_tpu.sql.session import TpuSession
+
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.shuffle.mode": "ICI",
+            "spark.default.parallelism": 4,
+            "spark.rapids.executor.id": pid,
+            "spark.rapids.executor.count": nprocs,
+            "spark.rapids.executor.coordinator.address":
+                f"127.0.0.1:{jax_port}",
+            "spark.rapids.shuffle.rendezvous.address": rdv_addr,
+        })
+        df = s.createDataFrame(_agg_table()).orderBy("k")
+        try:
+            df.toArrow()
+            q.put(("err", pid, "orderBy did not raise", None))
+        except NotImplementedError as e:
+            q.put(("ok", pid, str(e), None))
+    except Exception:  # pragma: no cover
+        q.put(("err", pid, traceback.format_exc(), None))
+
+
+def test_multiprocess_global_gather_raises():
+    """Global-gather operators must fail loudly in multi-executor mode
+    instead of silently computing per-slice results."""
+    from spark_rapids_tpu.parallel.rendezvous import RendezvousCoordinator
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    nprocs = 2
+    jax_port = _free_port()
+    coord = RendezvousCoordinator(num_processes=nprocs)
+    procs = [ctx.Process(target=_unsupported_worker,
+                         args=(i, nprocs, jax_port, coord.address, q))
+             for i in range(nprocs)]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nprocs):
+            results.append(q.get(timeout=240))
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        coord.shutdown()
+    errs = [r for r in results if r[0] == "err"]
+    assert not errs, errs[0][2]
+    assert all("multi-executor" in r[2] for r in results)
+
+
+def test_executor_conf_validation():
+    """count > 1 without addresses (or without ICI mode) must raise."""
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.parallel.executor import init_executor
+    with pytest.raises(ValueError, match="coordinator.address"):
+        init_executor(RapidsConf({"spark.rapids.executor.count": 2}))
+    with pytest.raises(ValueError, match="ICI"):
+        init_executor(RapidsConf({
+            "spark.rapids.executor.count": 2,
+            "spark.rapids.executor.coordinator.address": "127.0.0.1:1",
+            "spark.rapids.shuffle.rendezvous.address": "127.0.0.1:2",
+        }))
