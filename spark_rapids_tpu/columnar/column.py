@@ -423,6 +423,8 @@ def _device_to_host_impl(batch: DeviceBatch,
             bufs.append(c.validity)
         if c.lengths is not None:
             bufs.append(c.lengths)
+        if c.evalid is not None:
+            bufs.append(c.evalid)
     from spark_rapids_tpu.shims import get_shim
     shim = get_shim()
     for b in bufs:

@@ -605,18 +605,17 @@ class TpuSortMergeJoinExec(TpuExec):
         # skewed sub-partition's keys re-spread on the re-split
         SUB_SEED = 0x53504C54 + depth
 
-        def split(batches, keys, schema):
-            pid_fn = cached_kernel(
-                ("subpart_pid", k, SUB_SEED, canon, fingerprint(keys),
-                 fingerprint(schema)),
-                lambda: make_pid_fn(keys, k, canon, seed=SUB_SEED))
+        def split(batches, keys):
+            pid_fn = make_pid_fn(keys, k, canon, seed=SUB_SEED)
             # drains ``batches`` in place so the originals free even
-            # though execute()'s frame still references the lists
-            return split_to_spillables(batches, pid_fn, k, mgr)
+            # though execute()'s frame still references the lists;
+            # the split's kernels are cached under the pid recipe
+            return split_to_spillables(
+                batches, lambda b, aux: pid_fn(b), k, mgr,
+                ("subpart", SUB_SEED, canon, fingerprint(keys)))
 
-        l_slices = split(l_list, self.left_keys, self.children[0].schema)
-        r_slices = split(r_list, self.right_keys,
-                         self.children[1].schema)
+        l_slices = split(l_list, self.left_keys)
+        r_slices = split(r_list, self.right_keys)
         for i in range(k):
             # inner/semi emit only matched left rows: an empty side means
             # an empty pair output (left/anti/full still must run to emit

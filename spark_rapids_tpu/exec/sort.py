@@ -137,9 +137,14 @@ class TpuSortExec(TpuExec):
         with self.timer():
             # drains ``batches`` in place so the originals free even
             # though execute()'s frame still references the list
+            # bounds are data-dependent: they ride as a traced kernel
+            # argument (aux), never baked into the cached executable
+            from spark_rapids_tpu.runtime.kernel_cache import fingerprint
             slices = split_to_spillables(
-                batches, lambda b: _range_ids(b, orders, bounds),
-                nranges, mgr)
+                batches, lambda b, aux: _range_ids(b, orders, aux),
+                nranges, mgr,
+                key=("rangesplit", fingerprint(list(orders))),
+                aux=bounds)
         for r in range(nranges):
             if not slices[r]:
                 continue

@@ -45,7 +45,8 @@ from spark_rapids_tpu.plan import logical as L
 
 
 WINDOW_KINDS = ("row_number", "rank", "dense_rank", "lag", "lead",
-                "sum", "min", "max", "count", "avg", "first")
+                "sum", "min", "max", "count", "avg", "first",
+                "ntile", "percent_rank", "cume_dist")
 
 
 # ---------------------------------------------------------------------------
@@ -112,41 +113,125 @@ def _scan_minmax(data_s, contrib, pb, kind, dt):
     return segmented_scan(red, masked, pb), None, None
 
 
-def _bounded_window_sum(values, pb, rn, lo: int, hi: int, acc_dt):
-    """Sliding ROWS-frame sum via inclusive-prefix differences.
+def _range_sum(values, pb, start, end, part_start, acc_dt):
+    """Frame sum over absolute per-row bounds [start, end] via
+    inclusive-prefix differences.
 
     [REF: cudf rolling window kernels — re-designed as two gathers over
-    one segmented prefix, the TPU-idiom rolling primitive]
-    frame of row i = rows [i+lo, i+hi] clamped to i's partition."""
+    one segmented prefix, the TPU-idiom rolling primitive]  Bounds must
+    already be clamped to the row's partition; empty frames (end <
+    start) sum to zero."""
     n = values.shape[0]
     prefix = segmented_scan(jnp.add, values.astype(acc_dt), pb)
+    nonempty = end >= start
+    end_v = jnp.where(nonempty,
+                      jnp.take(prefix, jnp.clip(end, 0, n - 1)),
+                      jnp.zeros((), acc_dt))
+    start_v = jnp.where(nonempty & (start > part_start),
+                        jnp.take(prefix, jnp.clip(start - 1, 0, n - 1)),
+                        jnp.zeros((), acc_dt))
+    return end_v - start_v
+
+
+def _range_reduce(vals, combine, start, end):
+    """Frame reduce over absolute per-row bounds via a doubling sparse
+    table: tables[j][i] = reduce over [i, i+2^j-1] (tail-clamped), and
+    a query [s, e] is combine(tables[k][s], tables[k][e-2^k+1]) with
+    2^k = largest power ≤ len.  ``combine`` must be idempotent
+    (min/max) — the two query windows overlap.  log(n) build steps,
+    O(n log n) memory, no partition awareness needed: the two windows
+    lie inside [s, e], which never crosses a partition."""
+    n = int(vals.shape[0])
+    steps = max(1, (max(n, 2) - 1).bit_length())
     i = jnp.arange(n, dtype=jnp.int32)
+    tables = [vals]
+    cur = vals
+    step = 1
+    for _ in range(steps):
+        shifted = jnp.take(cur, jnp.minimum(i + step, n - 1))
+        cur = combine(cur, shifted)
+        tables.append(cur)
+        step *= 2
+    stacked = jnp.stack(tables)          # [steps+1, n]
+    flat = stacked.reshape(-1)
+    ln = jnp.maximum(end - start + 1, 1)
+    k = jnp.zeros_like(ln)
+    for j in range(1, steps + 1):
+        k = k + (ln >= (1 << j)).astype(ln.dtype)
+    pow_k = jnp.left_shift(jnp.ones((), ln.dtype), k)
+    a = jnp.take(flat, k * n + jnp.clip(start, 0, n - 1))
+    b = jnp.take(flat, k * n + jnp.clip(end - pow_k + 1, 0, n - 1))
+    return combine(a, b)
+
+
+def _frame_bounds_rows(i, rn, pb, lo: int, hi: int):
+    """Absolute [start, end] for a ROWS frame [i+lo, i+hi], clamped to
+    the row's partition."""
     part_start = i - (rn - 1)
     part_len = broadcast_last(rn, pb)
     part_end = part_start + part_len - 1
-    end = jnp.clip(i + hi, part_start - 1, part_end)
     start = jnp.clip(i + lo, part_start, part_end + 1)
-    end_v = jnp.where(end >= part_start,
-                      jnp.take(prefix, jnp.clip(end, 0, n - 1)),
-                      jnp.zeros((), acc_dt))
-    start_v = jnp.where(start > part_start,
-                        jnp.take(prefix, jnp.clip(start - 1, 0, n - 1)),
-                        jnp.zeros((), acc_dt))
-    return jnp.where(end >= start, end_v - start_v,
-                     jnp.zeros((), acc_dt))
+    end = jnp.clip(i + hi, part_start - 1, part_end)
+    return start, end, part_start
 
 
 def _eval_agg(wf: L.WindowFunctionSpec, data_s, valid_s, live_s, pb,
-              peer_b, rn) -> DeviceColumn:
+              peer_b, rn, range_bounds=None) -> DeviceColumn:
     kind, frame = wf.kind, wf.frame
     contrib = valid_s & live_s
 
-    if frame == "rows_bounded":
-        lo, hi = wf.frame_lo, wf.frame_hi
-        n_contrib = _bounded_window_sum(contrib.astype(jnp.int64), pb,
-                                        rn, lo, hi, jnp.int64)
+    if frame in ("rows_bounded", "range_bounded"):
+        n = int(data_s.shape[0])
+        i = jnp.arange(n, dtype=jnp.int32)
+        if frame == "rows_bounded":
+            start, end, part_start = _frame_bounds_rows(
+                i, rn, pb, wf.frame_lo, wf.frame_hi)
+        else:
+            start, end, part_start = range_bounds
+
+        def rsum(vals, acc_dt):
+            return _range_sum(vals, pb, start, end, part_start, acc_dt)
+
+        n_contrib = rsum(contrib.astype(jnp.int64), jnp.int64)
         if kind == "count":
             return DeviceColumn(T.LongT, n_contrib, None)
+        if kind == "first":
+            # first row of the frame (null-including semantics)
+            nonempty = end >= start
+            pos = jnp.clip(start, 0, n - 1)
+            v = jnp.take(data_s, pos, axis=0)
+            vv = jnp.take(valid_s, pos) & nonempty
+            return DeviceColumn(wf.dtype, v, vv)
+        if kind in ("min", "max"):
+            dt = wf.dtype
+            if isinstance(dt, (T.FloatType, T.DoubleType)):
+                isn = jnp.isnan(data_s)
+                real = contrib & ~isn
+                inf = jnp.asarray(np.inf, data_s.dtype)
+                red = jnp.minimum if kind == "min" else jnp.maximum
+                masked = jnp.where(real, data_s,
+                                   inf if kind == "min" else -inf)
+                agg = _range_reduce(masked, red, start, end)
+                n_real = rsum(real.astype(jnp.int64), jnp.int64)
+                n_nan = rsum((contrib & isn).astype(jnp.int64),
+                             jnp.int64)
+                nan = jnp.asarray(np.nan, data_s.dtype)
+                if kind == "min":
+                    agg = jnp.where((n_real == 0) & (n_contrib > 0),
+                                    nan, agg)
+                else:
+                    agg = jnp.where(n_nan > 0, nan, agg)
+                return DeviceColumn(dt, agg, n_contrib > 0)
+            from spark_rapids_tpu.exec.aggregate import (
+                decode_orderable, encode_orderable)
+            u = encode_orderable(data_s, dt)
+            sentinel = jnp.uint64(
+                0xFFFFFFFFFFFFFFFF if kind == "min" else 0)
+            masked = jnp.where(contrib, u, sentinel)
+            red = jnp.minimum if kind == "min" else jnp.maximum
+            raw = _range_reduce(masked, red, start, end)
+            return DeviceColumn(wf.dtype, decode_orderable(raw, wf.dtype),
+                                n_contrib > 0)
 
         def frame_sum(vals, acc_dt):
             """NaN/Inf-safe bounded-frame float sum: a prefix difference
@@ -157,8 +242,7 @@ def _eval_agg(wf: L.WindowFunctionSpec, data_s, valid_s, live_s, pb,
             if not np.issubdtype(acc_dt, np.floating):
                 masked = jnp.where(contrib, vals.astype(acc_dt),
                                    jnp.zeros((), acc_dt))
-                return _bounded_window_sum(masked, pb, rn, lo, hi,
-                                           acc_dt)
+                return rsum(masked, acc_dt)
             v = vals.astype(acc_dt)
             isnan = jnp.isnan(v)
             ispinf = jnp.isposinf(v)
@@ -166,13 +250,11 @@ def _eval_agg(wf: L.WindowFunctionSpec, data_s, valid_s, live_s, pb,
             finite = contrib & ~(isnan | ispinf | isninf)
 
             def cnt(mask):
-                return _bounded_window_sum(
-                    (contrib & mask).astype(jnp.int64), pb, rn, lo, hi,
-                    jnp.int64)
+                return rsum((contrib & mask).astype(jnp.int64),
+                            jnp.int64)
 
-            s = _bounded_window_sum(
-                jnp.where(finite, v, jnp.zeros((), acc_dt)), pb, rn,
-                lo, hi, acc_dt)
+            s = rsum(jnp.where(finite, v, jnp.zeros((), acc_dt)),
+                     acc_dt)
             n_nan, n_pinf, n_ninf = cnt(isnan), cnt(ispinf), cnt(isninf)
             s = jnp.where(n_pinf > 0, jnp.asarray(np.inf, acc_dt), s)
             s = jnp.where(n_ninf > 0, jnp.asarray(-np.inf, acc_dt), s)
@@ -239,8 +321,10 @@ def _eval_agg(wf: L.WindowFunctionSpec, data_s, valid_s, live_s, pb,
 
 
 def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
-                    perm, live_s, pb, peer_b, rn) -> DeviceColumn:
+                    perm, live_s, pb, peer_b, rn,
+                    range_bounds=None) -> DeviceColumn:
     kind = wf.kind
+    b = int(rn.shape[0])
     if kind == "row_number":
         return DeviceColumn(wf.dtype, rn, None)
     if kind == "rank":
@@ -250,6 +334,34 @@ def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
         return DeviceColumn(
             wf.dtype,
             segmented_scan(jnp.add, peer_b.astype(jnp.int32), pb), None)
+    if kind in ("percent_rank", "cume_dist", "ntile"):
+        i = jnp.arange(b, dtype=jnp.int32)
+        part_len = broadcast_last(rn, pb)
+        if kind == "percent_rank":
+            rank = segmented_scan(_keep_first, rn, peer_b)
+            denom = jnp.maximum(part_len - 1, 1)
+            v = jnp.where(part_len > 1,
+                          (rank - 1).astype(jnp.float64)
+                          / denom.astype(jnp.float64), 0.0)
+            return DeviceColumn(wf.dtype, v, None)
+        if kind == "cume_dist":
+            part_start = i - (rn - 1)
+            pe = broadcast_last(i, peer_b)
+            v = ((pe - part_start + 1).astype(jnp.float64)
+                 / part_len.astype(jnp.float64))
+            return DeviceColumn(wf.dtype, v, None)
+        # ntile(n): first (len % n) buckets get (len // n + 1) rows
+        nb = jnp.int32(int(wf.offset))
+        q = part_len // nb
+        r = part_len % nb
+        size1 = q + 1
+        cutoff = r * size1
+        rn0 = rn - 1
+        in_first = rn0 < cutoff
+        bucket = jnp.where(
+            in_first, rn0 // jnp.maximum(size1, 1),
+            r + (rn0 - cutoff) // jnp.maximum(q, 1)) + 1
+        return DeviceColumn(wf.dtype, bucket.astype(jnp.int32), None)
 
     c = wf.child.eval_tpu(batch)
     data_s = jnp.take(c.data, perm, axis=0)
@@ -258,7 +370,6 @@ def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
 
     if kind in ("lag", "lead"):
         k = int(wf.offset)
-        b = int(data_s.shape[0])
         if k >= b:  # offset beyond the batch: every row's result is null
             return DeviceColumn(
                 wf.dtype, jnp.zeros_like(data_s),
@@ -267,6 +378,51 @@ def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
         if k == 0:
             return DeviceColumn(wf.dtype, data_s,
                                 valid_s & live_s, lengths_s)
+        if wf.ignore_nulls:
+            # k-th non-null neighbor: 'previous valid index' array via a
+            # segmented running max of masked indices, composed k times
+            # (lead = the same on the reversed arrays)
+            idx = jnp.arange(b, dtype=jnp.int32)
+            ok = valid_s & live_s
+
+            def prev_valid_idx(okm, pbm):
+                last_v = segmented_scan(
+                    jnp.maximum, jnp.where(okm, idx, -1), pbm)
+                return jnp.where(
+                    pbm, -1,
+                    jnp.concatenate([jnp.full((1,), -1, jnp.int32),
+                                     last_v[:-1]]))
+
+            if kind == "lag":
+                p1 = prev_valid_idx(ok, pb)
+            else:
+                is_end = jnp.concatenate(
+                    [pb[1:], jnp.ones((1,), jnp.bool_)])
+                p1r = prev_valid_idx(jnp.flip(ok), jnp.flip(is_end))
+                p1 = jnp.flip(p1r)
+                p1 = jnp.where(p1 >= 0, b - 1 - p1, -1)
+            # k-1 further hops by pointer doubling: O(log k) gathers
+            # traced, never k (a large offset would otherwise unroll
+            # thousands of sequential gathers into one XLA program —
+            # the compile pathology class this repo budgets against)
+            def compose(f, g):
+                return jnp.where(f >= 0,
+                                 jnp.take(g, jnp.clip(f, 0, b - 1)), -1)
+
+            tgt = p1
+            rem = k - 1
+            hop = p1
+            while rem:
+                if rem & 1:
+                    tgt = compose(tgt, hop)
+                rem >>= 1
+                if rem:
+                    hop = compose(hop, hop)
+            pos = jnp.clip(tgt, 0, b - 1)
+            sd = jnp.take(data_s, pos, axis=0)
+            sv = (tgt >= 0)
+            sl = None if lengths_s is None else jnp.take(lengths_s, pos)
+            return DeviceColumn(wf.dtype, sd, sv, sl)
         if kind == "lag":
             def shift(x, fill):
                 pad = jnp.full((k,) + x.shape[1:], fill, x.dtype)
@@ -285,7 +441,76 @@ def _eval_window_fn(wf: L.WindowFunctionSpec, batch: DeviceBatch,
         sl = None if lengths_s is None else shift(lengths_s, 0)
         return DeviceColumn(wf.dtype, sd, sv, sl)
 
-    return _eval_agg(wf, data_s, valid_s, live_s, pb, peer_b, rn)
+    return _eval_agg(wf, data_s, valid_s, live_s, pb, peer_b, rn,
+                     range_bounds)
+
+
+def _compute_range_bounds(batch, order: "L.SortOrder", perm, pb, peer_b,
+                          rn, specs):
+    """Per-row absolute [start, end] for each RANGE offset frame.
+
+    The frame of row i = rows of i's partition whose ORDER value lies in
+    [v_i + lo, v_i + hi].  Found by a vectorized lexicographic binary
+    search (exec/join._lex_search) over a 3-limb monotone encoding of
+    the sorted rows: (partition ordinal, null flag, biased order value).
+    Null-ordering rows take their peer group as the frame (Spark range
+    semantics); unbounded ends clamp to the partition.
+    """
+    from spark_rapids_tpu.exec.join import _lex_search
+    b = int(rn.shape[0])
+    i = jnp.arange(b, dtype=jnp.int32)
+    part_start = i - (rn - 1)
+    part_len = broadcast_last(rn, pb)
+    part_end = part_start + part_len - 1
+    ps = segmented_scan(_keep_first, i, peer_b)
+    pe = broadcast_last(i, peer_b)
+
+    c = order.expr.eval_tpu(batch)
+    vals = jnp.take(c.data, perm).astype(jnp.int64)
+    ovalid = jnp.take(c.valid_mask(), perm)
+    pid_ord = jnp.cumsum(pb.astype(jnp.int64)).astype(jnp.uint64)
+    null_limb = (ovalid if order.nulls_first else ~ovalid).astype(
+        jnp.uint64)
+    q_null = jnp.uint64(1 if order.nulls_first else 0)
+    bias = jnp.int64(1) << jnp.int64(63)
+
+    def enc(v):
+        return (v ^ bias).astype(jnp.uint64)  # order-preserving i64→u64
+
+    imax = jnp.int64((1 << 63) - 1)
+    imin = jnp.int64(-(1 << 63))
+
+    def sat_add(v, off: int):
+        """Saturating v + off: a wrapped bound would land before the
+        partition's values and empty every frame near the extremes (the
+        CPU oracle compares with exact Python ints — saturation agrees
+        with it, since the bound only needs to dominate all values)."""
+        o = jnp.int64(off)
+        if off >= 0:
+            return jnp.where(v > imax - o, imax, v + o)
+        return jnp.where(v < imin - o, imin, v + o)
+
+    sorted_3 = [pid_ord, null_limb, enc(vals)]
+    out = {}
+    for lo, hi in specs:
+        if lo is None:
+            start = part_start
+        else:
+            qs = [pid_ord, jnp.full((b,), q_null, jnp.uint64),
+                  enc(sat_add(vals, lo))]
+            start = _lex_search(sorted_3, qs, "left").astype(jnp.int32)
+        if hi is None:
+            end = part_end
+        else:
+            qe = [pid_ord, jnp.full((b,), q_null, jnp.uint64),
+                  enc(sat_add(vals, hi))]
+            end = (_lex_search(sorted_3, qe, "right").astype(jnp.int32)
+                   - 1)
+        # null current rows: frame = their peer group
+        start = jnp.where(ovalid, start, ps)
+        end = jnp.where(ovalid, end, pe)
+        out[(lo, hi)] = (start, end, part_start)
+    return out
 
 
 def _window_impl(batch: DeviceBatch, pby: Sequence[Expression],
@@ -311,10 +536,20 @@ def _window_impl(batch: DeviceBatch, pby: Sequence[Expression],
                     else jnp.zeros((b,), jnp.bool_))).at[0].set(True)
     rn = segmented_scan(jnp.add, jnp.ones((b,), jnp.int32), pb)
 
+    range_specs = {(wf.frame_lo, wf.frame_hi) for wf in fns
+                   if wf.frame == "range_bounded"}
+    range_bounds = {}
+    if range_specs:
+        range_bounds = _compute_range_bounds(
+            batch, orders[0], perm, pb, peer_b, rn, range_specs)
+
     out_cols: List[DeviceColumn] = [c.gather(perm) for c in batch.columns]
     for wf in fns:
+        rb = (range_bounds.get((wf.frame_lo, wf.frame_hi))
+              if wf.frame == "range_bounded" else None)
         out_cols.append(
-            _eval_window_fn(wf, batch, perm, live_s, pb, peer_b, rn))
+            _eval_window_fn(wf, batch, perm, live_s, pb, peer_b, rn,
+                            rb))
     count = jnp.sum(live_s.astype(jnp.int32))
     sel = jnp.arange(b, dtype=jnp.int32) < count
     return DeviceBatch(out_schema, tuple(out_cols), sel, compacted=True)
@@ -466,6 +701,41 @@ class CpuWindowExec(CpuExec):
                 for pi in range(len(peer_starts) - 1):
                     for i in range(peer_starts[pi], peer_starts[pi + 1]):
                         vals[i] = pi + 1
+            elif wf.kind == "percent_rank":
+                plen = hi - lo
+                for pi in range(len(peer_starts) - 1):
+                    for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                        vals[i] = ((peer_starts[pi] - lo)
+                                   / (plen - 1) if plen > 1 else 0.0)
+            elif wf.kind == "cume_dist":
+                plen = hi - lo
+                for pi in range(len(peer_starts) - 1):
+                    for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                        vals[i] = (peer_starts[pi + 1] - lo) / plen
+            elif wf.kind == "ntile":
+                plen = hi - lo
+                nb = wf.offset
+                q, r = divmod(plen, nb)
+                for i in range(lo, hi):
+                    rn0 = i - lo
+                    if rn0 < r * (q + 1):
+                        vals[i] = rn0 // (q + 1) + 1
+                    else:
+                        vals[i] = r + (rn0 - r * (q + 1)) // max(q, 1) + 1
+            elif wf.kind in ("lag", "lead") and wf.ignore_nulls:
+                step = -1 if wf.kind == "lag" else 1
+                for i in range(lo, hi):
+                    remaining, src = wf.offset, i
+                    while remaining > 0:
+                        src += step
+                        if not (lo <= src < hi):
+                            src = None
+                            break
+                        if (vc.validity is None
+                                or bool(vc.validity[src])):
+                            remaining -= 1
+                    if src is not None:
+                        vals[i] = vc.data[src]
             elif wf.kind in ("lag", "lead"):
                 k = wf.offset if wf.kind == "lag" else -wf.offset
                 for i in range(lo, hi):
@@ -482,6 +752,41 @@ class CpuWindowExec(CpuExec):
                                    min(hi - 1, i + wf.frame_hi) + 1):
                         _acc_update(acc, fobj, vc, j)
                     vals[i] = _acc_final(acc, fobj)
+            elif wf.frame == "range_bounded":
+                fobj = _AGG_CLS[wf.kind](wf.child)
+                oc = self.order_by[0].expr.eval_cpu(merged)
+                ov = oc.data[perm]
+                ovalid = (np.ones(n, bool) if oc.validity is None
+                          else oc.validity[perm])
+                nf = self.order_by[0].nulls_first
+                for pi in range(len(peer_starts) - 1):
+                    for i in range(peer_starts[pi], peer_starts[pi + 1]):
+                        acc = _new_acc(fobj)
+                        if not ovalid[i]:
+                            # null order key: frame = the peer group
+                            frame = list(range(peer_starts[pi],
+                                               peer_starts[pi + 1]))
+                        else:
+                            v = int(ov[i])
+                            frame = []
+                            for j in range(lo, hi):
+                                if ovalid[j]:
+                                    if ((wf.frame_lo is None
+                                         or int(ov[j])
+                                         >= v + wf.frame_lo)
+                                            and (wf.frame_hi is None
+                                                 or int(ov[j])
+                                                 <= v + wf.frame_hi)):
+                                        frame.append(j)
+                                # an unbounded end reaches the nulls on
+                                # that side of the partition
+                                elif ((nf and wf.frame_lo is None)
+                                      or (not nf
+                                          and wf.frame_hi is None)):
+                                    frame.append(j)
+                        for j in frame:
+                            _acc_update(acc, fobj, vc, j)
+                        vals[i] = _acc_final(acc, fobj)
             else:  # aggregates
                 fobj = _AGG_CLS[wf.kind](wf.child)
                 acc = _new_acc(fobj)
@@ -530,11 +835,12 @@ def _tag_window(meta):
             meta.will_not_work(
                 f"window function {wf.kind} has no TPU implementation")
             continue
-        if wf.frame == "rows_bounded" and wf.kind not in (
-                "sum", "count", "avg"):
+        if (wf.frame == "range_bounded"
+                and not cpu.order_by[0].ascending):
             meta.will_not_work(
-                f"bounded-frame window {wf.kind} not supported on "
-                "device (prefix-difference covers sum/count/avg only)")
+                "RANGE offset frames over a descending ORDER BY key "
+                "not yet supported on device (the bound search encodes "
+                "ascending order)")
         if wf.child is not None:
             meta.tag_expressions([wf.child])
             if wf.kind in ("min", "max", "first") and isinstance(

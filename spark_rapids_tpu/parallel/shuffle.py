@@ -206,31 +206,97 @@ def shard_batch(mesh: jax.sharding.Mesh, batch: DeviceBatch) -> DeviceBatch:
     return jax.device_put(batch, sharding)
 
 
-def split_to_spillables(batches, ids_fn, nbuckets: int, mgr):
-    """Slice every batch by bucket id and register each slice as an
-    unreserved spillable (the out-of-core sort/join spill pool).
+def split_to_spillables(batches, ids_fn, nbuckets: int, mgr, key: tuple,
+                        aux=None, chunk_rows: int = 1 << 20):
+    """Bucket-split batches and register each slice as an unreserved
+    spillable (the out-of-core sort/join spill pool).
+
+    Dispatch-bounded design: the naive per-(batch × bucket) eager mask/
+    compact/sync loop costs O(batches · buckets) kernel dispatches AND
+    host syncs — ~2k tunnel round trips on TPC-H q10, the breadth-query
+    killer.  Instead the batches coalesce into ≤``chunk_rows`` chunks
+    and each chunk runs ONE cached counting-sort kernel (rows grouped
+    by bucket id + per-bucket counts), ONE [nbuckets] host sync, and
+    one cached gather per non-empty bucket (cut kernels cached per
+    pow-2 slice size, so the compile set is tiny and shared).
+
+    ``key`` must fingerprint ``ids_fn``'s behavior (the kernels are
+    cached on it); data-dependent state (e.g. range bounds) must ride
+    ``aux`` — it is passed to ``ids_fn(batch, aux)`` as a traced
+    argument, NOT baked into the compiled kernel.
 
     CONSUMES ``batches`` in place (front pop): an upstream generator
     frame usually still references the same list object, so an in-place
     drain is the only way the original batches actually free as their
     slices are carved — `del` in the callee would just drop an alias.
-    Front pop keeps concat order identical to the in-core path (stable
-    sorts break ties by input order)."""
-    from spark_rapids_tpu.columnar.column import compact
+    Chunk coalescing keeps concat order identical to the in-core path
+    (the counting sort is stable, so intra-bucket order is input
+    order)."""
+    from spark_rapids_tpu.columnar.column import DeviceBatch, compact
+    from spark_rapids_tpu.exec.basic import concat_device_batches
+    from spark_rapids_tpu.runtime.kernel_cache import (
+        cached_kernel, fingerprint)
     from spark_rapids_tpu.runtime.memory import SpillableBatch
     out = [[] for _ in range(nbuckets)]
+    if not batches:
+        return out
+    schema = batches[0].schema
+    base_key = ("split", nbuckets, fingerprint(schema)) + tuple(key)
+    # this path usually runs AFTER a RetryOOM: the chunk (plus its
+    # sorted copy) must fit the arbiter budget, so cap chunk rows by
+    # the estimated row width
+    row_b = max(1, batches[0].nbytes() // max(batches[0].capacity, 1))
+    budget_rows = max(1024, int(mgr.budget) // (4 * row_b))
+    chunk_rows = min(chunk_rows,
+                     1 << max(10, budget_rows.bit_length() - 1))
+
+    def build_sort():
+        def run(m, aux):
+            pid = ids_fn(m, aux)
+            pid_s, perm = _sorted_pids(m, pid, nbuckets)
+            bounds = _partition_bounds(pid_s, nbuckets)
+            cols = tuple(c.gather(perm) for c in m.columns)
+            sel = (jnp.arange(m.capacity, dtype=jnp.int32)
+                   < bounds[-1])
+            counts = bounds[1:] - bounds[:-1]
+            return DeviceBatch(m.schema, cols, sel,
+                               compacted=True), counts
+        return run
+
+    def build_cut(size):
+        def run(m, lo, count):
+            idx = jnp.clip(lo + jnp.arange(size, dtype=jnp.int32),
+                           0, m.capacity - 1)
+            cols = tuple(c.gather(idx) for c in m.columns)
+            sel = jnp.arange(size, dtype=jnp.int32) < count
+            return DeviceBatch(m.schema, cols, sel, compacted=True)
+        return run
+
     while batches:
-        b = batches.pop(0)
-        ids = ids_fn(b)
+        chunk, acc = [], 0
+        while batches and (not chunk
+                           or acc + batches[0].capacity <= chunk_rows):
+            b = compact(batches.pop(0))
+            chunk.append(b)
+            acc += b.capacity
+        merged = (chunk[0] if len(chunk) == 1 else
+                  concat_device_batches(schema, chunk))
+        del chunk
+        sort_fn = cached_kernel(("split_sort",) + base_key, build_sort)
+        laid, counts = sort_fn(merged, aux)
+        counts = np.asarray(counts)  # the chunk's ONE host sync
+        offs = np.concatenate([[0], np.cumsum(counts)])
         for i in range(nbuckets):
-            part = compact(b.with_sel(b.sel & (ids == i)))
-            n = part.num_rows_host()
+            n = int(counts[i])
             if n == 0:
                 continue
-            cap = max(8, 1 << (n - 1).bit_length())
-            if cap < part.capacity:
-                part = slice_batch(part, 0, cap)
+            size = max(8, 1 << (n - 1).bit_length())
+            cut_fn = cached_kernel(
+                ("split_cut", size) + base_key,
+                lambda s=size: build_cut(s))
+            part = cut_fn(laid, int(offs[i]), n)
             out[i].append(SpillableBatch(part, mgr, reserve=False))
+        del laid, merged
     return out
 
 

@@ -274,11 +274,25 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
             # simple patterns transpile to device predicates — the
             # RegexParser fast path [REF: CudfRegexTranspiler]
             kind, lit = simple
-            if kind == "eq":
-                return S.string_comparison(
-                    "eq", child, E.Literal(lit, T.StringT))
-            return S.string_predicate(kind, child,
-                                      E.Literal(lit, T.StringT))
+
+            def one(lit2):
+                if kind == "eq":
+                    return S.string_comparison(
+                        "eq", child, E.Literal(lit2, T.StringT))
+                return S.string_predicate(kind, child,
+                                          E.Literal(lit2, T.StringT))
+
+            if kind not in ("eq", "endswith"):
+                return one(lit)
+            # Java's '$' (Pattern.find, no UNIX_LINES) also matches
+            # just before a FINAL line terminator: "abc\n" rlike
+            # "abc$" is true — OR in each terminator variant (XLA
+            # CSEs the repeated child subtree)
+            out = one(lit)
+            for term in ("\n", "\r\n", "\r", "\u0085",
+                         "\u2028", "\u2029"):
+                out = E.Or(out, one(lit + term))
+            return out
         return S.RLike(child, pattern)
     if op == "regexp_extract":
         pattern, idx = u.payload
@@ -395,23 +409,43 @@ def resolve_window(u: UExpr, schema: T.StructType):
         frame = "range_current" if orders else "partition"
     else:
         kind, lo, hi = spec.frame
-        bounded = (lo != Window.unboundedPreceding
-                   and hi != Window.unboundedFollowing)
-        if kind == "rows" and lo == Window.unboundedPreceding and hi == 0:
+        unb_lo = lo == Window.unboundedPreceding
+        unb_hi = hi == Window.unboundedFollowing
+        if kind == "rows" and unb_lo and hi == 0:
             frame = "rows_current"
-        elif (kind == "rows" and lo == Window.unboundedPreceding
-              and hi == Window.unboundedFollowing):
+        elif unb_lo and unb_hi:
             frame = "partition"
-        elif kind == "rows" and bounded and lo <= hi:
+        elif kind == "rows" and lo <= hi:
             # sliding frame, e.g. rowsBetween(-3, 0) — rolling kernels
-            # [REF: cudf rolling / GpuWindowExpression bounded frames]
+            # [REF: cudf rolling / GpuWindowExpression bounded frames];
+            # an unbounded end clamps to the partition edge in the
+            # kernel, so it rides the same path
             frame = "rows_bounded"
-            frame_lo, frame_hi = int(lo), int(hi)
+            cap = 1 << 30  # past any batch size; int32-safe in kernels
+            frame_lo = max(int(lo), -cap)
+            frame_hi = min(int(hi), cap)
+        elif kind == "range" and unb_lo and hi == 0:
+            frame = "range_current"
+        elif kind == "range" and lo <= hi:
+            frame = "range_bounded"
+            frame_lo = None if unb_lo else int(lo)
+            frame_hi = None if unb_hi else int(hi)
+            if not orders or len(orders) != 1:
+                raise AnalysisException(
+                    "RANGE frame with offsets requires exactly one "
+                    "ORDER BY expression")
+            okey = orders[0]
+            if not (T.is_integral(okey.expr.dtype)
+                    or isinstance(okey.expr.dtype, T.DateType)):
+                raise AnalysisException(
+                    "RANGE frame offsets need an integral or date "
+                    f"ORDER BY key, got {okey.expr.dtype.simple_name}")
         else:
             raise AnalysisException(
                 f"unsupported window frame {spec.frame} (supported: "
                 "ROWS unboundedPreceding..currentRow, "
-                "unbounded..unbounded, and bounded rowsBetween(a, b))")
+                "unbounded..unbounded, bounded rowsBetween(a, b), and "
+                "rangeBetween over one integral/date ORDER BY key)")
 
     if fu.op == "winfn":
         kind = fu.payload[0]
@@ -420,9 +454,22 @@ def resolve_window(u: UExpr, schema: T.StructType):
         if kind in ("row_number", "rank", "dense_rank"):
             wf = L.WindowFunctionSpec(kind, None, T.IntegerT, frame=frame)
             name = f"{kind}()"
+        elif kind in ("percent_rank", "cume_dist"):
+            wf = L.WindowFunctionSpec(kind, None, T.DoubleT, frame=frame)
+            name = f"{kind}()"
+        elif kind == "ntile":
+            n = int(fu.payload[1])
+            if n <= 0:
+                raise AnalysisException("ntile() needs a positive bucket "
+                                        "count")
+            wf = L.WindowFunctionSpec(kind, None, T.IntegerT, offset=n,
+                                      frame=frame)
+            name = f"ntile({n})"
         else:  # lag / lead
             child = resolve(fu.children[0], schema)
             offset = int(fu.payload[1])
+            ignore_nulls = bool(fu.payload[2]) if len(fu.payload) > 2 \
+                else False
             # Spark's default name keeps the user's spelling, even when a
             # negative offset normalizes lag <-> lead below
             name = f"{kind}({fu.children[0]}, {fu.payload[1]})"
@@ -430,7 +477,8 @@ def resolve_window(u: UExpr, schema: T.StructType):
                 kind = "lead" if kind == "lag" else "lag"
                 offset = -offset
             wf = L.WindowFunctionSpec(kind, child, child.dtype,
-                                      offset=offset, frame=frame)
+                                      offset=offset, frame=frame,
+                                      ignore_nulls=ignore_nulls)
     elif fu.op == "agg":
         kind = fu.payload
         if kind == "count_star":

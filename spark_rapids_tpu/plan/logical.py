@@ -126,12 +126,16 @@ class WindowFunctionSpec:
     kind: str
     child: Optional[Expression]
     dtype: T.DataType
-    offset: int = 1          # lag/lead
+    offset: int = 1          # lag/lead offset; ntile bucket count
     frame: str = "partition"
     # rows_bounded frame offsets relative to the current row
-    # (negative = preceding), e.g. rowsBetween(-2, 0) → lo=-2, hi=0
-    frame_lo: int = 0
-    frame_hi: int = 0
+    # (negative = preceding), e.g. rowsBetween(-2, 0) → lo=-2, hi=0;
+    # for range_bounded they are ORDER-value offsets, and None means
+    # unbounded on that end
+    frame_lo: Optional[int] = 0
+    frame_hi: Optional[int] = 0
+    # lead/lag IGNORE NULLS: step over null values
+    ignore_nulls: bool = False
 
 
 @dataclasses.dataclass

@@ -277,9 +277,20 @@ def _dynamic_partition_pruning(join: L.Join,
         candidates.append(("right", join.right, join.right_keys,
                            join.left, join.left_keys))
     for side, probe, probe_keys, build, build_keys in candidates:
-        filters, rel = _filter_chain(probe)
-        if (rel is None or not rel.partition_values or rel.dpp is not None
-                or rel.columns is not None):
+        # column pruning may have left a Project head over the (already
+        # narrowed) relation — peel it and map key indices through its
+        # exprs; _prune_relation preserves the [data..., partition...,
+        # file_name] layout, so the index math below still holds (a
+        # Project head between scan and join used to disable DPP
+        # entirely — missed file pruning)
+        proj = None
+        inner = probe
+        if isinstance(inner, L.Project):
+            proj = inner
+            inner = inner.child
+        filters, rel = _filter_chain(inner)
+        if (rel is None or not rel.partition_values
+                or rel.dpp is not None):
             continue
         # the subquery executes host-side before the scan pumps — only
         # worth it (and only safe) for broadcast-sized build sides, the
@@ -292,6 +303,10 @@ def _dynamic_partition_pruning(join: L.Join,
         for ki, key in enumerate(probe_keys):
             if not isinstance(key, E.BoundReference):
                 continue
+            if proj is not None:
+                key = proj.exprs[key.index]
+                if not isinstance(key, E.BoundReference):
+                    continue
             if not (n_data <= key.index
                     < n_data + len(rel.partition_fields)):
                 continue
@@ -302,6 +317,8 @@ def _dynamic_partition_pruning(join: L.Join,
                 T.StructType((T.StructField("_dpp_key", bkey.dtype),)))
             new_rel = dataclasses.replace(rel, dpp=(sub, col_name))
             new_probe = _rebuild_chain(filters, new_rel)
+            if proj is not None:
+                new_probe = dataclasses.replace(proj, child=new_probe)
             if side == "left":
                 return dataclasses.replace(join, left=new_probe)
             return dataclasses.replace(join, right=new_probe)

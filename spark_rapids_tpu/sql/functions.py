@@ -254,9 +254,29 @@ def dense_rank() -> Column:
     return Column(UExpr("winfn", ("dense_rank",)))
 
 
-def lag(c, offset: int = 1) -> Column:
-    return Column(UExpr("winfn", ("lag", offset), (_cu(c),)))
+def lag(c, offset: int = 1, default=None,
+        ignorenulls: bool = False) -> Column:
+    if default is not None:
+        raise NotImplementedError("lag default value not supported")
+    return Column(UExpr("winfn", ("lag", offset, ignorenulls),
+                        (_cu(c),)))
 
 
-def lead(c, offset: int = 1) -> Column:
-    return Column(UExpr("winfn", ("lead", offset), (_cu(c),)))
+def lead(c, offset: int = 1, default=None,
+         ignorenulls: bool = False) -> Column:
+    if default is not None:
+        raise NotImplementedError("lead default value not supported")
+    return Column(UExpr("winfn", ("lead", offset, ignorenulls),
+                        (_cu(c),)))
+
+
+def ntile(n: int) -> Column:
+    return Column(UExpr("winfn", ("ntile", int(n))))
+
+
+def percent_rank() -> Column:
+    return Column(UExpr("winfn", ("percent_rank",)))
+
+
+def cume_dist() -> Column:
+    return Column(UExpr("winfn", ("cume_dist",)))

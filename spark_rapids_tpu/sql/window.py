@@ -34,6 +34,9 @@ class WindowSpec:
     def rowsBetween(self, start: int, end: int) -> "WindowSpec":
         return dataclasses.replace(self, frame=("rows", start, end))
 
+    def rangeBetween(self, start: int, end: int) -> "WindowSpec":
+        return dataclasses.replace(self, frame=("range", start, end))
+
 
 def _col_u(c) -> UExpr:
     if isinstance(c, str):

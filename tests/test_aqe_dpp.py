@@ -175,3 +175,17 @@ def test_dpp_left_join_prunes_right_only(fact_dir):
     matched = [r for r in out.column("name").to_pylist()
                if r is not None]
     assert len(matched) == 400
+
+
+def test_dpp_survives_column_pruning(fact_dir):
+    """A projection head between scan and join used to disable DPP
+    (ADVICE r3: rel.columns check) — pruning must still fire."""
+    s = tpu_session()
+    fact = s.read.parquet(fact_dir).select("part", "x")
+    df = fact.join(_dim(s), on="part", how="inner")
+    out = df.toArrow()
+    assert out.num_rows == 400
+    scan = _find(df._last_plan, "TpuParquetScanExec")
+    assert scan is not None
+    assert scan.metrics["dppPrunedFiles"].value == 8, (
+        scan.metrics["dppPrunedFiles"].value)

@@ -225,11 +225,23 @@ def test_window_avg_double():
         approx_float=True)
 
 
-def test_window_unsupported_frame_raises():
-    t = gen_table(11, n=20)
-    # currentRow..unboundedFollowing is still unsupported
+def test_window_rows_current_to_unbounded_following():
+    # currentRow..unboundedFollowing now rides the bounded-rows kernel
+    # (the unbounded end clamps to the partition edge)
+    t = gen_table(11, n=200)
     w = (Window.partitionBy("k").orderBy("o")
          .rowsBetween(0, Window.unboundedFollowing))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", F.sum("v").over(w).alias("x")),
+        approx_float=True)
+
+
+def test_window_unsupported_frame_raises():
+    t = gen_table(11, n=20)
+    # RANGE offsets need a single integral/date ORDER BY key
+    w = (Window.partitionBy("k").orderBy("o", "v")
+         .rangeBetween(-2, 2))
 
     def build(s):
         return s.createDataFrame(t).select(
@@ -324,3 +336,77 @@ def test_bounded_rows_frame_nan_inf_isolated():
     assert rows[2] == 3.0          # frame (1,2): finite
     assert rows[5] == 11.0         # frame (4,5): finite after the Inf
     assert rows[3] == float("inf")
+
+
+# -- round-4 window tail: bounded min/max/first, RANGE frames, ranking
+# functions, ignore-nulls lead/lag [REF: GpuWindowExpression.scala]
+
+@pytest.mark.parametrize("fn", ["min", "max", "first"])
+def test_bounded_rows_min_max_first(fn):
+    t = gen_table(21, n=400)
+    w = Window.partitionBy("k").orderBy("o", "v").rowsBetween(-3, 1)
+    f = getattr(F, fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v", f("v").over(w).alias("x")),
+        approx_float=True)
+
+
+@pytest.mark.parametrize("fn", ["min", "max"])
+def test_bounded_rows_minmax_double_nan(fn):
+    t = gen_table(22, n=300)
+    w = Window.partitionBy("k").orderBy("o", "v").rowsBetween(-2, 2)
+    f = getattr(F, fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v", f("d").over(w).alias("x")),
+        approx_float=True)
+
+
+@pytest.mark.parametrize("fn", ["sum", "count", "avg", "min", "max",
+                                "first"])
+def test_range_bounded_frames(fn):
+    t = gen_table(23, n=400)
+    w = Window.partitionBy("k").orderBy("o").rangeBetween(-4, 3)
+    f = getattr(F, fn)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v", f("v").over(w).alias("x")),
+        approx_float=True, ignore_order=True)
+
+
+def test_range_unbounded_ends():
+    t = gen_table(24, n=300)
+    w1 = (Window.partitionBy("k").orderBy("o")
+          .rangeBetween(Window.unboundedPreceding, 2))
+    w2 = (Window.partitionBy("k").orderBy("o")
+          .rangeBetween(-1, Window.unboundedFollowing))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", F.sum("v").over(w1).alias("a"),
+            F.count("v").over(w2).alias("b")),
+        approx_float=True, ignore_order=True)
+
+
+def test_ntile_percent_rank_cume_dist():
+    t = gen_table(25, n=400)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", F.ntile(4).over(w).alias("nt"),
+            F.percent_rank().over(w).alias("pr"),
+            F.cume_dist().over(w).alias("cd")),
+        approx_float=True)
+
+
+@pytest.mark.parametrize("kind,offset", [("lag", 1), ("lag", 2),
+                                         ("lead", 1), ("lead", 3)])
+def test_lead_lag_ignore_nulls(kind, offset):
+    t = gen_table(26, n=300)
+    w = Window.partitionBy("k").orderBy("o", "v")
+    f = getattr(F, kind)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", f("s", offset, ignorenulls=True).over(w)
+            .alias("x")),
+        approx_float=True)
