@@ -351,14 +351,18 @@ def _sf1_query_main(name: str) -> None:
     dfq.toArrow()  # warm (compile)
     t, _ = timed(lambda: dfq.toArrow(), reps=2)
     print(f"TPCH_SF1_SECONDS={t:.3f}")
+    # the honest progress meter for operator breadth: how much of this
+    # query's plan ran on device [REF: ExplainPlanImpl as a metric]
+    print("TPCH_SF1_FALLBACK=" + json.dumps(dfq.fallback_summary()))
 
 
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
+    """Returns (seconds | None, fallback_summary | None)."""
     import subprocess
     budget_s = min(SF1_QUERY_BUDGET_S, budget_s)
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
-        return None
+        return None, None
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
@@ -367,14 +371,19 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             timeout=budget_s)
     except subprocess.TimeoutExpired:
         mark(f"{name}: timed out after {budget_s:.0f}s (compile budget)")
-        return None
+        return None, None
+    secs = fb = None
     for line in (out.stdout or "").splitlines():
         if line.startswith("TPCH_SF1_SECONDS="):
-            return round(float(line.split("=", 1)[1]), 3)
+            secs = round(float(line.split("=", 1)[1]), 3)
+        elif line.startswith("TPCH_SF1_FALLBACK="):
+            fb = json.loads(line.split("=", 1)[1])
+    if secs is not None:
+        return secs, fb
     # crashed child: surface the failure, don't blur it into a timeout
     mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
          + (out.stderr or "")[-500:].replace("\n", " | "))
-    return None
+    return None, None
 
 
 def main():
@@ -434,6 +443,7 @@ def main():
 
     checked = {}
     times = {name: None for name in TPCH_BUILDERS}
+    fallbacks = {name: None for name in TPCH_BUILDERS}
     result = {
         "metric": "tpch_q6_throughput",
         "value": round(ROWS / t_tpu / 1e6, 2),
@@ -452,6 +462,7 @@ def main():
         "plan_pump_ms": round(t_pump * 1e3, 1),
         "input_bytes": in_bytes,
         "tpch_sf1_seconds": times,
+        "tpch_sf1_fallback": fallbacks,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
     }
@@ -482,7 +493,8 @@ def main():
         # and the bench still completes; the persistent XLA cache keeps
         # whatever finished compiling, so later runs get further.
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
-        times[name] = _sf1_query_subprocess(name, mark, remaining)
+        times[name], fallbacks[name] = _sf1_query_subprocess(
+            name, mark, remaining)
         mark(f"{name} sf1: {times[name]}s")
         emit()
 

@@ -34,8 +34,9 @@ from spark_rapids_tpu.exec.base import CpuExec, TpuExec
 from spark_rapids_tpu.exec.basic import concat_device_batches
 from spark_rapids_tpu.ops import ordering as ORD
 from spark_rapids_tpu.ops.aggregates import (
-    AggregateFunction, Average, CollectList, Count, CountStar, First,
-    Max, Min, Sum, _VarianceBase)
+    AggregateFunction, ApproxPercentile, Average, CollectList,
+    CollectSet, Count, CountStar, First, Max, Min, Percentile, Sum,
+    _VarianceBase)
 from spark_rapids_tpu.ops.expressions import Expression
 from spark_rapids_tpu.plan import logical as L
 
@@ -105,8 +106,7 @@ def segment_groupby(
     front in group order.
     """
     b = int(sel.shape[0])
-    parts = [ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols))
-    limbs = ORD.fuse_parts(parts)
+    limbs, _ = ORD.group_sort_limbs(list(key_cols), sel)
     sorted_limbs, perm = ORD.sort_by_keys(limbs)
 
     live_sorted = jnp.take(sel, perm)
@@ -287,27 +287,27 @@ def segment_max_group_count(key_cols, sel, contribs) -> jnp.ndarray:
     return out
 
 
-def segment_collect(key_cols, sel, value_col: DeviceColumn, cap: int
-                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """collect_list over sorted groups → (matrix [B, cap], lengths [B])
-    in the SAME compacted group order as ``segment_groupby``.
+def _sorted_group_layout(key_cols, sel, value_col: DeviceColumn,
+                         value_order: bool):
+    """Shared skeleton of the holistic aggregates: stable sort on
+    (exclusion, keys, value-invalid[, value]), per-group starts/valid
+    counts compacted to group order via the END-rows-to-front trick.
 
-    Scatter-free: a stable sort on (exclusion, keys, value-invalid)
-    makes each group's valid values contiguous from its group start, so
-    list g is one shifted gather.  Null values are skipped (Spark
-    collect_list semantics)."""
+    Returns (values_sorted, contrib_sorted, sorted_limbs, boundary,
+    start_scan, perm, perm2) — ``perm2`` maps compacted group g to its
+    end row (same group order as ``segment_groupby``)."""
     b = int(sel.shape[0])
     contrib = sel & value_col.valid_mask()
-    parts = ([ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols))
-             + [ORD._flag_part(~contrib)])
-    limbs = ORD.fuse_parts(parts)
+    tail_parts = [ORD._flag_part(~contrib)]
+    if value_order:
+        tail_parts = tail_parts + ORD.column_order_parts(
+            value_col, True, True, distinguish_neg_zero=False)
+    limbs, key_limbs = ORD.group_sort_limbs(list(key_cols), sel,
+                                            tail_parts)
     sorted_limbs, perm = ORD.sort_by_keys(limbs)
     live_sorted = jnp.take(sel, perm)
-    # boundaries over the KEY limbs only (exclusion flag shares limb 0's
-    # top bit; the trailing contrib flag must NOT split groups) — rebuild
-    # boundary from the key-only limb fusion evaluated in sorted order
-    key_limbs = ORD.fuse_parts(
-        [ORD._flag_part(~sel)] + ORD.batch_group_parts(list(key_cols)))
+    # boundaries over the KEY limbs only (trailing contrib/value parts
+    # must NOT split groups)
     key_sorted = [jnp.take(l, perm) for l in key_limbs]
     diff = jnp.zeros((b,), jnp.bool_)
     for l in key_sorted:
@@ -316,15 +316,51 @@ def segment_collect(key_cols, sel, value_col: DeviceColumn, cap: int
     is_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
     rank = (~(is_end & live_sorted)).astype(jnp.uint8)
     _, perm2 = ORD.sort_by_keys([rank])
-
     iota = jnp.arange(b, dtype=jnp.int32)
     start_scan = segmented_scan(_keep_first, iota, boundary)
     contrib_sorted = jnp.take(contrib, perm)
-    n_contrib = segmented_scan(jnp.add, contrib_sorted.astype(jnp.int32),
-                               boundary)
-    starts_g = jnp.take(start_scan, perm2)
-    counts_g = jnp.take(n_contrib, perm2)
     values_sorted = jnp.take(value_col.data, perm, axis=0)
+    return (values_sorted, contrib_sorted, sorted_limbs, boundary,
+            start_scan, perm, perm2)
+
+
+def segment_collect(key_cols, sel, value_col: DeviceColumn, cap: int,
+                    distinct: bool = False
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """collect_list/collect_set over sorted groups → (matrix [B, cap],
+    lengths [B]) in the SAME compacted group order as
+    ``segment_groupby``.
+
+    Scatter-free: a stable sort on (exclusion, keys, value-invalid)
+    makes each group's valid values contiguous from its group start, so
+    list g is one shifted gather.  Null values are skipped (Spark
+    collect semantics).  ``distinct`` additionally sorts by value,
+    keeps only each run's first row, and re-packs kept rows to the
+    group front with one more stable sort (set order = value order)."""
+    b = int(sel.shape[0])
+    (values_sorted, contrib_sorted, sorted_limbs, boundary, start_scan,
+     perm, perm2) = _sorted_group_layout(key_cols, sel, value_col,
+                                         value_order=distinct)
+    keep = contrib_sorted
+    if distinct:
+        full_diff = jnp.zeros((b,), jnp.bool_)
+        for l in sorted_limbs:
+            full_diff = full_diff | ORD.limb_neq(
+                l, jnp.concatenate([l[:1], l[:-1]]))
+        keep = contrib_sorted & full_diff.at[0].set(True)
+        # re-pack kept rows to the group front (group blocks stay at
+        # the same positions: the group ordinal is the primary key and
+        # group sizes don't change, so `boundary`/`start_scan` hold)
+        grp_ord = jnp.cumsum(boundary.astype(jnp.int64)).astype(
+            jnp.uint64)
+        limbs3 = ORD.fuse_parts(
+            [(grp_ord, 64), ORD._flag_part(~keep)])
+        _, perm3 = ORD.sort_by_keys(limbs3)
+        values_sorted = jnp.take(values_sorted, perm3, axis=0)
+        keep = jnp.take(keep, perm3)
+    n_keep = segmented_scan(jnp.add, keep.astype(jnp.int32), boundary)
+    starts_g = jnp.take(start_scan, perm2)
+    counts_g = jnp.take(n_keep, perm2)
     idx = starts_g[:, None] + jnp.arange(cap, dtype=jnp.int32)[None, :]
     mat = jnp.take(values_sorted, jnp.clip(idx, 0, b - 1).reshape(-1),
                    axis=0).reshape((b, cap) + values_sorted.shape[1:])
@@ -332,6 +368,45 @@ def segment_collect(key_cols, sel, value_col: DeviceColumn, cap: int
     zero = jnp.zeros((), values_sorted.dtype)
     mat = jnp.where(mask, mat, zero)
     return mat, counts_g.astype(jnp.int32)
+
+
+def segment_percentile(key_cols, sel, value_col: DeviceColumn,
+                       pct: float, interpolate: bool
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """percentile / approx_percentile over value-sorted groups →
+    (values [B], validity [B]) in compacted group order.
+
+    Exact path: Spark's rank = p·(n-1) with linear interpolation.
+    Approx path: the nearest-rank ELEMENT (ceil(p·n)-1) — zero rank
+    error, always an actual group element (see ApproxPercentile)."""
+    b = int(sel.shape[0])
+    (values_sorted, contrib_sorted, _limbs, boundary, start_scan,
+     perm, perm2) = _sorted_group_layout(key_cols, sel, value_col,
+                                         value_order=True)
+    n_contrib = segmented_scan(jnp.add, contrib_sorted.astype(jnp.int32),
+                               boundary)
+    starts_g = jnp.take(start_scan, perm2)
+    counts_g = jnp.take(n_contrib, perm2)
+    nonempty = counts_g > 0
+    if interpolate:
+        r = jnp.float64(pct) * jnp.maximum(counts_g - 1, 0).astype(
+            jnp.float64)
+        lo = jnp.floor(r)
+        vlo = jnp.take(values_sorted, jnp.clip(
+            starts_g + lo.astype(jnp.int32), 0, b - 1)).astype(
+                jnp.float64)
+        vhi = jnp.take(values_sorted, jnp.clip(
+            starts_g + jnp.ceil(r).astype(jnp.int32), 0, b - 1)).astype(
+                jnp.float64)
+        out = vlo + (r - lo) * (vhi - vlo)
+        return out, nonempty
+    idx = jnp.clip(jnp.ceil(jnp.float64(pct)
+                            * counts_g.astype(jnp.float64))
+                   .astype(jnp.int32) - 1, 0,
+                   jnp.maximum(counts_g - 1, 0))
+    out = jnp.take(values_sorted,
+                   jnp.clip(starts_g + idx, 0, b - 1))
+    return out, nonempty
 
 
 def _reduce_column(data: jnp.ndarray, valid: jnp.ndarray,
@@ -560,7 +635,8 @@ class TpuHashAggregateExec(TpuExec):
 
     @property
     def _has_collect(self) -> bool:
-        return any(isinstance(f, CollectList) for f in self.fns)
+        return any(isinstance(f, (CollectList, Percentile))
+                   for f in self.fns)
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         if self.mode != "complete":
@@ -571,13 +647,14 @@ class TpuHashAggregateExec(TpuExec):
         src, pre, pre_key = fuse_upstream(self.children[0])
         with self.timer():
             if self._has_collect:
-                out = self._execute_collect(src, pre, pre_key)
+                outs = [self._execute_collect(src, pre, pre_key)]
             elif not self.grouping:
-                out = self._execute_global(src, pre, pre_key)
+                outs = [self._execute_global(src, pre, pre_key)]
             else:
-                out = self._execute_grouped(src, pre, pre_key)
-        self.metric("numOutputBatches").add(1)
-        yield out
+                outs = self._execute_grouped(src, pre, pre_key)
+        for out in outs:
+            self.metric("numOutputBatches").add(1)
+            yield out
 
     def _execute_collect(self, src, pre, pre_key) -> DeviceBatch:
         """collect_list path: single kernel over the gathered input
@@ -600,21 +677,26 @@ class TpuHashAggregateExec(TpuExec):
             base_key = (pre_key, has_nans, fingerprint(grouping),
                         fingerprint(fns), fingerprint(schema))
 
-            def build_count():
-                def run(m):
-                    if pre is not None:
-                        m = pre(m)
-                    keys = [g.eval_tpu(m) for g in grouping]
-                    contribs = [
-                        f.child.eval_tpu(m).valid_mask()
-                        for f in fns if isinstance(f, CollectList)]
-                    return segment_max_group_count(keys, m.sel, contribs)
-                return run
+            has_lists = any(isinstance(f, CollectList) for f in fns)
+            cap = 1
+            if has_lists:
+                def build_count():
+                    def run(m):
+                        if pre is not None:
+                            m = pre(m)
+                        keys = [g.eval_tpu(m) for g in grouping]
+                        contribs = [
+                            f.child.eval_tpu(m).valid_mask()
+                            for f in fns if isinstance(f, CollectList)]
+                        return segment_max_group_count(keys, m.sel,
+                                                       contribs)
+                    return run
 
-            cnt_fn = cached_kernel(("agg_collect_count",) + base_key,
-                                   build_count)
-            cap = int(np.asarray(cnt_fn(merged)))
-            cap = max(1, 1 << (cap - 1).bit_length() if cap > 1 else 1)
+                cnt_fn = cached_kernel(
+                    ("agg_collect_count",) + base_key, build_count)
+                cap = int(np.asarray(cnt_fn(merged)))
+                cap = max(1, 1 << (cap - 1).bit_length()
+                          if cap > 1 else 1)
 
             def build_main():
                 def run(m):
@@ -622,7 +704,8 @@ class TpuHashAggregateExec(TpuExec):
                         m = pre(m)
                     keys = [g.eval_tpu(m) for g in grouping]
                     normal = [f for f in fns
-                              if not isinstance(f, CollectList)]
+                              if not isinstance(f,
+                                                (CollectList, Percentile))]
                     vals = update_value_cols(normal, m)
                     ok, ov, sel = segment_groupby(keys, m.sel, vals,
                                                   has_nans=has_nans)
@@ -631,9 +714,18 @@ class TpuHashAggregateExec(TpuExec):
                     for f in fns:
                         if isinstance(f, CollectList):
                             mat, lens = segment_collect(
-                                keys, m.sel, f.child.eval_tpu(m), cap)
+                                keys, m.sel, f.child.eval_tpu(m), cap,
+                                distinct=isinstance(f, CollectSet))
                             cols.append(DeviceColumn(
                                 f.result_dtype, mat, None, lens))
+                        elif isinstance(f, Percentile):
+                            v, vv = segment_percentile(
+                                keys, m.sel, f.child.eval_tpu(m),
+                                f.pct,
+                                interpolate=not isinstance(
+                                    f, ApproxPercentile))
+                            cols.append(DeviceColumn(
+                                f.result_dtype, v, vv))
                         else:
                             cols.append(next(normal_res))
                     return DeviceBatch(schema, tuple(cols), sel,
@@ -683,7 +775,7 @@ class TpuHashAggregateExec(TpuExec):
             manager=mgr))
         return self._reduce_merge_final(partials)
 
-    def _execute_grouped(self, src, pre, pre_key) -> DeviceBatch:
+    def _execute_grouped(self, src, pre, pre_key) -> List[DeviceBatch]:
         """Update-per-batch under the OOM-retry framework: a RetryOOM
         spills the arbiter's pool and re-runs the batch; repeated
         pressure halves it by rows (partials merge regardless — the
@@ -707,10 +799,55 @@ class TpuHashAggregateExec(TpuExec):
             from spark_rapids_tpu.columnar.column import empty_batch
             partials.append(self._partial(
                 empty_batch(src.schema), pre, pre_key))
+        return self._merge_bounded(partials, self._merge_final)
+
+    def _merge_bounded(self, partials: List[DeviceBatch],
+                       merge_fn) -> List[DeviceBatch]:
+        """Concat + merge partial buffer batches, with the
+        merge-explosion repartition fallback [REF: GpuAggregateExec
+        repartition fallback]: when merged cardinality ≈ input (total
+        live rows far exceed one batch bucket), one concat would build
+        — and compile a merge kernel for — an exploded bucket; instead
+        the partials re-hash-partition by grouping key and each bucket
+        merges independently (equal keys share a bucket, so semantics
+        hold per bucket)."""
         from spark_rapids_tpu.columnar.column import compact
-        merged = concat_device_batches(
-            self._buffer_schema(), [compact(p) for p in partials])
-        return self._merge_final(merged)
+        from spark_rapids_tpu.exec.basic import _overlapped_live_counts
+        partials = [compact(p) for p in partials]
+        if len(partials) == 1:
+            return [merge_fn(partials[0])]
+        schema = self._buffer_schema()
+        if len(partials) <= 2:
+            return [merge_fn(concat_device_batches(schema, partials))]
+        counts = _overlapped_live_counts(partials)
+        total = sum(counts)
+        cap = max(b.capacity for b in partials)
+        if total <= 2 * cap:
+            return [merge_fn(concat_device_batches(schema, partials,
+                                                   counts=counts))]
+        self.metric("repartitionMerges").add(1)
+        from spark_rapids_tpu.ops.expressions import BoundReference
+        from spark_rapids_tpu.parallel.shuffle import (
+            make_pid_fn, split_to_spillables)
+        from spark_rapids_tpu.runtime.kernel_cache import fingerprint
+        from spark_rapids_tpu.runtime.memory import get_manager
+        mgr = get_manager()
+        k = int(min(64, max(2, -(-total // cap))))
+        keys = [BoundReference(i, g.dtype)
+                for i, g in enumerate(self.grouping)]
+        pid_fn = make_pid_fn(keys, k)
+        slices = split_to_spillables(
+            partials, lambda b, aux: pid_fn(b), k, mgr,
+            ("aggrepart", k, fingerprint(keys), fingerprint(schema)))
+        out = []
+        for i in range(k):
+            if not slices[i]:
+                continue
+            bs = [s.get() for s in slices[i]]
+            out.append(merge_fn(concat_device_batches(schema, bs)))
+            for s in slices[i]:
+                s.close()
+        return out
 
     def _execute_staged(self, partition: int) -> Iterator[DeviceBatch]:
         """partial/final modes: operate on ONE child partition's stream
@@ -736,22 +873,18 @@ class TpuHashAggregateExec(TpuExec):
                     yield empty_batch(self._buffer_schema())
                     return
                 if len(partials) == 1:
-                    out = partials[0]
+                    outs = [partials[0]]
                 else:
-                    merged = concat_device_batches(
-                        self._buffer_schema(),
-                        [compact(p) for p in partials])
-                    out = self._merge_buffers(merged)
+                    outs = self._merge_bounded(partials,
+                                               self._merge_buffers)
             else:  # final
                 batches = [compact(b) for b in child.execute(partition)]
                 if not batches:
                     return
-                merged = (batches[0] if len(batches) == 1 else
-                          concat_device_batches(self._buffer_schema(),
-                                                batches))
-                out = self._merge_final(merged)
-        self.metric("numOutputBatches").add(1)
-        yield out
+                outs = self._merge_bounded(batches, self._merge_final)
+        for out in outs:
+            self.metric("numOutputBatches").add(1)
+            yield out
 
     def _merge_buffers(self, merged: DeviceBatch) -> DeviceBatch:
         """Merge buffer batches into one buffer batch (no final project):
@@ -988,7 +1121,7 @@ def _acc_update(acc, fn, vc, i):
         delta = float(v) - acc["mean"]
         acc["mean"] += delta / acc["count"]
         acc["m2"] += delta * (float(v) - acc["mean"])
-    elif isinstance(fn, CollectList):
+    elif isinstance(fn, (CollectList, Percentile)):
         acc["list"].append(vc.data[i])
     elif isinstance(fn, Min):
         acc["min"] = v if acc["min"] is None else _spark_min(acc["min"], v, fn)
@@ -1035,8 +1168,33 @@ def _acc_final(acc, fn):
         import math
         return math.sqrt(var) if fn.sqrt_final and var == var else (
             float("nan") if fn.sqrt_final else var)
+    if isinstance(fn, CollectSet):
+        dt = fn.input_dtype
+        uniq = {}
+        for v in acc["list"]:
+            uniq.setdefault(_total_key(v, dt), v)
+        return [_py_scalar(uniq[k], dt) for k in sorted(uniq)]
     if isinstance(fn, CollectList):
         return [_py_scalar(v, fn.input_dtype) for v in acc["list"]]
+    if isinstance(fn, ApproxPercentile):
+        vals = sorted(acc["list"],
+                      key=lambda v: _total_key(v, fn.input_dtype))
+        if not vals:
+            return None
+        import math
+        idx = min(max(math.ceil(fn.pct * len(vals)) - 1, 0),
+                  len(vals) - 1)
+        return _py_scalar(vals[idx], fn.input_dtype)
+    if isinstance(fn, Percentile):
+        vals = sorted((float(v) for v in acc["list"]),
+                      key=lambda x: _total_key(x, T.DoubleT))
+        if not vals:
+            return None
+        import math
+        r = fn.pct * (len(vals) - 1)
+        lo = math.floor(r)
+        hi = math.ceil(r)
+        return vals[lo] + (r - lo) * (vals[hi] - vals[lo])
     if isinstance(fn, Min):
         return acc["min"]
     if isinstance(fn, Max):

@@ -521,7 +521,9 @@ def _overlapped_live_counts(batches) -> List[int]:
 
 
 def _concat_compacted_fast(schema: T.StructType,
-                           batches: List[DeviceBatch]) -> DeviceBatch:
+                           batches: List[DeviceBatch],
+                           counts: Optional[List[int]] = None
+                           ) -> DeviceBatch:
     """Dispatch-bounded concat of COMPACTED batches.
 
     1. live counts for ALL batches pulled with one overlapped transfer
@@ -537,7 +539,8 @@ def _concat_compacted_fast(schema: T.StructType,
     from spark_rapids_tpu.columnar.column import compact as _compact
     from spark_rapids_tpu.runtime.kernel_cache import (
         cached_kernel, fingerprint)
-    counts = _overlapped_live_counts(batches)
+    if counts is None:
+        counts = _overlapped_live_counts(batches)
     total = sum(counts)
     out_bucket = round_up_pow2(max(total, 1))
     nfields = len(schema.fields)
@@ -656,15 +659,16 @@ def concat_device_batches(schema: T.StructType,
     if (len(batches) == 1 and bucket is None and min_width == 0
             and force_validity is None):
         return batches[0]
-    if (counts is None and bucket is None and min_width == 0
+    if (bucket is None and min_width == 0
             and force_validity is None and len(batches) > 2
             and all(b.compacted for b in batches)):
         # many-batch gathers (partial-agg merges, join/sort gathers) pay
         # O(batches) tunnel syncs + O(batches × leaves) eager slices on
         # the sequential path below — ~15s of a 16s TPC-H q1 on the
         # tunnel.  The fast path pulls every count in ONE overlapped
-        # round trip and keeps per-batch work to one cached kernel.
-        return _concat_compacted_fast(schema, batches)
+        # round trip (reusing caller-tracked counts when given) and
+        # keeps per-batch work to one cached kernel.
+        return _concat_compacted_fast(schema, batches, counts)
     if counts is None:
         counts = _overlapped_live_counts(batches)
     total = sum(counts)

@@ -759,6 +759,14 @@ class CpuWindowExec(CpuExec):
                 ovalid = (np.ones(n, bool) if oc.validity is None
                           else oc.validity[perm])
                 nf = self.order_by[0].nulls_first
+                # offsets are in ORDER direction: under DESC, "x
+                # preceding" means LARGER values — the value window
+                # flips to [v - hi, v - lo]
+                if self.order_by[0].ascending:
+                    vlo, vhi = wf.frame_lo, wf.frame_hi
+                else:
+                    vlo = None if wf.frame_hi is None else -wf.frame_hi
+                    vhi = None if wf.frame_lo is None else -wf.frame_lo
                 for pi in range(len(peer_starts) - 1):
                     for i in range(peer_starts[pi], peer_starts[pi + 1]):
                         acc = _new_acc(fobj)
@@ -771,12 +779,11 @@ class CpuWindowExec(CpuExec):
                             frame = []
                             for j in range(lo, hi):
                                 if ovalid[j]:
-                                    if ((wf.frame_lo is None
-                                         or int(ov[j])
-                                         >= v + wf.frame_lo)
-                                            and (wf.frame_hi is None
+                                    if ((vlo is None
+                                         or int(ov[j]) >= v + vlo)
+                                            and (vhi is None
                                                  or int(ov[j])
-                                                 <= v + wf.frame_hi)):
+                                                 <= v + vhi)):
                                         frame.append(j)
                                 # an unbounded end reaches the nulls on
                                 # that side of the partition

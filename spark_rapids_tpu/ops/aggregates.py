@@ -223,3 +223,52 @@ class CollectList(AggregateFunction):
 
     def buffer_dtypes(self):
         return [self.result_dtype]
+
+
+class CollectSet(CollectList):
+    """collect_set(x) → array<x> — each group's DISTINCT values.
+
+    Spark leaves set element order undefined; here both the device
+    kernel and the CPU oracle emit ascending value order (sorted-group
+    dedup falls out of the same stable sort the collect path already
+    pays for).  [REF: GpuCollectSet]
+    """
+
+    name = "collect_set"
+
+
+@dataclasses.dataclass
+class Percentile(AggregateFunction):
+    """percentile(x, p) — EXACT percentile with linear interpolation,
+    computed holistically over value-sorted groups (one stable sort +
+    two gathers — no scatter).  [REF: GpuPercentileDefault]"""
+
+    pct: float = 0.5
+    name = "percentile"
+    buffer_kinds = ["collect"]  # holistic: whole-agg single kernel
+
+    @property
+    def result_dtype(self):
+        return T.DoubleT
+
+    def buffer_dtypes(self):
+        return [self.result_dtype]
+
+
+@dataclasses.dataclass
+class ApproxPercentile(Percentile):
+    """approx_percentile(x, p[, accuracy]) — nearest-rank percentile.
+
+    The reference sketches with t-digest; this engine computes the
+    holistic nearest-rank element directly (a zero-rank-error answer is
+    inside any accuracy bound, so results can differ from Spark's
+    t-digest OUTPUT while being at least as accurate; the value is
+    always an actual element of the group).  [REF:
+    ApproxPercentileFromTDigest]"""
+
+    accuracy: int = 10000
+    name = "approx_percentile"
+
+    @property
+    def result_dtype(self):
+        return self.input_dtype

@@ -215,6 +215,73 @@ def batch_group_parts(cols: List[DeviceColumn]) -> List[Part]:
     return out
 
 
+# above this many fused limbs, group sorts switch to the 128-bit
+# key-tuple hash: lax.sort compile cost grows superlinearly PER OPERAND
+# on TPU (measured: ~21 s at 2 operands; a ~10-limb multi-string key
+# set ran >25 min without finishing)
+GROUP_HASH_LIMB_CAP = 3
+
+
+def group_sort_limbs(cols: List[DeviceColumn], sel,
+                     tail_parts: List[Part] = ()
+                     ) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
+    """(sort limbs, key-only limbs) for GROUP BY segmentation.
+
+    Narrow key tuples keep the exact lexicographic encoding (group
+    output order = key order, stable for existing behavior), with any
+    ``tail_parts`` (contrib flags, value order) fused into the same
+    limb set's spare bits.  WIDE tuples (fused encoding >
+    GROUP_HASH_LIMB_CAP limbs — e.g. several string keys, the TPC-H
+    q10 shape) sort by a 128-bit hash of the normalized key tuple
+    instead: grouping only needs equal-keys-contiguous, a hash
+    aggregate's group order is undefined in Spark anyway, and distinct
+    keys merge only on a full 128-bit collision (~2^-128 — four
+    murmur3 passes with independent seeds).  Boundary detection must
+    use the returned KEY limbs (tail parts must not split groups).
+    """
+    key_parts = [_flag_part(~sel)] + batch_group_parts(cols)
+    exact = fuse_parts(key_parts)
+    if len(exact) <= GROUP_HASH_LIMB_CAP:
+        if not tail_parts:
+            return exact, exact
+        return fuse_parts(key_parts + list(tail_parts)), exact
+    from spark_rapids_tpu.ops import hashing as HH
+    n = int(sel.shape[0])
+
+    def tuple_hash(seed: int) -> jnp.ndarray:
+        h = jnp.full((n,), np.uint32(seed), jnp.uint32)
+        for c in cols:
+            dt = c.dtype
+            data = c.data
+            valid = c.valid_mask()
+            # the per-column null flag ALWAYS mixes in: hash_column
+            # leaves h unchanged for null rows, so without this,
+            # (null, x) and (x, null) would hash identically on every
+            # seed — a systematic merge, not a 2^-128 collision
+            h = HH._mix_h1(h, HH._mix_k1(valid.astype(jnp.uint32),
+                                         jnp), jnp)
+            if isinstance(dt, T.DoubleType):
+                from spark_rapids_tpu.parallel.shuffle import (
+                    _hash_f64_tpu_safe)
+                h = jnp.where(valid, _hash_f64_tpu_safe(data, h), h)
+                continue
+            if isinstance(dt, T.FloatType):
+                data = jnp.where(data == 0.0,
+                                 jnp.zeros((), data.dtype), data)
+            h = HH.hash_column((data, c.lengths), dt, h, valid, jnp)
+        return h
+
+    h = [tuple_hash(s).astype(jnp.uint64)
+         for s in (42, 0x5F3759DF, 0x9E3779B9, 0x85EBCA6B)]
+    h64a = (h[0] << jnp.uint64(32)) | h[1]
+    h64b = (h[2] << jnp.uint64(32)) | h[3]
+    key_limbs = fuse_parts(
+        [_flag_part(~sel), (h64a, 64), (h64b, 64)])
+    if not tail_parts:
+        return key_limbs, key_limbs
+    return key_limbs + fuse_parts(list(tail_parts)), key_limbs
+
+
 def sort_by_keys(limbs: List[jnp.ndarray], payload=None
                  ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
     """Stable lexicographic sort; returns (sorted limbs, permutation).

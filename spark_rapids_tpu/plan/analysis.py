@@ -349,7 +349,8 @@ _AGG_MAP = {"sum": A.Sum, "min": A.Min, "max": A.Max, "count": A.Count,
             "var_samp": A.VarianceSamp, "var_pop": A.VariancePop,
             "stddev_samp": A.StddevSamp, "stddev_pop": A.StddevPop,
             "count_distinct": A.CountDistinct,
-            "collect_list": A.CollectList}
+            "collect_list": A.CollectList,
+            "collect_set": A.CollectSet}
 
 
 def resolve_aggregate(u: UExpr, schema: T.StructType
@@ -363,9 +364,34 @@ def resolve_aggregate(u: UExpr, schema: T.StructType
         raise AnalysisException(
             f"agg() expects aggregate expressions, got {u}")
     kind = u.payload
+    args = ()
+    if isinstance(kind, tuple):
+        kind, args = kind[0], kind[1:]
     child = resolve(u.children[0], schema)
     if kind == "count_star":
         return A.CountStar(child), alias or "count(1)"
+    if kind in ("percentile", "approx_percentile"):
+        if not T.is_numeric(child.dtype):
+            raise AnalysisException(f"{kind} needs a numeric input")
+        if isinstance(child.dtype, T.DecimalType):
+            if kind == "approx_percentile":
+                # result type = input type; the unscaled-int64 decimal
+                # representation cannot round-trip through the kernel
+                raise AnalysisException(
+                    "approx_percentile over decimal input is not "
+                    "supported (use percentile, which returns double)")
+            child = cast_to(child, T.DoubleT)
+        pct = float(args[0])
+        if not 0.0 <= pct <= 1.0:
+            raise AnalysisException(
+                f"{kind} percentage must be in [0, 1], got {pct}")
+        if kind == "percentile":
+            fn = A.Percentile(child, pct)
+        else:
+            fn = A.ApproxPercentile(child, pct,
+                                    int(args[1]) if len(args) > 1
+                                    else 10000)
+        return fn, alias or f"{kind}({u.children[0]}, {pct})"
     if kind == "avg":
         child = cast_to(child, T.DoubleT)
     if kind == "sum" and isinstance(child.dtype,

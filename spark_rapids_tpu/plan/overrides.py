@@ -246,8 +246,8 @@ EXEC_RULES[B.CpuUnionExec] = ExecRule(
 def _tag_aggregate(meta: ExecMeta):
     from spark_rapids_tpu.exec.aggregate import CpuAggregateExec
     from spark_rapids_tpu.ops.aggregates import (
-        Average, CollectList, Count, CountStar, First, Max, Min, Sum,
-        _VarianceBase)
+        Average, CollectList, Count, CountStar, First, Max, Min,
+        Percentile, Sum, _VarianceBase)
     cpu: CpuAggregateExec = meta.cpu
     meta.tag_expressions(cpu.grouping)
     for fn in cpu.fns:
@@ -256,7 +256,8 @@ def _tag_aggregate(meta: ExecMeta):
                 "sum under spark.sql.ansi.enabled=true: device sum wraps "
                 "on overflow (non-ANSI) — CPU fallback")
         if not isinstance(fn, (Sum, Min, Max, Count, CountStar, Average,
-                               First, _VarianceBase, CollectList)):
+                               First, _VarianceBase, CollectList,
+                               Percentile)):
             meta.will_not_work(
                 f"aggregate function {fn.name} has no TPU implementation")
             continue
@@ -287,9 +288,10 @@ def _convert_aggregate(cpu, ch, conf):
     from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.aggregate import TpuHashAggregateExec
     from spark_rapids_tpu.exec.distributed import ici_active
-    from spark_rapids_tpu.ops.aggregates import CollectList
+    from spark_rapids_tpu.ops.aggregates import CollectList, Percentile
     has_nans = bool(conf.get(C.HAS_NANS))
-    has_collect = any(isinstance(f, CollectList) for f in cpu.fns)
+    has_collect = any(isinstance(f, (CollectList, Percentile))
+                      for f in cpu.fns)
     if ici_active(conf) and cpu.grouping and not has_collect:
         # distributed: {partial agg → hash exchange on keys → final agg}
         # — one SPMD all_to_all per shuffle stage (SURVEY §5.8)
@@ -410,6 +412,23 @@ class OverrideResult:
                         f"!Exec <{type(m.cpu).__name__}> cannot run on TPU "
                         f"because {r}")
         return out
+
+    def fallback_summary(self) -> dict:
+        """The fallback BUDGET as a metric [REF: ExplainPlanImpl — the
+        reference's explain=NOT_ON_GPU output, condensed to the number
+        that tracks progress]: how many plan operators run on device vs
+        fell back, with reasons."""
+        device = sum(1 for m in self.metas if m.can_run_on_tpu)
+        fallen = [m for m in self.metas if not m.can_run_on_tpu]
+        return {
+            "device_ops": device,
+            "fallback_ops": len(fallen),
+            "device_fraction": round(
+                device / max(len(self.metas), 1), 3),
+            "fallback_reasons": sorted(
+                {f"{type(m.cpu).__name__}: {r}"
+                 for m in fallen for r in m.reasons}),
+        }
 
 
 def wrap(cpu: CpuExec, conf: RapidsConf, all_metas: List[ExecMeta]) -> ExecMeta:

@@ -578,7 +578,18 @@ class DataFrame:
         conf = self.session.rapids_conf()
         cpu = plan_physical(optimize(self._plan, conf), conf)
         result = apply_overrides(cpu, conf)
+        self._last_override = result
         return result.plan
+
+    def fallback_summary(self) -> dict:
+        """Device-vs-fallback operator counts for the last planned
+        execution (the reference's explain=NOT_ON_GPU signal as a
+        metric [REF: ExplainPlanImpl; SURVEY §5.5])."""
+        res = getattr(self, "_last_override", None)
+        if res is None:
+            self._execute_plan()
+            res = self._last_override
+        return res.fallback_summary()
 
     def toArrow(self) -> pa.Table:
         import contextlib

@@ -180,6 +180,19 @@ stddev_pop = _agg1("stddev_pop")
 variance = var_samp
 stddev = stddev_samp
 collect_list = _agg1("collect_list")
+collect_set = _agg1("collect_set")
+
+
+def percentile(c, pct: float) -> Column:
+    return Column(UExpr("agg", ("percentile", float(pct)), (_cu(c),)))
+
+
+def percentile_approx(c, pct: float, accuracy: int = 10000) -> Column:
+    return Column(UExpr("agg", ("approx_percentile", float(pct),
+                                int(accuracy)), (_cu(c),)))
+
+
+approx_percentile = percentile_approx
 
 
 # python UDFs ---------------------------------------------------------------

@@ -194,3 +194,154 @@ def test_collect_list_string_falls_back():
             F.collect_list(F.col("s")).alias("xs")),
         ignore_order=True,
         allow_non_tpu=["HashAggregate", "InMemoryScan"])
+
+
+# -- round-4 aggregate tail: collect_set, percentile, approx_percentile,
+# merge-explosion repartition fallback [REF: GpuCollectSet,
+# GpuPercentileDefault, GpuAggregateExec repartition fallback]
+
+def test_collect_set_matches_oracle():
+    rng = np.random.default_rng(61)
+    n = 4000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 12, n)),
+        "v": pa.array(np.where(rng.random(n) < 0.1, None,
+                               rng.integers(0, 25, n).astype("float64"))),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k")
+        .agg(F.collect_set("v").alias("cs")),
+        ignore_order=True)
+
+
+def test_percentile_exact_and_approx():
+    rng = np.random.default_rng(62)
+    n = 6000
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 9, n)),
+        "v": pa.array(np.where(rng.random(n) < 0.08, None,
+                               rng.normal(100, 40, n))),
+        "i": pa.array(rng.integers(-500, 500, n)),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k")
+        .agg(F.percentile("v", 0.5).alias("p50"),
+             F.percentile("i", 0.25).alias("p25"),
+             F.percentile("v", 0.0).alias("p0"),
+             F.percentile("v", 1.0).alias("p100"),
+             F.percentile_approx("v", 0.9).alias("a90"),
+             F.percentile_approx("i", 0.1).alias("a10")),
+        ignore_order=True, approx_float=True)
+
+
+def test_percentile_all_null_group():
+    t = pa.table({
+        "k": pa.array([0, 0, 1, 1]),
+        "v": pa.array([None, None, 3.0, 5.0]),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k")
+        .agg(F.percentile("v", 0.5).alias("p"),
+             F.percentile_approx("v", 0.5).alias("a")),
+        ignore_order=True)
+
+
+def test_merge_explosion_repartition_fallback():
+    """Near-unique keys: every partial batch's groups survive the merge
+    — the concat must re-hash-partition instead of building one
+    exploded bucket."""
+    rng = np.random.default_rng(63)
+    n = 60_000
+    t = pa.table({
+        "k": pa.array(rng.permutation(n)),  # unique keys
+        "v": pa.array(rng.integers(0, 100, n)),
+    })
+    s = tpu_session({"spark.rapids.tpu.batchRows": 4096})
+    df = (s.createDataFrame(t).groupBy("k")
+          .agg(F.sum("v").alias("sv"), F.count("*").alias("c")))
+    out = df.toArrow()
+    assert out.num_rows == n
+    agg = _find(df._last_plan, "TpuHashAggregateExec")
+    assert agg.metric("repartitionMerges").value >= 1
+    # correctness spot check
+    got = {r["k"]: (r["sv"], r["c"]) for r in out.to_pylist()}
+    exp_v = np.asarray(t.column("v"))
+    exp_k = np.asarray(t.column("k"))
+    for i in rng.integers(0, n, 25):
+        assert got[int(exp_k[i])] == (int(exp_v[i]), 1)
+
+
+def _find(node, name):
+    if type(node).__name__ == name:
+        return node
+    for c in node.children:
+        r = _find(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+def test_percentile_decimal_input():
+    import decimal
+    import pytest as _pt
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    t = pa.table({
+        "k": pa.array([0, 0, 0, 1]),
+        "d": pa.array([decimal.Decimal("1.50"), decimal.Decimal("2.50"),
+                       decimal.Decimal("3.50"), decimal.Decimal("9.99")],
+                      type=pa.decimal128(10, 2)),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k")
+        .agg(F.percentile("d", 0.5).alias("p")),
+        ignore_order=True)
+    from spark_rapids_tpu.utils.harness import tpu_session
+    with _pt.raises(AnalysisException, match="approx_percentile"):
+        (tpu_session({}).createDataFrame(t).groupBy("k")
+         .agg(F.percentile_approx("d", 0.5)))
+
+
+def test_wide_multi_string_key_groupby_hash_path():
+    """q10-shaped grouping (int + wide strings + double) exceeds the
+    exact-encoding limb cap: the group sort runs on the 128-bit tuple
+    hash. Results must still match the oracle exactly (order aside)."""
+    rng = np.random.default_rng(71)
+    n = 8000
+    names = [f"Customer#{i:09d}" for i in range(400)]
+    nations = [f"NATION_{i:02d}" for i in range(25)]
+    t = pa.table({
+        "ck": pa.array(rng.integers(0, 400, n)),
+        "name": pa.array([names[i] for i in rng.integers(0, 400, n)]),
+        "bal": pa.array(rng.uniform(-999, 9999, n).round(2)),
+        "nat": pa.array([nations[i] for i in rng.integers(0, 25, n)]),
+        "v": pa.array(rng.uniform(0, 100, n)),
+    })
+    # prove the hash path actually engages for this key shape
+    from spark_rapids_tpu.columnar.column import host_to_device
+    from spark_rapids_tpu.ops import ordering as ORD
+    db = host_to_device(t.select(["ck", "name", "bal", "nat"]))
+    exact = ORD.fuse_parts(
+        [ORD._flag_part(~db.sel)]
+        + ORD.batch_group_parts(list(db.columns)))
+    assert len(exact) > ORD.GROUP_HASH_LIMB_CAP, len(exact)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t)
+        .groupBy("ck", "name", "bal", "nat")
+        .agg(F.sum("v").alias("sv"), F.count("*").alias("c")),
+        ignore_order=True, approx_float=True)
+
+
+def test_wide_key_groupby_null_positions_stay_distinct():
+    """(null, x) vs (x, null) in a wide key tuple must stay separate
+    groups — the tuple hash mixes a per-column null flag."""
+    t = pa.table({
+        "a": pa.array(["x", None, "x", None] * 50),
+        "b": pa.array([None, "x", None, "x"] * 50),
+        "c": pa.array(["pad_to_wide_key_0123456789"] * 200),
+        "d": pa.array(["another_wide_padding_col__"] * 200),
+        "v": pa.array(list(range(200)), type=pa.int64()),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("a", "b", "c", "d")
+        .agg(F.count("*").alias("n"), F.sum("v").alias("sv")),
+        ignore_order=True)

@@ -99,3 +99,25 @@ def test_profiler_capture_writes_trace(tmp_path):
     assert out.num_rows > 0
     captured = glob.glob(os.path.join(prof, "**", "*"), recursive=True)
     assert any(os.path.isfile(p) for p in captured), captured
+
+
+def test_fallback_summary_metric():
+    """The fallback budget as a metric (ExplainPlanImpl condensed):
+    device/fallback op counts + reasons [VERDICT r3 #10]."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.utils.harness import tpu_session
+    t = pa.table({"k": pa.array(np.arange(50) % 5),
+                  "v": pa.array(np.arange(50.0))})
+    s = tpu_session({})
+    df = s.createDataFrame(t).groupBy("k").agg(F.sum("v").alias("sv"))
+    df.toArrow()
+    fs = df.fallback_summary()
+    assert fs["fallback_ops"] == 0
+    assert fs["device_fraction"] == 1.0
+    assert fs["device_ops"] >= 2
+    # a lazily-planned frame gets a summary without execution
+    df2 = s.createDataFrame(t).select("k")
+    fs2 = df2.fallback_summary()
+    assert fs2["device_ops"] >= 1
