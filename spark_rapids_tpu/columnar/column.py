@@ -291,6 +291,18 @@ def _decimal_to_int64(arr: pa.Array) -> np.ndarray:
     return low
 
 
+def _decimal_to_hilo(arr: pa.Array) -> np.ndarray:
+    """decimal128 arrow column -> int64[n, 2] (hi, lo bit patterns)."""
+    arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+    buf = arr.buffers()[1]
+    raw = np.frombuffer(buf, dtype=np.int64, count=2 * len(arr),
+                        offset=arr.offset * 16)
+    out = np.empty((len(arr), 2), dtype=np.int64)
+    out[:, 0] = raw[1::2]  # hi
+    out[:, 1] = raw[0::2]  # lo (bit pattern)
+    return out
+
+
 def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
     ensure_initialized()
     if isinstance(arr, pa.ChunkedArray):
@@ -317,6 +329,12 @@ def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
             None if emask is None else jnp.asarray(emask),
         )
     if isinstance(dt, T.DecimalType):
+        if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+            data = _decimal_to_hilo(arr)
+            data[null_mask] = 0
+            return DeviceColumn(
+                dt, jnp.asarray(data),
+                None if validity_np is None else jnp.asarray(validity_np))
         data = _decimal_to_int64(arr)
         data = np.where(null_mask, 0, data)
     else:
@@ -474,11 +492,16 @@ def _device_to_host_impl(batch: DeviceBatch,
         data = np.asarray(c.data)[:n]
         if isinstance(f.dtype, T.DecimalType):
             # build decimal128 buffers directly: 16-byte little-endian
-            # two's complement = (low=int64 unscaled, high=sign extension)
-            low = data.astype(np.int64)
+            # two's complement = (low=int64 unscaled, high=sign extension
+            # for <=18; real hi lane for decimal128)
             raw = np.empty(2 * n, dtype=np.int64)
-            raw[0::2] = low
-            raw[1::2] = low >> 63
+            if data.ndim == 2:
+                raw[0::2] = data[:, 1]
+                raw[1::2] = data[:, 0]
+            else:
+                low = data.astype(np.int64)
+                raw[0::2] = low
+                raw[1::2] = low >> 63
             null_buf = None
             if validity is not None and not validity.all():
                 null_buf = pa.py_buffer(
@@ -515,6 +538,10 @@ def empty_batch(schema: T.StructType, bucket: int = 1024) -> DeviceBatch:
             cols.append(DeviceColumn(
                 f.dtype, jnp.zeros((bucket, 8), jnp.uint8),
                 None, jnp.zeros((bucket,), jnp.int32)))
+        elif (isinstance(f.dtype, T.DecimalType)
+              and f.dtype.precision > T.DecimalType.MAX_LONG_DIGITS):
+            cols.append(DeviceColumn(
+                f.dtype, jnp.zeros((bucket, 2), jnp.int64)))
         else:
             npdt = T.to_numpy_dtype(f.dtype)
             cols.append(DeviceColumn(f.dtype, jnp.zeros((bucket,), npdt)))

@@ -205,9 +205,9 @@ _NUMPY_MAP = {
 def to_numpy_dtype(dt: DataType):
     """Physical numpy/device dtype for a SQL type's data buffer."""
     if isinstance(dt, DecimalType):
-        if dt.precision <= DecimalType.MAX_LONG_DIGITS:
-            return np.int64
-        raise NotImplementedError("decimal128 device layout not yet enabled")
+        # precision <= 18: scaled int64 [B]; > 18 (decimal128): two
+        # int64 lanes [B, 2] (hi, lo) — see ops/decimal128.py
+        return np.int64
     if isinstance(dt, (StringType, BinaryType)):
         return np.uint8  # byte-matrix payload
     t = _NUMPY_MAP.get(type(dt))

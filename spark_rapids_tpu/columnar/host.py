@@ -66,8 +66,18 @@ def from_arrow_column(col, dt: T.DataType) -> HostCol:
         data = np.array(
             ["" if v is None else v for v in col.to_pylist()], dtype=object)
     elif isinstance(dt, T.DecimalType):
-        from spark_rapids_tpu.columnar.column import _decimal_to_int64
-        data = np.where(nulls, 0, _decimal_to_int64(col))
+        if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+            # host rep for decimal128: object array of exact python
+            # ints (unscaled) — CPU-oracle arithmetic stays bit-exact
+            c = (col.combine_chunks()
+                 if isinstance(col, pa.ChunkedArray) else col)
+            data = np.empty(len(c), dtype=object)
+            for i, v in enumerate(c.to_pylist()):
+                data[i] = 0 if v is None else int(
+                    v.scaleb(dt.scale).to_integral_value())
+        else:
+            from spark_rapids_tpu.columnar.column import _decimal_to_int64
+            data = np.where(nulls, 0, _decimal_to_int64(col))
     elif isinstance(dt, T.DateType):
         data = np.asarray(col.cast(pa.date32()).cast(pa.int32()).fill_null(0))
     elif isinstance(dt, T.TimestampType):

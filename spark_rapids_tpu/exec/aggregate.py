@@ -91,6 +91,24 @@ def segmented_scan(op, values: jnp.ndarray, boundary: jnp.ndarray
     return v
 
 
+def segmented_scan_dec128(values2: jnp.ndarray, boundary: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """Inclusive segmented 128-bit sum over int64[B,2] (hi, lo) values
+    — the carry-aware twin of ``segmented_scan(jnp.add, ...)``."""
+    from spark_rapids_tpu.ops import decimal128 as D128
+
+    def comb(a, bb):
+        ah, al, fa = a
+        bh, bl, fb = bb
+        s = D128.add(D128.pack(ah, al), D128.pack(bh, bl))
+        return (jnp.where(fb, bh, D128.hi(s)),
+                jnp.where(fb, bl, D128.lo(s)), fa | fb)
+
+    h, l, _ = jax.lax.associative_scan(
+        comb, (values2[:, 0], values2[:, 1], boundary))
+    return jnp.stack([h, l], axis=-1)
+
+
 def segment_groupby(
     key_cols: Sequence[DeviceColumn],
     sel: jnp.ndarray,
@@ -154,7 +172,12 @@ def segment_groupby(
         e = {"c": c, "kind": kind, "data_s": data_s, "valid_s": valid_s}
         e["n_contrib"] = batcher.add("add", contrib.astype(jnp.int32),
                                      key=ckey)
-        if kind == "sum":
+        if kind == "sum" and data_s.ndim == 2:
+            # decimal128 buffers: carry-aware scan outside the batcher
+            e["agg128"] = segmented_scan_dec128(
+                jnp.where(contrib[:, None], data_s,
+                          jnp.zeros((), data_s.dtype)), boundary)
+        elif kind == "sum":
             e["agg"] = batcher.add("add", jnp.where(
                 contrib, data_s, jnp.zeros((), data_s.dtype)))
         elif kind in ("min", "max"):
@@ -202,7 +225,8 @@ def segment_groupby(
         c, kind = e["c"], e["kind"]
         n_contrib = batcher.get(e["n_contrib"])
         validity = n_contrib > 0
-        agg = batcher.get(e["agg"])
+        agg = (e["agg128"] if "agg128" in e
+               else batcher.get(e["agg"]))
         if kind in ("min", "max") and e.get("float_nan"):
             nan = jnp.asarray(np.nan, e["data_s"].dtype)
             if kind == "min":
@@ -494,8 +518,13 @@ def update_value_cols(fns: Sequence[AggregateFunction], batch: DeviceBatch
             out.append((DeviceColumn(
                 T.LongT, valid.astype(jnp.int64)), "sum"))
         elif isinstance(fn, (Sum, Average)):
+            from spark_rapids_tpu.ops import decimal128 as D128
             rdt = fn.buffer_dtypes()[0]
-            data = c.data.astype(T.to_numpy_dtype(rdt))
+            if D128.is128(rdt):
+                data = (c.data if D128.is128(c.dtype)
+                        else D128.from_i64(c.data))
+            else:
+                data = c.data.astype(T.to_numpy_dtype(rdt))
             out.append((DeviceColumn(rdt, data, c.validity), "sum"))
             out.append((DeviceColumn(
                 T.LongT, valid.astype(jnp.int64)), "sum"))
@@ -532,9 +561,13 @@ def final_project(fns: Sequence[AggregateFunction],
         if isinstance(fn, (Count, CountStar)):
             out.append(DeviceColumn(T.LongT, mine[0].data, None))
         elif isinstance(fn, Sum):
+            from spark_rapids_tpu.ops import decimal128 as D128
             s, cnt = mine
-            out.append(DeviceColumn(fn.result_dtype, s.data,
-                                    cnt.data > 0))
+            validity = cnt.data > 0
+            if D128.is128(fn.result_dtype):
+                validity = validity & D128.fits_precision(
+                    s.data, fn.result_dtype.precision)
+            out.append(DeviceColumn(fn.result_dtype, s.data, validity))
         elif isinstance(fn, Average):
             s, cnt = mine
             denom = jnp.where(cnt.data > 0, cnt.data, 1)
@@ -1063,6 +1096,11 @@ class CpuAggregateExec(CpuExec):
             elif isinstance(f.dtype, (T.StringType, T.BinaryType)):
                 data = np.array([v if v is not None else "" for v in vals],
                                 dtype=object)
+            elif (isinstance(f.dtype, T.DecimalType)
+                  and f.dtype.precision > T.DecimalType.MAX_LONG_DIGITS):
+                data = np.empty(len(vals), dtype=object)
+                for i, v in enumerate(vals):
+                    data[i] = int(v) if v is not None else 0
             else:
                 npdt = T.to_numpy_dtype(f.dtype)
                 data = np.array([v if v is not None else 0 for v in vals])
@@ -1109,8 +1147,11 @@ def _acc_update(acc, fn, vc, i):
         acc["count"] += 1
     elif isinstance(fn, (Sum, Average)):
         acc["count"] += 1
-        if T.is_integral(fn.child.dtype) or isinstance(
-                fn.child.dtype, T.DecimalType):
+        if isinstance(fn.child.dtype, T.DecimalType):
+            # exact python-int accumulation: decimal sums widen to
+            # p+10 digits (a decimal128 buffer on device)
+            acc["sum"] = int(acc["sum"]) + int(v)
+        elif T.is_integral(fn.child.dtype):
             with np.errstate(over="ignore"):  # Spark non-ANSI sum wraps
                 acc["sum"] = np.int64(acc["sum"] + np.int64(v))
         else:
@@ -1154,6 +1195,12 @@ def _acc_final(acc, fn):
     if isinstance(fn, Sum):
         if acc["count"] == 0:
             return None
+        if isinstance(fn.child.dtype, T.DecimalType):
+            # mirror the 128-bit container wrap + overflow-to-null
+            from spark_rapids_tpu.ops import decimal128 as D128
+            w = D128.py_wrap128(acc["sum"])
+            return (w if D128.py_fits(w, fn.result_dtype.precision)
+                    else None)
         return acc["sum"]
     if isinstance(fn, Average):
         if acc["count"] == 0:

@@ -354,6 +354,10 @@ def _canonical_key_parts(c: DeviceColumn, str_width: int
         return [ORD._flag_part(isn), (val, "f64")]
     if isinstance(dt, T.BooleanType):
         return [(c.data.astype(jnp.uint64), 1)]
+    if (isinstance(dt, T.DecimalType)
+            and dt.precision > T.DecimalType.MAX_LONG_DIGITS):
+        return [ORD._int_part(c.data[:, 0], 64, True),
+                (c.data[:, 1].astype(jnp.uint64), 64)]
     # integral family, date, timestamp, decimal → 64-bit biased encoding
     return [ORD._int_part(c.data.astype(jnp.int64), 64, True)]
 
@@ -952,6 +956,11 @@ def _tag_join(meta):
         else:
             _tag_e(cpu.condition, meta)
     for le, re in zip(cpu.left_keys, cpu.right_keys):
+        from spark_rapids_tpu.ops import decimal128 as D128
+        if (D128.is128(le.dtype) and cpu.join_type in ("right", "full")):
+            meta.will_not_work(
+                "decimal128 join keys on right/full joins not yet on "
+                "device (key-column coalesce lacks a 2-lane select)")
         lf, rf = _join_key_family(le.dtype), _join_key_family(re.dtype)
         if lf != rf:
             meta.will_not_work(

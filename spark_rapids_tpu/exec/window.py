@@ -822,6 +822,21 @@ def _vals_to_col(vals: List[object], dt: T.DataType) -> H.HostCol:
     if isinstance(dt, (T.StringType, T.BinaryType)):
         data = np.array([v if v is not None else "" for v in vals],
                         dtype=object)
+    elif (isinstance(dt, T.DecimalType)
+          and dt.precision > T.DecimalType.MAX_LONG_DIGITS):
+        from spark_rapids_tpu.ops import decimal128 as D128
+        data = np.empty(len(vals), dtype=object)
+        for i, v in enumerate(vals):
+            if v is None:
+                data[i] = 0
+                continue
+            w = D128.py_wrap128(int(v))
+            if not D128.py_fits(w, dt.precision):
+                validity[i] = False
+                w = 0
+            data[i] = w
+        return H.HostCol(dt, data,
+                         None if validity.all() else validity)
     else:
         npdt = T.to_numpy_dtype(dt)
         data = np.array([v if v is not None else 0 for v in vals])
@@ -850,6 +865,11 @@ def _tag_window(meta):
                 "ascending order)")
         if wf.child is not None:
             meta.tag_expressions([wf.child])
+            from spark_rapids_tpu.ops.decimal128 import is128 as _is128
+            if _is128(wf.child.dtype):
+                meta.will_not_work(
+                    f"window {wf.kind} over decimal128 input not yet "
+                    "on device (1-D scan kernels lack the carry)")
             if wf.kind in ("min", "max", "first") and isinstance(
                     wf.child.dtype, (T.StringType, T.BinaryType)):
                 meta.will_not_work(

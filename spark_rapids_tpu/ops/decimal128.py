@@ -1,0 +1,267 @@
+"""decimal128 device arithmetic: int32-limb kernels over an int64[B,2]
+(hi, lo) column representation.
+
+[REF: NVIDIA/spark-rapids-jni :: src/main/cpp/src/decimal128 kernels —
+the reference implements 128-bit decimal math in CUDA; SURVEY §2.2 N9]
+
+TPU-first design notes:
+* the device representation is two int64 lanes per row — ``data[:, 0]``
+  the signed high limb, ``data[:, 1]`` the low limb's BIT PATTERN (an
+  int64 holding a logically-unsigned value).  XLA's x64 int64 is native
+  enough; only 64-bit *bitcasts* are forbidden on TPU, and none are
+  used here.
+* multiplication decomposes each 64-bit lane into 32-bit halves and
+  runs wrapping schoolbook products: a 32x32 product's int64 BIT
+  PATTERN is exact mod 2^64 even when it wraps negative, and its
+  masked halves (& 0xFFFFFFFF, arithmetic-shift + mask) are the true
+  unsigned halves — so the whole pipeline stays in int64 ops.
+* division (avg, down-rescale) is vectorized long division over the
+  four 32-bit limbs of |x| with a positive divisor < 2^31 — each step's
+  partial remainder fits well inside a positive int64.
+
+Overflow wraps mod 2^128 (non-ANSI Spark behavior for the enabled ops).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+
+# python-int constants: they bind lazily at op time (module import can
+# precede the engine's x64 enablement, where jnp.int64(...) would clip)
+_MASK32 = 0xFFFFFFFF
+_SIGN = -0x8000000000000000  # 1 << 63 as int64
+
+
+def is128(dt) -> bool:
+    return (isinstance(dt, T.DecimalType)
+            and dt.precision > T.DecimalType.MAX_LONG_DIGITS)
+
+
+def pack(hi: jnp.ndarray, lo: jnp.ndarray) -> jnp.ndarray:
+    return jnp.stack([hi, lo], axis=-1)
+
+
+def hi(d: jnp.ndarray) -> jnp.ndarray:
+    return d[..., 0]
+
+
+def lo(d: jnp.ndarray) -> jnp.ndarray:
+    return d[..., 1]
+
+
+def _ult(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unsigned a < b over int64 bit patterns."""
+    return (a ^ _SIGN) < (b ^ _SIGN)
+
+
+def from_i64(x: jnp.ndarray) -> jnp.ndarray:
+    """Sign-extend an int64 unscaled value to (hi, lo)."""
+    return pack(x >> jnp.int64(63), x)
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    lo_s = lo(a) + lo(b)  # wraps mod 2^64
+    carry = _ult(lo_s, lo(a)).astype(jnp.int64)
+    return pack(hi(a) + hi(b) + carry, lo_s)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    lo_n = -lo(a)  # two's complement of the low lane
+    borrow = (lo(a) != 0).astype(jnp.int64)
+    return pack(-hi(a) - borrow, lo_n)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return add(a, neg(b))
+
+
+def is_negative(a: jnp.ndarray) -> jnp.ndarray:
+    return hi(a) < 0
+
+
+def abs128(a: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    n = is_negative(a)
+    return jnp.where(n[..., None], neg(a), a), n
+
+
+def _limbs32(a: jnp.ndarray):
+    """(hi, lo) -> four 32-bit limbs, most significant first, each held
+    as a non-negative int64."""
+    h, l = hi(a), lo(a)
+    return ((h >> jnp.int64(32)) & _MASK32, h & _MASK32,
+            (l >> jnp.int64(32)) & _MASK32, l & _MASK32)
+
+
+def _from_limbs32(l3, l2, l1, l0) -> jnp.ndarray:
+    """Four CARRY-FREE 32-bit limbs (each < 2^32) -> (hi, lo)."""
+    return pack((l3 << jnp.int64(32)) | l2, (l1 << jnp.int64(32)) | l0)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a * b mod 2^128 (wrapping schoolbook over 32-bit limbs)."""
+    a3, a2, a1, a0 = _limbs32(a)
+    b3, b2, b1, b0 = _limbs32(b)
+
+    def p(x, y):
+        """32x32 product as (hi32, lo32) — the int64 product's bit
+        pattern is exact mod 2^64 even when it wraps negative."""
+        v = x * y
+        return (v >> jnp.int64(32)) & _MASK32, v & _MASK32
+
+    # column sums c_k of partial products contributing to limb k
+    # (k = 0 least significant); each term < 2^32, <= 8 terms -> the
+    # accumulators stay positive int64
+    c0 = jnp.zeros_like(a0)
+    c1 = jnp.zeros_like(a0)
+    c2 = jnp.zeros_like(a0)
+    c3 = jnp.zeros_like(a0)
+    for i, ai in enumerate((a3, a2, a1, a0)):
+        for j, bj in enumerate((b3, b2, b1, b0)):
+            k = (3 - i) + (3 - j)  # limb index of the low half
+            if k > 3:
+                continue
+            ph, pl = p(ai, bj)
+            if k == 0:
+                c0 = c0 + pl
+                c1 = c1 + ph
+            elif k == 1:
+                c1 = c1 + pl
+                c2 = c2 + ph
+            elif k == 2:
+                c2 = c2 + pl
+                c3 = c3 + ph
+            else:
+                c3 = c3 + pl
+    # carry propagation
+    l0 = c0 & _MASK32
+    c1 = c1 + (c0 >> jnp.int64(32))
+    l1 = c1 & _MASK32
+    c2 = c2 + (c1 >> jnp.int64(32))
+    l2 = c2 & _MASK32
+    c3 = c3 + (c2 >> jnp.int64(32))
+    l3 = c3 & _MASK32
+    return _from_limbs32(l3, l2, l1, l0)
+
+
+def mul_small(a: jnp.ndarray, m: int) -> jnp.ndarray:
+    """a * m mod 2^128 for a non-negative python int m < 2^31."""
+    mm = jnp.int64(m)
+    a3, a2, a1, a0 = _limbs32(a)
+    p0 = a0 * mm
+    p1 = a1 * mm
+    p2 = a2 * mm
+    p3 = a3 * mm
+    l0 = p0 & _MASK32
+    p1 = p1 + ((p0 >> jnp.int64(32)) & _MASK32)
+    l1 = p1 & _MASK32
+    p2 = p2 + ((p1 >> jnp.int64(32)) & _MASK32)
+    l2 = p2 & _MASK32
+    p3 = p3 + ((p2 >> jnp.int64(32)) & _MASK32)
+    l3 = p3 & _MASK32
+    return _from_limbs32(l3, l2, l1, l0)
+
+
+def scale_up(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a * 10^k mod 2^128 — factored into < 2^31 multipliers."""
+    while k > 0:
+        step = min(k, 9)
+        a = mul_small(a, 10 ** step)
+        k -= step
+    return a
+
+
+def divmod_small(a: jnp.ndarray, d: int
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(|a| // d, |a| % d) with the SIGN of a applied to the quotient
+    via the caller; a must already be non-negative.  d: positive python
+    int < 2^31.  Vectorized long division over the 32-bit limbs."""
+    dd = jnp.int64(d)
+    limbs = _limbs32(a)
+    r = jnp.zeros_like(limbs[0])
+    q = []
+    for l in limbs:
+        cur = (r << jnp.int64(32)) | l  # < 2^63: r < d < 2^31
+        q.append(cur // dd)
+        r = cur % dd
+    return _from_limbs32(*q), r
+
+
+def div_small_round(a: jnp.ndarray, d: int) -> jnp.ndarray:
+    """a / d with HALF_UP rounding away from zero (Spark decimal
+    divide/average rounding); d: positive python int < 2^31."""
+    mag, sign = abs128(a)
+    q, r = divmod_small(mag, d)
+    round_up = (r * jnp.int64(2) >= jnp.int64(d)).astype(jnp.int64)
+    q = add(q, pack(jnp.zeros_like(round_up), round_up))
+    return jnp.where(sign[..., None], neg(q), q)
+
+
+def scale_down_round(a: jnp.ndarray, k: int) -> jnp.ndarray:
+    """a / 10^k with HALF_UP rounding; supported for k <= 9 (divisor
+    must stay < 2^31 so the single rounding division is exact)."""
+    if k == 0:
+        return a
+    assert k <= 9, "scale-down beyond 10^9 is tagged out"
+    return div_small_round(a, 10 ** k)
+
+
+def cmp_lt(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return (hi(a) < hi(b)) | ((hi(a) == hi(b)) & _ult(lo(a), lo(b)))
+
+
+def cmp_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return (hi(a) == hi(b)) & (lo(a) == lo(b))
+
+
+def to_double(a: jnp.ndarray, scale: int) -> jnp.ndarray:
+    """Approximate double value (hi * 2^64 + unsigned lo) / 10^scale."""
+    l = lo(a)
+    lo_u = (l & ~_SIGN).astype(jnp.float64) + jnp.where(
+        l < 0, jnp.float64(2.0 ** 63), jnp.float64(0.0))
+    v = hi(a).astype(jnp.float64) * jnp.float64(2.0 ** 64) + lo_u
+    return v / jnp.float64(10.0 ** scale)
+
+
+def np_pack(values) -> np.ndarray:
+    """Host iterable of python ints -> int64[n, 2] (hi, lo)."""
+    out = np.zeros((len(values), 2), dtype=np.int64)
+    for i, v in enumerate(values):
+        v = int(v)
+        out[i, 0] = np.int64(v >> 64)  # arithmetic shift keeps sign
+        l = v & 0xFFFFFFFFFFFFFFFF
+        out[i, 1] = np.int64(l - (1 << 64) if l >= (1 << 63) else l)
+    return out
+
+
+def np_unpack(data: np.ndarray) -> np.ndarray:
+    """int64[n, 2] -> host object-array of python ints."""
+    n = data.shape[0]
+    out = np.empty(n, dtype=object)
+    for i in range(n):
+        h = int(data[i, 0])
+        l = int(data[i, 1]) & 0xFFFFFFFFFFFFFFFF
+        out[i] = (h << 64) | l
+    return out
+
+
+def fits_precision(a: jnp.ndarray, precision: int) -> jnp.ndarray:
+    """|a| < 10^precision — Spark nulls decimal results that overflow
+    their declared precision (non-ANSI)."""
+    bound = jnp.asarray(np_pack([10 ** precision]))[0]
+    mag, _ = abs128(a)
+    return cmp_lt(mag, jnp.broadcast_to(bound, mag.shape))
+
+
+def py_wrap128(v: int) -> int:
+    """Python-int twin of the device container: wrap mod 2^128 signed."""
+    w = int(v) % (1 << 128)
+    return w - (1 << 128) if w >= (1 << 127) else w
+
+
+def py_fits(v: int, precision: int) -> bool:
+    return abs(int(v)) < 10 ** precision

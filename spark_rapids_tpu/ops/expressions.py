@@ -139,6 +139,11 @@ class Literal(Expression):
                                     jnp.zeros((b, 1), jnp.uint8),
                                     jnp.zeros((b,), jnp.bool_),
                                     jnp.zeros((b,), jnp.int32))
+            from spark_rapids_tpu.ops import decimal128 as D128
+            if D128.is128(self.dtype):
+                return DeviceColumn(self.dtype,
+                                    jnp.zeros((b, 2), jnp.int64),
+                                    jnp.zeros((b,), jnp.bool_))
             npdt = (np.int32 if isinstance(self.dtype, T.NullType)
                     else T.to_numpy_dtype(self.dtype))
             data = jnp.zeros((b,), npdt)
@@ -156,6 +161,11 @@ class Literal(Expression):
         if isinstance(self.dtype, T.DecimalType):
             import decimal as _d
             v = int(_d.Decimal(str(v)).scaleb(self.dtype.scale))
+            from spark_rapids_tpu.ops import decimal128 as D128
+            if D128.is128(self.dtype):
+                pair = D128.np_pack([v])
+                return DeviceColumn(self.dtype, jnp.broadcast_to(
+                    jnp.asarray(pair), (b, 2)))
         data = jnp.full((b,), v, T.to_numpy_dtype(self.dtype))
         return DeviceColumn(self.dtype, data)
 
@@ -174,6 +184,10 @@ class Literal(Expression):
         if isinstance(self.dtype, T.DecimalType):
             import decimal as _d
             v = int(_d.Decimal(str(v)).scaleb(self.dtype.scale))
+            if self.dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
+                out = np.empty(n, dtype=object)
+                out[:] = v
+                return HostCol(self.dtype, out)
         return HostCol(self.dtype, np.full(n, v, T.to_numpy_dtype(self.dtype)))
 
     def __str__(self):
@@ -216,10 +230,13 @@ class _BinaryArith(Expression):
     ansi_sensitive = True
     left: Expression
     right: Expression
+    # decimal arithmetic result type (precision/scale bookkeeping lives
+    # in the analyzer); None = operand type passes through
+    forced_dtype: Optional[T.DataType] = None
 
     @property
     def dtype(self):
-        return self.left.dtype
+        return self.forced_dtype or self.left.dtype
 
     @property
     def children(self):
@@ -231,16 +248,54 @@ class _BinaryArith(Expression):
     def _op_h(self, a, b):
         raise NotImplementedError
 
+    # decimal128 lowering: ops/decimal128 int32-limb kernels (values
+    # wrap mod 2^128, the non-ANSI container behavior) [REF:
+    # spark-rapids-jni decimal128 kernels]
+    _d128_op = None
+
     def eval_tpu(self, batch):
+        from spark_rapids_tpu.ops import decimal128 as D128
         l = self.left.eval_tpu(batch)
         r = self.right.eval_tpu(batch)
+        if D128.is128(self.dtype):
+            op = type(self)._d128_op
+            if op is None:
+                raise NotImplementedError(
+                    f"decimal128 {type(self).__name__}")
+
+            def to128(c):
+                return (c.data if D128.is128(c.dtype)
+                        else D128.from_i64(c.data))
+
+            data = op(to128(l), to128(r))
+            validity = merge_validity_d(l.validity, r.validity)
+            # Spark non-ANSI: overflow beyond the result precision
+            # nulls the row
+            fits = D128.fits_precision(data, self.dtype.precision)
+            validity = fits if validity is None else validity & fits
+            return DeviceColumn(self.dtype, data, validity)
         data = self._op_d(l.data, r.data)
         return DeviceColumn(self.dtype, data,
                             merge_validity_d(l.validity, r.validity))
 
     def eval_cpu(self, batch):
+        from spark_rapids_tpu.ops import decimal128 as D128
         l = self.left.eval_cpu(batch)
         r = self.right.eval_cpu(batch)
+        if D128.is128(self.dtype):
+            la = np.array([int(v) for v in l.data], dtype=object)
+            ra = np.array([int(v) for v in r.data], dtype=object)
+            data = self._op_h(la, ra)
+            # wrap mod 2^128 like the device container, then apply the
+            # Spark overflow-to-null rule on the declared precision
+            wrapped = np.empty(len(data), dtype=object)
+            for i, v in enumerate(data):
+                wrapped[i] = D128.py_wrap128(v)
+            fits = np.array([D128.py_fits(v, self.dtype.precision)
+                             for v in wrapped], dtype=bool)
+            validity = merge_validity_h(l.validity, r.validity)
+            validity = fits if validity is None else validity & fits
+            return HostCol(self.dtype, wrapped, validity)
         with np.errstate(all="ignore"):
             data = self._op_h(l.data, r.data)
         return HostCol(self.dtype, data,
@@ -248,6 +303,9 @@ class _BinaryArith(Expression):
 
 
 class Add(_BinaryArith):
+    from spark_rapids_tpu.ops import decimal128 as _D
+    _d128_op = staticmethod(_D.add)
+
     def _op_d(self, a, b):
         return a + b
 
@@ -256,6 +314,9 @@ class Add(_BinaryArith):
 
 
 class Subtract(_BinaryArith):
+    from spark_rapids_tpu.ops import decimal128 as _D
+    _d128_op = staticmethod(_D.sub)
+
     def _op_d(self, a, b):
         return a - b
 
@@ -264,6 +325,9 @@ class Subtract(_BinaryArith):
 
 
 class Multiply(_BinaryArith):
+    from spark_rapids_tpu.ops import decimal128 as _D
+    _d128_op = staticmethod(_D.mul)
+
     def _op_d(self, a, b):
         return a * b
 
@@ -451,9 +515,35 @@ class _BinaryComparison(Expression):
     def _cmp(self, a, b, an, bn, xp):
         raise NotImplementedError
 
+    # decimal128 comparisons in terms of the limb-pair primitives
+    # (device) or exact python ints (host)
+    _D128_CMPS = {
+        "EqualTo": lambda lt, eq, a, b: eq(a, b),
+        "EqualNullSafe": lambda lt, eq, a, b: eq(a, b),
+        "LessThan": lambda lt, eq, a, b: lt(a, b),
+        "LessThanOrEqual": lambda lt, eq, a, b: ~lt(b, a),
+        "GreaterThan": lambda lt, eq, a, b: lt(b, a),
+        "GreaterThanOrEqual": lambda lt, eq, a, b: ~lt(a, b),
+    }
+
     def _eval(self, l, r, xp, validity):
         if isinstance(self.left.dtype, T.StringType):
             raise NotImplementedError("string comparison handled in strings.py")
+        from spark_rapids_tpu.ops import decimal128 as D128
+        if D128.is128(self.left.dtype) or D128.is128(self.right.dtype):
+            f = self._D128_CMPS[type(self).__name__]
+            if xp is np:
+                la = np.array([int(v) for v in l], dtype=object)
+                ra = np.array([int(v) for v in r], dtype=object)
+                return f(lambda a, b: a < b, lambda a, b: a == b,
+                         la, ra).astype(bool)
+
+            def to128(c, dt):
+                return c if D128.is128(dt) else D128.from_i64(c)
+
+            return f(D128.cmp_lt, D128.cmp_eq,
+                     to128(l, self.left.dtype),
+                     to128(r, self.right.dtype))
         if _is_float(self.left.dtype):
             an, bn = xp.isnan(l), xp.isnan(r)
         else:
@@ -698,6 +788,9 @@ def device_select(cond1d, a: "DeviceColumn", b: "DeviceColumn",
         data = jnp.where(cond1d[:, None], da, db)
         lengths = jnp.where(cond1d, a.lengths, b.lengths)
         return DeviceColumn(dtype, data, None, lengths)
+    if a.data.ndim == 2:  # decimal128 (hi, lo) lanes
+        return DeviceColumn(
+            dtype, jnp.where(cond1d[:, None], a.data, b.data), None)
     return DeviceColumn(dtype, jnp.where(cond1d, a.data, b.data), None)
 
 
@@ -1047,6 +1140,22 @@ class Cast(Expression):
         """Per-combination device support (tagging hook).  None = ok."""
         from spark_rapids_tpu import conf as C
         src, dst = self.child.dtype, self.dtype
+        if self._decimal_combo() is not None:
+            if isinstance(dst, T.DecimalType):
+                down = (src.scale - dst.scale
+                        if isinstance(src, T.DecimalType) else -1)
+                if down > 9:
+                    return ("decimal scale-down beyond 10^9 not on "
+                            "device (single-step rounded division cap)")
+                if (isinstance(src, T.DecimalType)
+                        or T.is_integral(src)):
+                    return None
+                return (f"cast {src.simple_name}→{dst.simple_name} "
+                        "not yet on device")
+            if isinstance(dst, T.DoubleType):
+                return None
+            return (f"cast {src.simple_name}→{dst.simple_name} not "
+                    "yet on device")
         src_s = isinstance(src, T.StringType)
         dst_s = isinstance(dst, T.StringType)
         if not (src_s or dst_s):
@@ -1067,10 +1176,85 @@ class Cast(Expression):
         return (f"cast {src.simple_name}→{dst.simple_name} not yet on "
                 "device")
 
+    def _decimal_combo(self):
+        """(src_scale_delta handling needed?)  Returns None when this
+        cast does not involve decimals."""
+        src, dst = self.child.dtype, self.dtype
+        if not (isinstance(src, T.DecimalType)
+                or isinstance(dst, T.DecimalType)):
+            return None
+        return (src, dst)
+
+    def _cast_decimal_tpu(self, c):
+        from spark_rapids_tpu.ops import decimal128 as D128
+        src, dst = self.child.dtype, self.dtype
+        if isinstance(dst, T.DecimalType):
+            # EVERY cast to decimal runs through the 128-bit container:
+            # the rescale cannot wrap int64, and the overflow-to-null
+            # check applies uniformly (Spark non-ANSI)
+            big_dst = D128.is128(dst)
+            if isinstance(src, T.DecimalType):
+                k = dst.scale - src.scale
+                d = (c.data if D128.is128(src)
+                     else D128.from_i64(c.data))
+                d = (D128.scale_up(d, k) if k >= 0
+                     else D128.scale_down_round(d, -k))
+            elif T.is_integral(src):
+                d = D128.scale_up(D128.from_i64(
+                    c.data.astype(jnp.int64)), dst.scale)
+            else:
+                raise NotImplementedError(f"cast {src}→{dst} on device")
+            fits = D128.fits_precision(d, dst.precision)
+            validity = (fits if c.validity is None
+                        else c.validity & fits)
+            if not big_dst:
+                d = D128.lo(d)
+            return DeviceColumn(dst, d, validity)
+        # src is decimal
+        if isinstance(dst, T.DoubleType):
+            from spark_rapids_tpu.ops import decimal128 as D128
+            if D128.is128(src):
+                return DeviceColumn(
+                    dst, D128.to_double(c.data, src.scale), c.validity)
+            return DeviceColumn(
+                dst, c.data.astype(jnp.float64)
+                / jnp.float64(10.0 ** src.scale), c.validity)
+        raise NotImplementedError(f"cast {src}→{dst} on device")
+
+    def _cast_decimal_cpu(self, c):
+        import decimal as _d
+        src, dst = self.child.dtype, self.dtype
+        n = len(c.data)
+        if isinstance(dst, T.DecimalType):
+            k = (dst.scale - src.scale
+                 if isinstance(src, T.DecimalType) else dst.scale)
+            out = np.empty(n, dtype=object)
+            for i in range(n):
+                v = int(c.data[i])
+                if k >= 0:
+                    out[i] = v * (10 ** k)
+                else:
+                    out[i] = int((_d.Decimal(v) / (10 ** (-k))).quantize(
+                        0, rounding=_d.ROUND_HALF_UP))
+            bound = 10 ** dst.precision
+            fits = np.array([abs(int(v)) < bound for v in out], bool)
+            validity = (fits if c.validity is None
+                        else c.validity & fits)
+            if dst.precision <= T.DecimalType.MAX_LONG_DIGITS:
+                out = np.array([int(v) for v in out], dtype=np.int64)
+            return HostCol(dst, out, validity)
+        if isinstance(dst, T.DoubleType):
+            out = np.array([int(v) / (10.0 ** src.scale)
+                            for v in c.data], dtype=np.float64)
+            return HostCol(dst, out, c.validity)
+        raise NotImplementedError(f"cast {src}→{dst} on cpu")
+
     def eval_tpu(self, batch):
         from spark_rapids_tpu.ops import strings as S
         c = self.child.eval_tpu(batch)
         src, dst = self.child.dtype, self.dtype
+        if self._decimal_combo() is not None:
+            return self._cast_decimal_tpu(c)
         if isinstance(dst, T.StringType):
             if isinstance(src, T.BooleanType):
                 return S.cast_bool_to_string_device(c)
@@ -1090,6 +1274,8 @@ class Cast(Expression):
     def eval_cpu(self, batch):
         c = self.child.eval_cpu(batch)
         src, dst = self.child.dtype, self.dtype
+        if self._decimal_combo() is not None:
+            return self._cast_decimal_cpu(c)
         if isinstance(src, T.StringType) or isinstance(dst, T.StringType):
             return self._cast_string_cpu(c)
         with np.errstate(all="ignore"):

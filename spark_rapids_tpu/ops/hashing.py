@@ -276,7 +276,19 @@ def hash_column(col, dt: T.DataType, h, valid, xp):
     elif isinstance(dt, T.DoubleType):
         nh = _hash_long_vec(_canon_double_bits(data, xp), h, xp)
     elif isinstance(dt, T.DecimalType):
-        nh = _hash_long_vec(data.astype(np.int64), h, xp)
+        if getattr(data, "ndim", 1) == 2:
+            # decimal128 (hi, lo): mix both lanes.  Internal-consistency
+            # hash (grouping/partitioning); NOT bit-exact with Spark's
+            # byte-array hash of wide decimals.
+            nh = _hash_long_vec(data[..., 1].astype(np.int64), h, xp)
+            nh = _hash_long_vec(data[..., 0].astype(np.int64), nh, xp)
+        elif data.dtype == object:
+            from spark_rapids_tpu.ops.decimal128 import np_pack
+            pair = np_pack(list(data))
+            nh = _hash_long_vec(pair[:, 1], h, xp)
+            nh = _hash_long_vec(pair[:, 0], nh, xp)
+        else:
+            nh = _hash_long_vec(data.astype(np.int64), h, xp)
     elif isinstance(dt, (T.StringType, T.BinaryType)):
         nh = _hash_string_vec(data, lengths, h, xp)
     else:
@@ -589,11 +601,30 @@ def xxhash_column(col, dt: T.DataType, h, valid, xp):
             v = bits.astype(jnp.uint64)
         nh = _xxh_long_vec(v, h, xp)
     elif isinstance(dt, T.DecimalType):
-        if xp is np:
-            v = data.astype(np.int64).view(np.uint64)
+        if getattr(data, "ndim", 1) == 2:
+            # decimal128 (hi, lo) device lanes: mix both (internal
+            # consistency, not bit-exact with Spark's byte-array hash)
+            lo64 = data[..., 1]
+            hi64 = data[..., 0]
+            if xp is np:
+                nh = _xxh_long_vec(lo64.astype(np.int64).view(np.uint64),
+                                   h, xp)
+                nh = _xxh_long_vec(hi64.astype(np.int64).view(np.uint64),
+                                   nh, xp)
+            else:
+                nh = _xxh_long_vec(lo64.astype(jnp.uint64), h, xp)
+                nh = _xxh_long_vec(hi64.astype(jnp.uint64), nh, xp)
+        elif data.dtype == object:
+            from spark_rapids_tpu.ops.decimal128 import np_pack
+            pair = np_pack(list(data))
+            nh = _xxh_long_vec(pair[:, 1].view(np.uint64), h, xp)
+            nh = _xxh_long_vec(pair[:, 0].view(np.uint64), nh, xp)
         else:
-            v = data.astype(jnp.int64).astype(jnp.uint64)
-        nh = _xxh_long_vec(v, h, xp)
+            if xp is np:
+                v = data.astype(np.int64).view(np.uint64)
+            else:
+                v = data.astype(jnp.int64).astype(jnp.uint64)
+            nh = _xxh_long_vec(v, h, xp)
     elif isinstance(dt, (T.StringType, T.BinaryType)):
         nh = _xxh_string_vec(data, lengths, h, xp)
     else:

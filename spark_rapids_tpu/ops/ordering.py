@@ -175,7 +175,18 @@ def column_order_parts(col: DeviceColumn, ascending: bool = True,
         parts = [(col.data.astype(jnp.uint64)
                   if ascending else (~col.data).astype(jnp.uint64), 1)]
     elif isinstance(dt, T.DecimalType):
-        parts = [_int_part(col.data, 64, ascending)]
+        if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
+            # decimal128 [B,2]: signed-biased hi limb, then the lo
+            # limb's raw (unsigned-ordered) bit pattern
+            h = col.data[:, 0]
+            l = col.data[:, 1]
+            hp = _int_part(h, 64, ascending)
+            lu = l.astype(jnp.uint64)
+            if not ascending:
+                lu = ~lu
+            parts = [hp, (lu, 64)]
+        else:
+            parts = [_int_part(col.data, 64, ascending)]
     else:  # integral, date, timestamp
         parts = [_int_part(col.data, _INT_WIDTH[type(dt)], ascending)]
     # null part: orders independently of direction: nulls_first ⇒ nulls 0
@@ -323,6 +334,14 @@ def np_order_keys(data: np.ndarray, validity: Optional[np.ndarray],
                 limb = (limb << np.uint64(8)) | padded[:, i * 8 + j].astype(np.uint64)
             limbs.append(limb)
         limbs.append(np.array([len(v) for v in enc], np.uint64))
+    elif isinstance(dt, T.DecimalType) and data.dtype == object:
+        # decimal128 host rep: python ints — split to biased hi + lo
+        hi = np.array([int(v) >> 64 for v in data], dtype=np.int64)
+        lo = np.array([int(v) & 0xFFFFFFFFFFFFFFFF for v in data],
+                      dtype=np.uint64)
+        hi_u = (hi.astype(np.int64) ^ np.int64(-(1 << 63))).view(
+            np.uint64)
+        limbs = [hi_u, lo]  # the shared tail applies the desc flip
     elif isinstance(dt, T.FloatType):
         canon = np.where(np.isnan(data), np.float32(np.nan),
                          data.astype(np.float32))

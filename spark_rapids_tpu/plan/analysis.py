@@ -83,6 +83,11 @@ def common_type(a: T.DataType, b: T.DataType) -> T.DataType:
         return a
     if T.is_integral(a) and isinstance(b, T.DecimalType):
         return b
+    if isinstance(a, T.DecimalType) and isinstance(b, T.DecimalType):
+        # widest: keep every integer digit and every fraction digit
+        scale = max(a.scale, b.scale)
+        intd = max(a.precision - a.scale, b.precision - b.scale)
+        return T.DecimalType(min(intd + scale, 38), scale)
     if isinstance(a, T.DateType) and isinstance(b, T.TimestampType):
         return b
     if isinstance(a, T.TimestampType) and isinstance(b, T.DateType):
@@ -144,6 +149,26 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
         r = resolve(u.children[1], schema)
         if isinstance(l.dtype, T.StringType) or isinstance(r.dtype, T.StringType):
             raise AnalysisException(f"'{op}' needs numeric operands")
+        if (isinstance(l.dtype, T.DecimalType)
+                and isinstance(r.dtype, T.DecimalType)):
+            # Spark decimal arithmetic result types (non-ANSI; beyond
+            # precision 38 is rejected rather than scale-adjusted)
+            p1, s1 = l.dtype.precision, l.dtype.scale
+            p2, s2 = r.dtype.precision, r.dtype.scale
+            if op == "mul":
+                rt = T.DecimalType(min(p1 + p2 + 1, 38), s1 + s2)
+                if rt.scale > rt.precision:
+                    raise AnalysisException(
+                        f"decimal multiply result scale {rt.scale} "
+                        "exceeds precision 38")
+                return _BIN_ARITH[op](l, r, rt)
+            if op in ("add", "sub"):
+                ct = common_type(l.dtype, r.dtype)
+                rt = T.DecimalType(min(ct.precision + 1, 38), ct.scale)
+                return _BIN_ARITH[op](cast_to(l, ct), cast_to(r, ct),
+                                      rt)
+            raise AnalysisException(
+                f"decimal '{op}' not supported")
         l, r = _coerce_pair(l, r)
         return _BIN_ARITH[op](l, r)
     if op == "div":
@@ -549,4 +574,11 @@ def _parse_type(s: str) -> T.DataType:
     key = str(s).strip().lower()
     if key in m:
         return m[key]
+    import re as _re
+    dm = _re.fullmatch(r"decimal\s*\(\s*(\d+)\s*,\s*(\d+)\s*\)", key)
+    if dm:
+        p, sc = int(dm.group(1)), int(dm.group(2))
+        if not (0 < p <= 38 and 0 <= sc <= p):
+            raise AnalysisException(f"invalid decimal type {s!r}")
+        return T.DecimalType(p, sc)
     raise AnalysisException(f"cannot parse type string {s!r}")

@@ -39,9 +39,8 @@ from spark_rapids_tpu.ops.expressions import Expression
 def is_device_supported_type(dt: T.DataType) -> Optional[str]:
     """None if supported on device; else the reason string."""
     if isinstance(dt, T.DecimalType):
-        if dt.precision > T.DecimalType.MAX_LONG_DIGITS:
-            return (f"decimal precision {dt.precision} > 18 "
-                    "(decimal128 not yet enabled)")
+        if dt.precision > 38:
+            return f"decimal precision {dt.precision} > 38"
         return None
     if isinstance(dt, (T.ArrayType, T.MapType, T.StructType)):
         return f"nested type {dt.simple_name} not yet supported on device"
@@ -263,6 +262,12 @@ def _tag_aggregate(meta: ExecMeta):
             continue
         if not isinstance(fn, CountStar):
             meta.tag_expressions([fn.child])
+        from spark_rapids_tpu.ops.decimal128 import is128 as _is128
+        if (isinstance(fn, (Min, Max, First, _VarianceBase))
+                and fn.child is not None and _is128(fn.input_dtype)):
+            meta.will_not_work(
+                f"aggregate {fn.name} over decimal128 input not yet on "
+                "device (sum/count/avg are)")
         if isinstance(fn, (Min, Max, First)) and isinstance(
                 fn.input_dtype, (T.StringType, T.BinaryType)):
             meta.will_not_work(

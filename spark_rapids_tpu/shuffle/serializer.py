@@ -98,7 +98,10 @@ def _descs(cols: Sequence[HostColView]):
         if c.is_string:
             kind, isz = 1, int(c.data.shape[1]) if c.data.ndim == 2 else 1
         else:
-            kind, isz = 0, int(c.data.dtype.itemsize)
+            # isz = bytes per ROW: decimal128 rides as int64[n, 2]
+            kind = 0
+            isz = int(c.data.dtype.itemsize) * (
+                int(c.data.shape[1]) if c.data.ndim == 2 else 1)
         arr[i] = _ColDesc(
             c.data.ctypes.data, None if c.validity is None
             else c.validity.ctypes.data,
@@ -158,7 +161,9 @@ def _py_serialize_one(cols, idx: np.ndarray) -> bytes:
         if c.is_string:
             kind, isz = 1, int(c.data.shape[1]) if c.data.ndim == 2 else 1
         else:
-            kind, isz = 0, int(c.data.dtype.itemsize)
+            kind = 0
+            isz = int(c.data.dtype.itemsize) * (
+                int(c.data.shape[1]) if c.data.ndim == 2 else 1)
         parts.append(struct.pack("<BBH", kind, 1 if c.validity is not None
                                  else 0, isz))
     for c in cols:
@@ -209,6 +214,13 @@ def deserialize(buf, schema: T.StructType
                       - np.repeat(np.cumsum(lengths) - lengths, lengths))
                 mat[ii, jj] = packed
             data, lens = mat, lengths
+        elif (isinstance(f.dtype, T.DecimalType)
+              and f.dtype.precision > T.DecimalType.MAX_LONG_DIGITS):
+            assert isz == 16, (f.name, isz)
+            data = np.frombuffer(buf, np.int64, nrows * 2,
+                                 off).reshape(nrows, 2)
+            off += nrows * 16
+            lens = None
         else:
             npdt = np.dtype(T.to_numpy_dtype(f.dtype))
             assert npdt.itemsize == isz, (f.name, npdt, isz)
