@@ -71,10 +71,10 @@ def from_arrow_column(col, dt: T.DataType) -> HostCol:
             # ints (unscaled) — CPU-oracle arithmetic stays bit-exact
             c = (col.combine_chunks()
                  if isinstance(col, pa.ChunkedArray) else col)
+            from spark_rapids_tpu.ops.decimal128 import py_unscaled
             data = np.empty(len(c), dtype=object)
             for i, v in enumerate(c.to_pylist()):
-                data[i] = 0 if v is None else int(
-                    v.scaleb(dt.scale).to_integral_value())
+                data[i] = 0 if v is None else py_unscaled(v, dt.scale)
         else:
             from spark_rapids_tpu.columnar.column import _decimal_to_int64
             data = np.where(nulls, 0, _decimal_to_int64(col))

@@ -830,7 +830,7 @@ def _vals_to_col(vals: List[object], dt: T.DataType) -> H.HostCol:
             if v is None:
                 data[i] = 0
                 continue
-            w = D128.py_wrap128(int(v))
+            w = int(v)
             if not D128.py_fits(w, dt.precision):
                 validity[i] = False
                 w = 0
@@ -866,10 +866,11 @@ def _tag_window(meta):
         if wf.child is not None:
             meta.tag_expressions([wf.child])
             from spark_rapids_tpu.ops.decimal128 import is128 as _is128
-            if _is128(wf.child.dtype):
+            if _is128(wf.child.dtype) or _is128(wf.dtype):
                 meta.will_not_work(
-                    f"window {wf.kind} over decimal128 input not yet "
-                    "on device (1-D scan kernels lack the carry)")
+                    f"window {wf.kind} over/into decimal128 not yet "
+                    "on device (1-D scan kernels lack the carry; a "
+                    "small-decimal SUM widens past 18 digits)")
             if wf.kind in ("min", "max", "first") and isinstance(
                     wf.child.dtype, (T.StringType, T.BinaryType)):
                 meta.will_not_work(

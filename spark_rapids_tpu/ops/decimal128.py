@@ -249,6 +249,74 @@ def np_unpack(data: np.ndarray) -> np.ndarray:
     return out
 
 
+def add_checked(a: jnp.ndarray, b: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(a + b, ok) — ok False on signed-128 overflow (same-sign operands
+    producing the opposite sign), so a wrap can never masquerade as an
+    in-precision value."""
+    s = add(a, b)
+    sa, sb, sr = is_negative(a), is_negative(b), is_negative(s)
+    return s, ~((sa == sb) & (sr != sa))
+
+
+def sub_checked(a: jnp.ndarray, b: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    return add_checked(a, neg(b))
+
+
+def mul_checked(a: jnp.ndarray, b: jnp.ndarray
+                ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(a * b, ok) — schoolbook over MAGNITUDES with explicit overflow
+    detection (dropped high columns, final carry, or a magnitude taking
+    the sign bit), so results beyond 2^127 cannot wrap back into the
+    valid range."""
+    ma, sa = abs128(a)
+    mb, sb = abs128(b)
+    a3, a2, a1, a0 = _limbs32(ma)
+    b3, b2, b1, b0 = _limbs32(mb)
+
+    def p(x, y):
+        v = x * y
+        return (v >> jnp.int64(32)) & _MASK32, v & _MASK32
+
+    c0 = jnp.zeros_like(a0)
+    c1 = jnp.zeros_like(a0)
+    c2 = jnp.zeros_like(a0)
+    c3 = jnp.zeros_like(a0)
+    ovf = jnp.zeros(a0.shape, jnp.bool_)
+    for i, ai in enumerate((a3, a2, a1, a0)):
+        for j, bj in enumerate((b3, b2, b1, b0)):
+            k = (3 - i) + (3 - j)
+            ph, pl = p(ai, bj)
+            if k > 3:
+                ovf = ovf | (pl != 0) | (ph != 0)
+                continue
+            if k == 0:
+                c0 = c0 + pl
+                c1 = c1 + ph
+            elif k == 1:
+                c1 = c1 + pl
+                c2 = c2 + ph
+            elif k == 2:
+                c2 = c2 + pl
+                c3 = c3 + ph
+            else:
+                c3 = c3 + pl
+                ovf = ovf | (ph != 0)
+    l0 = c0 & _MASK32
+    c1 = c1 + (c0 >> jnp.int64(32))
+    l1 = c1 & _MASK32
+    c2 = c2 + (c1 >> jnp.int64(32))
+    l2 = c2 & _MASK32
+    c3 = c3 + (c2 >> jnp.int64(32))
+    l3 = c3 & _MASK32
+    ovf = ovf | ((c3 >> jnp.int64(32)) != 0)
+    mag = _from_limbs32(l3, l2, l1, l0)
+    ovf = ovf | is_negative(mag)  # magnitude took the sign bit
+    sign = sa ^ sb
+    return jnp.where(sign[..., None], neg(mag), mag), ~ovf
+
+
 def fits_precision(a: jnp.ndarray, precision: int) -> jnp.ndarray:
     """|a| < 10^precision — Spark nulls decimal results that overflow
     their declared precision (non-ANSI)."""
@@ -265,3 +333,23 @@ def py_wrap128(v: int) -> int:
 
 def py_fits(v: int, precision: int) -> bool:
     return abs(int(v)) < 10 ** precision
+
+
+def py_rescale_half_up(v: int, k: int) -> int:
+    """Exact python-int rescale by 10^k (HALF_UP away from zero for
+    negative k) — no decimal.Context rounding surprises."""
+    v = int(v)
+    if k >= 0:
+        return v * (10 ** k)
+    d = 10 ** (-k)
+    q, r = divmod(abs(v), d)
+    q += 1 if 2 * r >= d else 0
+    return -q if v < 0 else q
+
+
+def py_unscaled(dec, scale: int) -> int:
+    """Exact unscaled int of a decimal.Decimal at the given scale."""
+    sign, digits, exp = dec.as_tuple()
+    mag = int("".join(map(str, digits)) or "0")
+    v = -mag if sign else mag
+    return py_rescale_half_up(v, exp + scale)

@@ -160,8 +160,8 @@ class Literal(Expression):
         v = self.value
         if isinstance(self.dtype, T.DecimalType):
             import decimal as _d
-            v = int(_d.Decimal(str(v)).scaleb(self.dtype.scale))
             from spark_rapids_tpu.ops import decimal128 as D128
+            v = D128.py_unscaled(_d.Decimal(str(v)), self.dtype.scale)
             if D128.is128(self.dtype):
                 pair = D128.np_pack([v])
                 return DeviceColumn(self.dtype, jnp.broadcast_to(
@@ -183,7 +183,8 @@ class Literal(Expression):
         v = self.value
         if isinstance(self.dtype, T.DecimalType):
             import decimal as _d
-            v = int(_d.Decimal(str(v)).scaleb(self.dtype.scale))
+            from spark_rapids_tpu.ops import decimal128 as D128
+            v = D128.py_unscaled(_d.Decimal(str(v)), self.dtype.scale)
             if self.dtype.precision > T.DecimalType.MAX_LONG_DIGITS:
                 out = np.empty(n, dtype=object)
                 out[:] = v
@@ -267,11 +268,11 @@ class _BinaryArith(Expression):
                 return (c.data if D128.is128(c.dtype)
                         else D128.from_i64(c.data))
 
-            data = op(to128(l), to128(r))
+            data, ok = op(to128(l), to128(r))
             validity = merge_validity_d(l.validity, r.validity)
-            # Spark non-ANSI: overflow beyond the result precision
-            # nulls the row
-            fits = D128.fits_precision(data, self.dtype.precision)
+            # Spark non-ANSI: overflow beyond the result precision (or
+            # the 128-bit container) nulls the row
+            fits = ok & D128.fits_precision(data, self.dtype.precision)
             validity = fits if validity is None else validity & fits
             return DeviceColumn(self.dtype, data, validity)
         data = self._op_d(l.data, r.data)
@@ -286,16 +287,17 @@ class _BinaryArith(Expression):
             la = np.array([int(v) for v in l.data], dtype=object)
             ra = np.array([int(v) for v in r.data], dtype=object)
             data = self._op_h(la, ra)
-            # wrap mod 2^128 like the device container, then apply the
-            # Spark overflow-to-null rule on the declared precision
-            wrapped = np.empty(len(data), dtype=object)
-            for i, v in enumerate(data):
-                wrapped[i] = D128.py_wrap128(v)
+            # exact python-int result; overflow beyond the declared
+            # precision nulls the row (values stored 0 to stay in the
+            # arrow container)
             fits = np.array([D128.py_fits(v, self.dtype.precision)
-                             for v in wrapped], dtype=bool)
+                             for v in data], dtype=bool)
+            out = np.empty(len(data), dtype=object)
+            for i, v in enumerate(data):
+                out[i] = int(v) if fits[i] else 0
             validity = merge_validity_h(l.validity, r.validity)
             validity = fits if validity is None else validity & fits
-            return HostCol(self.dtype, wrapped, validity)
+            return HostCol(self.dtype, out, validity)
         with np.errstate(all="ignore"):
             data = self._op_h(l.data, r.data)
         return HostCol(self.dtype, data,
@@ -304,7 +306,7 @@ class _BinaryArith(Expression):
 
 class Add(_BinaryArith):
     from spark_rapids_tpu.ops import decimal128 as _D
-    _d128_op = staticmethod(_D.add)
+    _d128_op = staticmethod(_D.add_checked)
 
     def _op_d(self, a, b):
         return a + b
@@ -315,7 +317,7 @@ class Add(_BinaryArith):
 
 class Subtract(_BinaryArith):
     from spark_rapids_tpu.ops import decimal128 as _D
-    _d128_op = staticmethod(_D.sub)
+    _d128_op = staticmethod(_D.sub_checked)
 
     def _op_d(self, a, b):
         return a - b
@@ -326,7 +328,7 @@ class Subtract(_BinaryArith):
 
 class Multiply(_BinaryArith):
     from spark_rapids_tpu.ops import decimal128 as _D
-    _d128_op = staticmethod(_D.mul)
+    _d128_op = staticmethod(_D.mul_checked)
 
     def _op_d(self, a, b):
         return a * b
@@ -1177,9 +1179,11 @@ class Cast(Expression):
                 "device")
 
     def _decimal_combo(self):
-        """(src_scale_delta handling needed?)  Returns None when this
-        cast does not involve decimals."""
+        """Non-string decimal cast combo, else None (string<->decimal
+        dispatches through the string paths)."""
         src, dst = self.child.dtype, self.dtype
+        if isinstance(src, T.StringType) or isinstance(dst, T.StringType):
+            return None
         if not (isinstance(src, T.DecimalType)
                 or isinstance(dst, T.DecimalType)):
             return None
@@ -1222,7 +1226,7 @@ class Cast(Expression):
         raise NotImplementedError(f"cast {src}→{dst} on device")
 
     def _cast_decimal_cpu(self, c):
-        import decimal as _d
+        from spark_rapids_tpu.ops.decimal128 import py_rescale_half_up
         src, dst = self.child.dtype, self.dtype
         n = len(c.data)
         if isinstance(dst, T.DecimalType):
@@ -1230,12 +1234,7 @@ class Cast(Expression):
                  if isinstance(src, T.DecimalType) else dst.scale)
             out = np.empty(n, dtype=object)
             for i in range(n):
-                v = int(c.data[i])
-                if k >= 0:
-                    out[i] = v * (10 ** k)
-                else:
-                    out[i] = int((_d.Decimal(v) / (10 ** (-k))).quantize(
-                        0, rounding=_d.ROUND_HALF_UP))
+                out[i] = py_rescale_half_up(int(c.data[i]), k)
             bound = 10 ** dst.precision
             fits = np.array([abs(int(v)) < bound for v in out], bool)
             validity = (fits if c.validity is None
@@ -1292,9 +1291,48 @@ class Cast(Expression):
                     out[i] = "true" if v else "false"
                 elif isinstance(src, (T.FloatType, T.DoubleType)):
                     out[i] = repr(float(v))
+                elif isinstance(src, T.DecimalType):
+                    u = int(v)
+                    sc = src.scale
+                    sign = "-" if u < 0 else ""
+                    m = str(abs(u))
+                    if sc == 0:
+                        out[i] = sign + m
+                    else:
+                        m = m.rjust(sc + 1, "0")
+                        out[i] = sign + m[:-sc] + "." + m[-sc:]
                 else:
                     out[i] = str(v)
             return HostCol(dst, out, c.validity)
+        if isinstance(dst, T.DecimalType):
+            # string -> decimal: parse exactly, HALF_UP to the target
+            # scale, overflow/invalid -> null (non-ANSI)
+            import decimal as _d
+            from spark_rapids_tpu.ops import decimal128 as D128
+            big = dst.precision > T.DecimalType.MAX_LONG_DIGITS
+            out = (np.empty(n, object) if big
+                   else np.zeros(n, np.int64))
+            validity = c.valid_mask().copy()
+            for i in range(n):
+                if not validity[i]:
+                    if big:
+                        out[i] = 0
+                    continue
+                try:
+                    dec = _d.Decimal(str(c.data[i]).strip())
+                    if not dec.is_finite():
+                        raise _d.InvalidOperation
+                except _d.InvalidOperation:
+                    validity[i] = False
+                    if big:
+                        out[i] = 0
+                    continue
+                u = D128.py_unscaled(dec, dst.scale)
+                if not D128.py_fits(u, dst.precision):
+                    validity[i] = False
+                    u = 0
+                out[i] = u
+            return HostCol(dst, out, validity)
         # string -> numeric: invalid -> null (non-ANSI).  Integral casts
         # accept decimal strings truncated toward zero ('3.7' -> 3) and
         # null out-of-range values, matching Spark (and the device

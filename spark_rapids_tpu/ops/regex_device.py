@@ -1,0 +1,646 @@
+"""Device regex engine: Java-regex subset → DFA tables interpreted over
+the byte matrices.
+
+[REF: sql-plugin/../RegexParser.scala :: CudfRegexTranspiler — the
+reference transpiles Java regex to cuDF's regex engine; SURVEY §2.2 N5
+prescribes "pre-compiled NFA table interpreted in a kernel" for TPU.]
+
+Pipeline (plan time, pattern is a literal): parse the supported subset →
+Thompson NFA → subset-construction DFA over the 256-byte alphabet →
+``DeviceRegex`` (transition table int32[S,256], accept bool[S], flags).
+Unsupported constructs return ``None`` and the expression stays on the
+host ``re`` path with a tag reason.
+
+Matching (device or host — ONE shared simulation, so the CPU oracle and
+the kernel agree byte-for-byte): all match starts run simultaneously as
+a [B, W] state matrix; step j feeds byte j to every run whose start
+s <= j.  The result is the leftmost-LONGEST match-length table — equal
+to Java's leftmost-greedy result for the gated subset (alternation is
+excluded from extract/replace, where greedy != longest can differ).
+
+Byte-level semantics: ``.`` and classes act on BYTES.  For ASCII data
+this equals Java exactly; multi-byte UTF-8 code points count as
+multiple ``.`` positions (documented divergence, same on both paths).
+
+Supported: literals, escapes (\\n \\t \\r \\d \\D \\w \\W \\s \\S \\.
+etc.), ``.``, char classes with ranges/negation, ``(?:...)``/``(...)``
+grouping (no capture extraction), ``|``, greedy ``* + ? {m} {m,}
+{m,n}``, ``^`` at pattern start, ``$`` at pattern end (Java find
+semantics: also matches before a final \\n, \\r\\n or \\r).
+Rejected: lazy/possessive quantifiers, backreferences, lookaround,
+mid-pattern anchors, \\b, \\p{...}, non-ASCII pattern characters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+import numpy as np
+
+MAX_DFA_STATES = 192
+_LINE_TERMS = (10, 13)  # \n, \r — '.' excludes these (Java non-DOTALL)
+
+
+class Unsupported(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Parser → NFA (Thompson construction)
+# ---------------------------------------------------------------------------
+
+class _Nfa:
+    def __init__(self):
+        self.eps: List[List[int]] = []
+        self.trans: List[List[Tuple[np.ndarray, int]]] = []
+
+    def new_state(self) -> int:
+        self.eps.append([])
+        self.trans.append([])
+        return len(self.eps) - 1
+
+
+def _class_bytes(chars) -> np.ndarray:
+    m = np.zeros(256, bool)
+    for c in chars:
+        m[c] = True
+    return m
+
+
+_D = _class_bytes(range(48, 58))
+_W = _class_bytes(list(range(48, 58)) + list(range(65, 91))
+                  + list(range(97, 123)) + [95])
+_S = _class_bytes([32, 9, 10, 11, 12, 13])
+_DOT = ~_class_bytes(_LINE_TERMS)
+
+
+def _escape_set(ch: str) -> Optional[np.ndarray]:
+    if ch == "d":
+        return _D
+    if ch == "D":
+        return ~_D
+    if ch == "w":
+        return _W
+    if ch == "W":
+        return ~_W
+    if ch == "s":
+        return _S
+    if ch == "S":
+        return ~_S
+    return None
+
+
+_ESC_LIT = {"n": 10, "t": 9, "r": 13, "f": 12, "a": 7, "e": 27, "0": 0}
+
+
+class _Parser:
+    """Recursive-descent over the supported subset; builds NFA fragments
+    (start, end) with eps/byte-set transitions."""
+
+    def __init__(self, pattern: str, nfa: _Nfa):
+        self.p = pattern
+        self.i = 0
+        self.nfa = nfa
+
+    def peek(self) -> str:
+        return self.p[self.i] if self.i < len(self.p) else ""
+
+    def take(self) -> str:
+        ch = self.p[self.i]
+        self.i += 1
+        return ch
+
+    def parse(self) -> Tuple[int, int]:
+        s, e = self.alternation()
+        if self.i != len(self.p):
+            raise Unsupported(f"unexpected '{self.peek()}'")
+        return s, e
+
+    def alternation(self) -> Tuple[int, int]:
+        frags = [self.concat()]
+        while self.peek() == "|":
+            self.take()
+            frags.append(self.concat())
+        if len(frags) == 1:
+            return frags[0]
+        s = self.nfa.new_state()
+        e = self.nfa.new_state()
+        for fs, fe in frags:
+            self.nfa.eps[s].append(fs)
+            self.nfa.eps[fe].append(e)
+        return s, e
+
+    def concat(self) -> Tuple[int, int]:
+        s = self.nfa.new_state()
+        cur = s
+        while self.peek() not in ("", "|", ")"):
+            fs, fe = self.repeat()
+            self.nfa.eps[cur].append(fs)
+            cur = fe
+        return s, cur
+
+    def repeat(self) -> Tuple[int, int]:
+        fs, fe = self.atom()
+        while self.peek() in ("*", "+", "?", "{"):
+            op = self.peek()
+            if op == "{":
+                save = self.i
+                lo, hi = self._braces()
+                if lo is None:
+                    self.i = save
+                    break
+                fs, fe = self._repeat_range(fs, fe, lo, hi)
+            else:
+                self.take()
+                if self.peek() in ("?", "+"):
+                    raise Unsupported("lazy/possessive quantifier")
+                if op == "*":
+                    fs, fe = self._repeat_range(fs, fe, 0, None)
+                elif op == "+":
+                    fs, fe = self._repeat_range(fs, fe, 1, None)
+                else:
+                    fs, fe = self._repeat_range(fs, fe, 0, 1)
+            # only one quantifier per atom (a** is a Java error anyway)
+            break
+        return fs, fe
+
+    def _braces(self):
+        assert self.take() == "{"
+        num = ""
+        while self.peek().isdigit():
+            num += self.take()
+        if not num:
+            return None, None
+        lo = int(num)
+        hi = lo
+        if self.peek() == ",":
+            self.take()
+            num2 = ""
+            while self.peek().isdigit():
+                num2 += self.take()
+            hi = int(num2) if num2 else None
+        if self.peek() != "}":
+            return None, None
+        self.take()
+        if self.peek() in ("?", "+"):
+            raise Unsupported("lazy/possessive quantifier")
+        if lo > 64 or (hi is not None and (hi > 64 or hi < lo)):
+            raise Unsupported("repetition count too large")
+        return lo, hi
+
+    def _clone(self, fs: int, fe: int, mapping=None) -> Tuple[int, int]:
+        """Deep-copy an NFA fragment (for counted repetition)."""
+        mapping: Dict[int, int] = {}
+        stack = [fs]
+        seen = {fs}
+        order = []
+        while stack:
+            st = stack.pop()
+            order.append(st)
+            for t in self.nfa.eps[st]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+            for _, t in self.nfa.trans[st]:
+                if t not in seen:
+                    seen.add(t)
+                    stack.append(t)
+        for st in order:
+            mapping[st] = self.nfa.new_state()
+        for st in order:
+            self.nfa.eps[mapping[st]] = [mapping[t]
+                                         for t in self.nfa.eps[st]]
+            self.nfa.trans[mapping[st]] = [
+                (bs, mapping[t]) for bs, t in self.nfa.trans[st]]
+        return mapping[fs], mapping[fe]
+
+    def _repeat_range(self, fs, fe, lo, hi) -> Tuple[int, int]:
+        s = self.nfa.new_state()
+        cur = s
+        for _ in range(lo):
+            cs, ce = self._clone(fs, fe)
+            self.nfa.eps[cur].append(cs)
+            cur = ce
+        e = self.nfa.new_state()
+        if hi is None:  # unbounded tail: loop
+            cs, ce = self._clone(fs, fe)
+            self.nfa.eps[cur].append(cs)
+            self.nfa.eps[cur].append(e)
+            self.nfa.eps[ce].append(cs)
+            self.nfa.eps[ce].append(e)
+        else:
+            for _ in range(hi - lo):
+                cs, ce = self._clone(fs, fe)
+                self.nfa.eps[cur].append(cs)
+                self.nfa.eps[cur].append(e)
+                cur = ce
+            self.nfa.eps[cur].append(e)
+        return s, e
+
+    def _byte_frag(self, byteset: np.ndarray) -> Tuple[int, int]:
+        s = self.nfa.new_state()
+        e = self.nfa.new_state()
+        self.nfa.trans[s].append((byteset, e))
+        return s, e
+
+    def atom(self) -> Tuple[int, int]:
+        ch = self.peek()
+        if ch == "(":
+            self.take()
+            if self.peek() == "?":
+                self.take()
+                if self.peek() != ":":
+                    raise Unsupported("lookaround / named group")
+                self.take()
+            frag = self.alternation()
+            if self.peek() != ")":
+                raise Unsupported("unbalanced group")
+            self.take()
+            return frag
+        if ch == "[":
+            return self._byte_frag(self._char_class())
+        if ch == ".":
+            self.take()
+            return self._byte_frag(_DOT)
+        if ch == "\\":
+            self.take()
+            if self.i >= len(self.p):
+                raise Unsupported("trailing backslash")
+            nxt = self.take()
+            cls = _escape_set(nxt)
+            if cls is not None:
+                return self._byte_frag(cls)
+            if nxt in ("b", "B", "A", "Z", "z", "G"):
+                raise Unsupported(f"anchor escape \\{nxt}")
+            if nxt in ("p", "P"):
+                raise Unsupported("\\p classes")
+            if nxt.isdigit() and nxt != "0":
+                raise Unsupported("backreference")
+            code = _ESC_LIT.get(nxt, None)
+            if code is None:
+                if ord(nxt) > 127:
+                    raise Unsupported("non-ASCII pattern")
+                if nxt.isalnum():
+                    # \x41, \uFFFF, \cX, \Q...: Java-special escapes
+                    raise Unsupported(f"escape \\{nxt}")
+                code = ord(nxt)
+            return self._byte_frag(_class_bytes([code]))
+        if ch in ("^", "$"):
+            raise Unsupported("mid-pattern anchor")
+        if ch in ("*", "+", "?", "{", ")"):
+            raise Unsupported(f"dangling '{ch}'")
+        self.take()
+        if ord(ch) > 127:
+            raise Unsupported("non-ASCII pattern")
+        return self._byte_frag(_class_bytes([ord(ch)]))
+
+    def _char_class(self) -> np.ndarray:
+        assert self.take() == "["
+        neg = False
+        if self.peek() == "^":
+            neg = True
+            self.take()
+        mask = np.zeros(256, bool)
+        first = True
+        while True:
+            ch = self.peek()
+            if ch == "":
+                raise Unsupported("unterminated class")
+            if ch == "]" and not first:
+                self.take()
+                break
+            first = False
+            if ch == "\\":
+                self.take()
+                nxt = self.take()
+                cls = _escape_set(nxt)
+                if cls is not None:
+                    mask |= cls
+                    continue
+                code = _ESC_LIT.get(nxt)
+                if code is None:
+                    if nxt.isalnum():
+                        raise Unsupported(f"escape \\{nxt}")
+                    code = ord(nxt)
+                lo_c = code
+            else:
+                self.take()
+                if ord(ch) > 127:
+                    raise Unsupported("non-ASCII pattern")
+                lo_c = ord(ch)
+            if self.peek() == "-" and self.i + 1 < len(self.p) \
+                    and self.p[self.i + 1] != "]":
+                self.take()
+                hc = self.take()
+                if hc == "\\":
+                    hc = self.take()
+                    hi_c = _ESC_LIT.get(hc, ord(hc))
+                else:
+                    if ord(hc) > 127:
+                        raise Unsupported("non-ASCII pattern")
+                    hi_c = ord(hc)
+                if hi_c < lo_c:
+                    raise Unsupported("bad class range")
+                mask[lo_c:hi_c + 1] = True
+            else:
+                mask[lo_c] = True
+        return ~mask if neg else mask
+
+
+# ---------------------------------------------------------------------------
+# NFA → DFA (subset construction)
+# ---------------------------------------------------------------------------
+
+def _eps_closure(nfa: _Nfa, states: FrozenSet[int]) -> FrozenSet[int]:
+    out = set(states)
+    stack = list(states)
+    while stack:
+        s = stack.pop()
+        for t in nfa.eps[s]:
+            if t not in out:
+                out.add(t)
+                stack.append(t)
+    return frozenset(out)
+
+
+@dataclasses.dataclass
+class DeviceRegex:
+    table: np.ndarray          # int32 [S, 256]; state 0 = dead
+    accept: np.ndarray         # bool [S]
+    start_state: int
+    anchored_start: bool
+    anchored_end: bool
+    has_alternation: bool
+    matches_empty: bool
+    pattern: str
+
+
+def compile_regex(pattern: str) -> Optional[DeviceRegex]:
+    """DFA-compile the pattern; None when outside the device subset."""
+    try:
+        if any(ord(c) > 127 for c in pattern):
+            raise Unsupported("non-ASCII pattern")
+        body = pattern
+        anchored_start = body.startswith("^")
+        if anchored_start:
+            body = body[1:]
+        anchored_end = body.endswith("$") and not body.endswith("\\$")
+        if anchored_end:
+            body = body[:-1]
+        if anchored_start or anchored_end:
+            # Java scopes ^/$ to the adjacent ALTERNATIVE, not the whole
+            # pattern ('^a|b' == (^a)|(b)) — reject top-level '|'
+            depth = 0
+            i = 0
+            while i < len(body):
+                ch = body[i]
+                if ch == "\\":
+                    i += 2
+                    continue
+                if ch == "(":
+                    depth += 1
+                elif ch == ")":
+                    depth -= 1
+                elif ch == "|" and depth == 0:
+                    raise Unsupported("anchor with top-level alternation")
+                i += 1
+        nfa = _Nfa()
+        parser = _Parser(body, nfa)
+        start, end = parser.parse()
+        has_alt = "|" in body
+
+        s0 = _eps_closure(nfa, frozenset([start]))
+        states: Dict[FrozenSet[int], int] = {s0: 1}
+        worklist = [s0]
+        rows = {1: np.zeros(256, np.int32)}
+        accepts = {1: end in s0}
+        while worklist:
+            cur = worklist.pop()
+            ci = states[cur]
+            row = rows[ci]
+            # group target NFA-state sets per byte
+            move: List[Optional[set]] = [None] * 256
+            for s in cur:
+                for byteset, t in nfa.trans[s]:
+                    idxs = np.nonzero(byteset)[0]
+                    for bval in idxs:
+                        if move[bval] is None:
+                            move[bval] = set()
+                        move[bval].add(t)
+            cache: Dict[FrozenSet[int], int] = {}
+            for bval in range(256):
+                if move[bval] is None:
+                    continue
+                key = frozenset(move[bval])
+                di = cache.get(key)
+                if di is None:
+                    clo = _eps_closure(nfa, key)
+                    di = states.get(clo)
+                    if di is None:
+                        if len(states) + 1 > MAX_DFA_STATES:
+                            raise Unsupported("DFA too large")
+                        di = len(states) + 1
+                        states[clo] = di
+                        rows[di] = np.zeros(256, np.int32)
+                        accepts[di] = end in clo
+                        worklist.append(clo)
+                    cache[key] = di
+                row[bval] = di
+        nstates = len(states) + 1
+        table = np.zeros((nstates, 256), np.int32)
+        accept = np.zeros(nstates, bool)
+        for di, row in rows.items():
+            table[di] = row
+        for di, a in accepts.items():
+            accept[di] = a
+        return DeviceRegex(table, accept, 1, anchored_start,
+                           anchored_end, has_alt, bool(accept[1]),
+                           pattern)
+    except Unsupported:
+        return None
+    except RecursionError:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Shared simulation (jnp on device, np on the CPU oracle — identical)
+# ---------------------------------------------------------------------------
+
+def _end_ok_mask(data, lengths, rx: DeviceRegex, xp):
+    """[B, W+1] — position p is a legal match END.
+
+    Unanchored: any p <= len.  ``$``: p == len, or just before a final
+    \\n, \\r\\n or \\r (Java Pattern ``$`` under find)."""
+    b, w = data.shape
+    pos = xp.arange(w + 1, dtype=xp.int32)[None, :]
+    ln = lengths[:, None].astype(xp.int32)
+    if not rx.anchored_end:
+        return pos <= ln
+    at_end = pos == ln
+    last = xp.clip(ln - 1, 0, w - 1)
+    last_b = xp.take_along_axis(
+        data, last.astype(xp.int64 if xp is np else xp.int32), axis=1)
+    is_nl = (last_b == 10) | (last_b == 13)
+    before_final = (pos == ln - 1) & is_nl & (ln >= 1)
+    last2 = xp.clip(ln - 2, 0, w - 1)
+    last2_b = xp.take_along_axis(
+        data, last2.astype(xp.int64 if xp is np else xp.int32), axis=1)
+    crlf = (last2_b == 13) & (last_b == 10) & (ln >= 2)
+    before_crlf = (pos == ln - 2) & crlf
+    return at_end | before_final | before_crlf
+
+
+def match_lens(data, lengths, rx: DeviceRegex, xp):
+    """Leftmost-longest match length per start → int32 [B, W+1]
+    (-1 = no match at that start; column W covers the empty match at
+    end-of-string).  Starts beyond the row length are -1 except the
+    end-of-string empty-match column."""
+    b, w = data.shape
+    flat = rx.table.reshape(-1).astype(np.int32)
+    acc = rx.accept
+    if xp is not np:
+        import jax.numpy as jnp
+        flat = jnp.asarray(flat)
+        acc = jnp.asarray(acc)
+    col = xp.arange(w + 1, dtype=xp.int32)[None, :]
+    ln = lengths[:, None].astype(xp.int32)
+    end_ok = _end_ok_mask(data, lengths, rx, xp)
+    valid_start = col <= ln
+    if rx.anchored_start:
+        valid_start = valid_start & (col == 0)
+    state = xp.full((b, w + 1), rx.start_state, np.int32)
+    mlen = xp.where(valid_start & rx.matches_empty & end_ok,
+                    xp.int32(0), xp.int32(-1))
+    if xp is np:
+        for j in range(w):
+            byte = data[:, j].astype(np.int32)[:, None]
+            nxt = np.take(flat, state * 256 + byte)
+            active = (col <= j) & (j < ln) & valid_start
+            state = np.where(active, nxt, state)
+            ok = np.take(acc, state) & active & end_ok[:, j + 1][:, None]
+            mlen = np.where(ok, j + 1 - col, mlen)
+        return mlen
+    # device: lax.fori_loop keeps the traced graph O(1) in W (an
+    # unrolled W-stage pipeline is exactly the compile-cost pathology
+    # this backend budgets against)
+    import jax
+    import jax.numpy as jnp
+
+    data_i = data.astype(jnp.int32)
+    end_ok_i = end_ok
+
+    def body(j, carry):
+        state, mlen = carry
+        byte = jax.lax.dynamic_slice_in_dim(data_i, j, 1, 1)  # [B,1]
+        nxt = jnp.take(flat, state * 256 + byte)
+        active = (col <= j) & (j < ln) & valid_start
+        state = jnp.where(active, nxt, state)
+        eok = jax.lax.dynamic_slice_in_dim(end_ok_i, j + 1, 1, 1)
+        ok = jnp.take(acc, state) & active & eok
+        mlen = jnp.where(ok, (j + 1 - col).astype(jnp.int32), mlen)
+        return state, mlen
+
+    _, mlen = jax.lax.fori_loop(0, w, body, (state, mlen))
+    return mlen
+
+
+def match_any(data, lengths, rx: DeviceRegex, xp):
+    """Java Pattern.find existence per row → bool [B]."""
+    return xp.any(match_lens(data, lengths, rx, xp) >= 0, axis=1)
+
+
+def extract_first(data, lengths, rx: DeviceRegex, xp):
+    """First (leftmost, longest) match substring per row →
+    (matrix [B, W], lengths [B], matched bool [B]).  No match → ''."""
+    b, w = data.shape
+    ml = match_lens(data, lengths, rx, xp)
+    has = xp.any(ml >= 0, axis=1)
+    s0 = xp.argmax(ml >= 0, axis=1).astype(xp.int32)
+    l0 = xp.take_along_axis(ml, s0[:, None].astype(
+        xp.int64 if xp is np else xp.int32), axis=1)[:, 0]
+    l0 = xp.where(has, l0, 0).astype(xp.int32)
+    k = xp.arange(w, dtype=xp.int32)[None, :]
+    idx = xp.clip(s0[:, None] + k, 0, w - 1)
+    mat = xp.take_along_axis(
+        data, idx.astype(xp.int64 if xp is np else xp.int32), axis=1)
+    mat = xp.where(k < l0[:, None], mat, 0).astype(data.dtype)
+    return mat, l0, has
+
+
+def replace_all(data, lengths, rx: DeviceRegex, repl: bytes, xp):
+    """Replace every non-overlapping leftmost match with the literal
+    ``repl`` → (matrix [B, Wout], lengths [B]).  Gated upstream: no
+    alternation, no empty-matching patterns, no $ group refs."""
+    b, w = data.shape
+    r = len(repl)
+    ml = match_lens(data, lengths, rx, xp)[:, :w]
+    ln = lengths[:, None].astype(xp.int32)
+    if xp is np:
+        nxt = np.zeros((b,), np.int32)
+        covered = np.zeros((b,), np.int32)
+        starts = []
+        consumed = []
+        for j in range(w):
+            here = (j >= nxt) & (ml[:, j] >= 1)
+            end_j = (j + ml[:, j]).astype(np.int32)
+            nxt = np.where(here, end_j, nxt)
+            covered = np.maximum(covered, np.where(here, end_j, 0))
+            starts.append(here)
+            consumed.append(j < covered)
+        S = np.stack(starts, axis=1)
+        C = np.stack(consumed, axis=1)
+    else:
+        import jax
+        import jax.numpy as jnp
+
+        def body(j, carry):
+            nxt, covered, S, C = carry
+            mlj = jax.lax.dynamic_slice_in_dim(ml, j, 1, 1)[:, 0]
+            here = (j >= nxt) & (mlj >= 1)
+            end_j = (j + mlj).astype(jnp.int32)
+            nxt = jnp.where(here, end_j, nxt)
+            covered = jnp.maximum(covered,
+                                  jnp.where(here, end_j, 0))
+            S = jax.lax.dynamic_update_slice_in_dim(
+                S, here[:, None], j, 1)
+            C = jax.lax.dynamic_update_slice_in_dim(
+                C, (j < covered)[:, None], j, 1)
+            return nxt, covered, S, C
+
+        init = (jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+                jnp.zeros((b, w), bool), jnp.zeros((b, w), bool))
+        _, _, S, C = jax.lax.fori_loop(0, w, body, init)
+    col = xp.arange(w, dtype=xp.int32)[None, :]
+    keep = (~C) & (col < ln)
+    e = (r * S.astype(xp.int32) + keep.astype(xp.int32))
+    offs = xp.cumsum(e, axis=1).astype(xp.int32)
+    total = offs[:, -1]
+    wout = max(w, w * max(r, 1))
+    k = xp.arange(wout, dtype=xp.int32)
+    if xp is np:
+        j_idx = np.empty((b, wout), np.int32)
+        for i in range(b):
+            j_idx[i] = np.searchsorted(offs[i], k, side="right")
+    else:
+        import jax
+        import jax.numpy as jnp
+        j_idx = jax.vmap(
+            lambda o: jnp.searchsorted(o, k, side="right"))(offs)
+    j_c = xp.clip(j_idx, 0, w - 1)
+    ga = (xp.int64 if xp is np else xp.int32)
+    off_j = xp.take_along_axis(offs, j_c.astype(ga), axis=1)
+    e_j = xp.take_along_axis(e, j_c.astype(ga), axis=1)
+    oic = k[None, :] - (off_j - e_j)
+    s_j = xp.take_along_axis(S, j_c.astype(ga), axis=1)
+    is_repl = s_j & (oic < max(r, 1)) & (r > 0)
+    repl_arr = (np.frombuffer(repl, np.uint8) if r else
+                np.zeros(1, np.uint8))
+    if xp is not np:
+        import jax.numpy as jnp
+        repl_arr = jnp.asarray(repl_arr)
+    rb = xp.take(repl_arr, xp.clip(oic, 0, max(r - 1, 0)))
+    db = xp.take_along_axis(data, j_c.astype(ga), axis=1)
+    out = xp.where(is_repl, rb, db)
+    valid = k[None, :] < total[:, None]
+    return xp.where(valid, out, 0).astype(data.dtype), total

@@ -1029,9 +1029,48 @@ def check_regex_supported(pattern: str) -> None:
         raise AnalysisException(f"invalid regex {pattern!r}: {e}")
 
 
+def _host_to_matrix(data):
+    """Host object-array of strings -> (uint8[n, W] matrix, int32[n])."""
+    enc = [(v.encode() if isinstance(v, str) else bytes(v))
+           for v in data]
+    w = max((len(e) for e in enc), default=1) or 1
+    mat = np.zeros((len(enc), w), np.uint8)
+    lens = np.zeros(len(enc), np.int32)
+    for i, e in enumerate(enc):
+        mat[i, :len(e)] = np.frombuffer(e, np.uint8)
+        lens[i] = len(e)
+    return mat, lens
+
+
+def _matrix_to_host(mat, lens) -> np.ndarray:
+    out = np.empty(mat.shape[0], object)
+    for i in range(mat.shape[0]):
+        out[i] = bytes(mat[i, :int(lens[i])]).decode("utf-8", "replace")
+    return out
+
+
+def _host_regex_apply(data, fn):
+    mat, lens = _host_to_matrix(data)
+    return fn(mat, lens)
+
+
+def _has_group_ref(repl: str) -> bool:
+    """True when the replacement is NOT a plain literal ($n refs or any
+    backslash escaping — those stay on the python re path)."""
+    return "\\" in repl or any(
+        repl[i] == "$" and i + 1 < len(repl) and repl[i + 1].isdigit()
+        for i in range(len(repl)))
+
+
 @dataclasses.dataclass
 class RLike(Expression):
-    """Host-evaluated regex match (Java Pattern.find semantics)."""
+    """Regex match (Java Pattern.find semantics).
+
+    Device path: DFA tables interpreted over the byte matrix
+    (ops/regex_device.py — the CudfRegexTranspiler analog); the CPU
+    oracle runs the SAME DFA for device-eligible patterns so both
+    paths agree byte-for-byte.  Patterns outside the subset stay on
+    python ``re`` with a tag reason."""
 
     child: Expression
     pattern: str
@@ -1041,18 +1080,48 @@ class RLike(Expression):
     def children(self):
         return (self.child,)
 
+    def _rx(self):
+        from spark_rapids_tpu.ops.regex_device import compile_regex
+        if not hasattr(self, "_rx_cache"):
+            object.__setattr__(self, "_rx_cache",
+                               compile_regex(self.pattern))
+        return self._rx_cache
+
+    def device_support_reason(self, conf):
+        if self._rx() is None:
+            return (f"regex {self.pattern!r} outside the device DFA "
+                    "subset (lazy/possessive quantifiers, backrefs, "
+                    "lookaround, \\b, mid-pattern anchors, non-ASCII)")
+        return None
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops import regex_device as RX
+        c = self.child.eval_tpu(batch)
+        got = RX.match_any(c.data, c.lengths, self._rx(), jnp)
+        return DeviceColumn(self.dtype, got, c.validity)
+
     def eval_cpu(self, batch):
-        import re as _re
-        rx = _re.compile(self.pattern)
         c = self.child.eval_cpu(batch)
-        out = np.fromiter((rx.search(str(v)) is not None for v in c.data),
+        rx = self._rx()
+        if rx is not None:
+            from spark_rapids_tpu.ops import regex_device as RX
+            mat, lens = _host_to_matrix(c.data)
+            got = RX.match_any(mat, lens, rx, np)
+            return HostCol(self.dtype, got, c.validity)
+        import re as _re
+        crx = _re.compile(self.pattern)
+        out = np.fromiter((crx.search(str(v)) is not None for v in c.data),
                           bool, len(c.data))
         return HostCol(self.dtype, out, c.validity)
 
 
 @dataclasses.dataclass
 class RegexpExtract(Expression):
-    """regexp_extract: group ``idx`` of the first match, '' if none."""
+    """regexp_extract: group ``idx`` of the first match, '' if none.
+
+    Device path (idx=0, no alternation): leftmost-longest DFA match +
+    substring gather; the CPU oracle runs the same DFA when eligible."""
 
     child: Expression
     pattern: str
@@ -1063,7 +1132,44 @@ class RegexpExtract(Expression):
     def children(self):
         return (self.child,)
 
+    def _rx(self):
+        from spark_rapids_tpu.ops.regex_device import compile_regex
+        if not hasattr(self, "_rx_cache"):
+            rx = compile_regex(self.pattern)
+            if rx is not None and (self.idx != 0 or rx.has_alternation):
+                rx = None  # group capture / greedy-vs-longest traps
+            object.__setattr__(self, "_rx_cache", rx)
+        return self._rx_cache
+
+    def device_support_reason(self, conf):
+        if self._rx() is None:
+            if self.idx != 0:
+                return ("regexp_extract group index > 0 needs capture "
+                        "groups — not in the device DFA engine")
+            return (f"regex {self.pattern!r} outside the device DFA "
+                    "subset (or alternation, where greedy != longest)")
+        return None
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops import regex_device as RX
+        c = self.child.eval_tpu(batch)
+        mat, lens, _has = RX.extract_first(c.data, c.lengths, self._rx(),
+                                           jnp)
+        return DeviceColumn(self.dtype, mat, c.validity, lens)
+
     def eval_cpu(self, batch):
+        rx = self._rx()
+        if rx is not None:
+            from spark_rapids_tpu.ops import regex_device as RX
+            c = self.child.eval_cpu(batch)
+            mat, lens = _host_regex_apply(
+                c.data, lambda m, ln: RX.extract_first(m, ln, rx, np)[:2])
+            return HostCol(self.dtype, _matrix_to_host(mat, lens),
+                           c.validity)
+        return self._eval_cpu_re(batch)
+
+    def _eval_cpu_re(self, batch):
         import re as _re
         rx = _re.compile(self.pattern)
         c = self.child.eval_cpu(batch)
@@ -1097,7 +1203,11 @@ def _java_repl_to_py(repl: str) -> str:
 
 @dataclasses.dataclass
 class RegexpReplace(Expression):
-    """regexp_replace with Java $n references in the replacement."""
+    """regexp_replace with Java $n references in the replacement.
+
+    Device path (literal replacement, no alternation, pattern cannot
+    match empty): leftmost non-overlapping DFA matches rebuilt through
+    prefix-sum byte layout; CPU oracle shares the DFA when eligible."""
 
     child: Expression
     pattern: str
@@ -1108,7 +1218,46 @@ class RegexpReplace(Expression):
     def children(self):
         return (self.child,)
 
+    def _rx(self):
+        from spark_rapids_tpu.ops.regex_device import compile_regex
+        if not hasattr(self, "_rx_cache"):
+            rx = compile_regex(self.pattern)
+            if rx is not None and (
+                    rx.has_alternation or rx.matches_empty
+                    or _has_group_ref(self.replacement)
+                    or any(ord(ch) > 127 for ch in self.replacement)):
+                rx = None
+            object.__setattr__(self, "_rx_cache", rx)
+        return self._rx_cache
+
+    def device_support_reason(self, conf):
+        if self._rx() is None:
+            return (f"regexp_replace({self.pattern!r}) outside the "
+                    "device DFA subset (alternation, empty-matching "
+                    "patterns, $n group references, non-ASCII)")
+        return None
+
+    def eval_tpu(self, batch):
+        import jax.numpy as jnp
+        from spark_rapids_tpu.ops import regex_device as RX
+        c = self.child.eval_tpu(batch)
+        mat, lens = RX.replace_all(c.data, c.lengths, self._rx(),
+                                   self.replacement.encode(), jnp)
+        return DeviceColumn(self.dtype, mat, c.validity, lens)
+
     def eval_cpu(self, batch):
+        rx = self._rx()
+        if rx is not None:
+            from spark_rapids_tpu.ops import regex_device as RX
+            c = self.child.eval_cpu(batch)
+            mat, lens = _host_regex_apply(
+                c.data, lambda m, ln: RX.replace_all(
+                    m, ln, rx, self.replacement.encode(), np))
+            return HostCol(self.dtype, _matrix_to_host(mat, lens),
+                           c.validity)
+        return self._eval_cpu_re(batch)
+
+    def _eval_cpu_re(self, batch):
         import re as _re
         rx = _re.compile(self.pattern)
         repl = _java_repl_to_py(self.replacement)

@@ -205,3 +205,71 @@ def test_null_decimal128_literal_in_casewhen():
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.createDataFrame(t).select(
             F.when(col("k") > 20, col("d")).otherwise(None).alias("x")))
+
+
+def test_mul_wrapback_is_null_not_garbage():
+    """A product that wraps PAST 2^128 back into the valid range must
+    null (checked magnitude multiply), not return the wrapped value."""
+    v = decimal.Decimal(1 << 64)
+    t = pa.table({"d": pa.array([v, decimal.Decimal(3)],
+                                type=pa.decimal128(20, 0))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            (col("d") * col("d")).alias("p")))
+    from spark_rapids_tpu.sql.session import TpuSession
+    out = (TpuSession({"spark.rapids.sql.enabled": True})
+           .createDataFrame(t)
+           .select((col("d") * col("d")).alias("p")).toArrow())
+    assert out.column("p").to_pylist()[0] is None  # 2^128 wraps to 0
+    assert out.column("p").to_pylist()[1] == decimal.Decimal(9)
+
+
+def test_large_precision_values_unrounded():
+    """38-digit values survive host<->device without decimal-context
+    rounding (the default context would clip at 28 digits)."""
+    v = decimal.Decimal("1234567890123456789012345678901234.5678")
+    t = pa.table({"d": pa.array([v], type=pa.decimal128(38, 4))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select("d"))
+    from spark_rapids_tpu.sql.session import TpuSession
+    out = (TpuSession({"spark.rapids.sql.enabled": True})
+           .createDataFrame(t).toArrow())
+    assert out.column("d").to_pylist()[0] == v
+
+
+def test_string_decimal_casts_cpu():
+    t = pa.table({"s": pa.array(["3.7", "abc", "-12.345", None,
+                                 "99999999999999999999999999.99"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            col("s").cast("decimal(30,2)").alias("d")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+    t2 = _table(seed=31, n=50)
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t2).select(
+            col("d").cast("string").alias("s")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_small_decimal_window_sum_falls_back():
+    """sum(decimal(18,0)) over a window widens to 28 digits — the 1-D
+    int64 scan would wrap, so it must fall back and stay correct."""
+    t = pa.table({
+        "k": pa.array([0] * 11),
+        "o": pa.array(list(range(11)), type=pa.int32()),
+        "d": pa.array([decimal.Decimal(9 * 10 ** 17)] * 11,
+                      type=pa.decimal128(18, 0)),
+    })
+    from spark_rapids_tpu.sql.window import Window
+    w = (Window.partitionBy("k").orderBy("o")
+         .rowsBetween(Window.unboundedPreceding,
+                      Window.unboundedFollowing))
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "o", F.sum("d").over(w).alias("sd")),
+        allow_non_tpu=["Window", "InMemoryScan"])
+    out = (tpu_session({"spark.rapids.sql.test.enabled": False})
+           .createDataFrame(t)
+           .select(F.sum("d").over(w).alias("sd")).toArrow())
+    assert out.column("sd").to_pylist()[0] == decimal.Decimal(
+        99 * 10 ** 17)

@@ -140,3 +140,96 @@ def test_rlike_filter_pushes_into_query():
         lambda s: s.createDataFrame(t)
         .filter(col("s").rlike("o"))
         .groupBy().agg(F.count("*").alias("c")))
+
+
+# -- round-4 device DFA engine [REF: CudfRegexTranspiler; VERDICT r3 #4]
+
+REGEX_CORPUS = [
+    r"abc", r"^abc", r"abc$", r"^abc$", r"a.c", r"[a-z]+", r"\d+",
+    r"\d{3}-\d{4}", r"(ab)+c", r"a|bc|def", r"[^0-9]+", r"\w+@\w+\.com",
+    r"x(yz)?w", r"a{2,3}b", r"(?:ab|cd)+", r"colou?r", r".*xyz",
+    r"h.llo$", r"^[A-Z][a-z]*", r"\s+", r"[abc]{2}", r"a\.b",
+    # host-only tail
+    r"a+?", r"(a)\1", r"(?=x)y", r"\bword\b",
+]
+
+
+def _regex_data():
+    rng = np.random.default_rng(5)
+    alph = list("abcdexyz0123456789 .-@_ABC")
+    vals = ["".join(rng.choice(alph, rng.integers(0, 16)))
+            for _ in range(400)]
+    vals += ["", "abc", "abc\n", "aabbc", "colour vs color",
+             "h2llo", "mail@host.com", "555-1234 x", None, "Abc def"]
+    return pa.table({"s": pa.array(vals)})
+
+
+def test_regex_corpus_device_fraction():
+    """The corpus runs device-side for the supported subset; the
+    device-run fraction is the honest progress meter (VERDICT #4)."""
+    from spark_rapids_tpu.ops.regex_device import compile_regex
+    t = _regex_data()
+    device = 0
+    for pat in REGEX_CORPUS:
+        eligible = compile_regex(pat) is not None
+        device += eligible
+        allow = ([] if eligible
+                 else ["Project", "Filter", "InMemoryScan"])
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s, p=pat: s.createDataFrame(t).select(
+                "s", F.rlike(col("s"), p).alias("m")),
+            allow_non_tpu=allow)
+    frac = device / len(REGEX_CORPUS)
+    print(f"\n[regex corpus] device-run fraction: {device}/"
+          f"{len(REGEX_CORPUS)} = {frac:.2f}")
+    assert frac >= 0.8, frac
+
+
+def test_regexp_extract_device():
+    t = _regex_data()
+    for pat in (r"\d+", r"[a-z]+@[a-z]+", r"c[a-z]*r", r"x.z"):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s, p=pat: s.createDataFrame(t).select(
+                "s", F.regexp_extract(col("s"), p, 0).alias("x")))
+    # group index > 0 stays on host
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.regexp_extract(col("s"), r"(\d+)-(\d+)", 2).alias("x")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_regexp_replace_device():
+    t = _regex_data()
+    for pat, repl in ((r"\d+", "#"), (r"[aeiou]", ""),
+                      (r"ab+", "AB"), (r"\s+", "_")):
+        assert_tpu_and_cpu_are_equal_collect(
+            lambda s, p=pat, r=repl: s.createDataFrame(t).select(
+                "s", F.regexp_replace(col("s"), p, r).alias("x")))
+    # $n refs stay on host
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            F.regexp_replace(col("s"), r"(\d+)", "<$1>").alias("x")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_rlike_dollar_now_device_dfa():
+    """$-anchored general patterns ride the DFA (Java terminator
+    semantics on both paths)."""
+    t = pa.table({"s": pa.array(["ab", "ab\n", "ab\r\n", "xab", "abx",
+                                 "a9\n", "a0"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "s", F.rlike(col("s"), r"a[b0-9]$").alias("m")))
+
+
+def test_regex_anchor_alternation_stays_on_host():
+    """Java scopes ^/$ per alternative: '^abc|def' finds 'def' anywhere.
+    The DFA rejects this shape; the host path must keep Java semantics."""
+    from spark_rapids_tpu.ops.regex_device import compile_regex
+    assert compile_regex("^abc|def") is None
+    assert compile_regex(r"\x41") is None  # Java hex escape
+    t = pa.table({"s": pa.array(["xxdef", "abcx", "def", "zzz"])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "s", F.rlike(col("s"), "^abc|def").alias("m")),
+        allow_non_tpu=["Project", "InMemoryScan"])
