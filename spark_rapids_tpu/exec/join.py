@@ -945,6 +945,121 @@ class TpuSortMergeJoinExec(TpuExec):
         return DeviceBatch(self.schema, tuple(cols), sel)
 
 
+class _ReplayExec(TpuExec):
+    """Serves already-materialized device batches (the AQE stage-result
+    handoff: a measured side re-enters the next plan step without
+    re-executing its subtree)."""
+
+    def __init__(self, schema, batches: List[DeviceBatch]):
+        super().__init__(schema)
+        self._batches = batches
+
+    def node_string(self):
+        return f"Replay[{len(self._batches)} batches]"
+
+    def num_partitions(self) -> int:
+        return 1
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        yield from self._batches
+
+
+class TpuAdaptiveJoinExec(TpuExec):
+    """AQE broadcast-after-measure [REF: GpuCustomShuffleReaderExec +
+    Spark AQE's DynamicJoinSelection]: the planner could not prove the
+    build side small (filters forward upper-bound estimates), so the
+    join defers the strategy choice to RUNTIME.  The build side
+    materializes once at the stage boundary; if its measured bytes fit
+    the broadcast threshold, the planned {exchange both sides →
+    partitioned join} collapses to a broadcast join (no all_to_all at
+    all); otherwise the measured batches replay into the planned
+    exchange, so nothing executes twice."""
+
+    def __init__(self, join_type: str, left_keys, right_keys, condition,
+                 schema, left: TpuExec, right: TpuExec, threshold: int,
+                 canon_int64, using: bool, sub_partition_rows: int,
+                 out_batch_rows):
+        super().__init__(schema, left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self.threshold = int(threshold)
+        self.canon_int64 = tuple(canon_int64)
+        self.using = using
+        self.sub_partition_rows = sub_partition_rows
+        self.out_batch_rows = out_batch_rows
+        from spark_rapids_tpu.parallel.mesh import make_mesh
+        self.mesh = make_mesh()
+        import threading
+        self._lock = threading.Lock()
+        self._inner: Optional[TpuSortMergeJoinExec] = None
+        self._mode: Optional[str] = None
+
+    def node_string(self):
+        mode = self._mode or "undecided"
+        return (f"TpuAdaptiveJoin [{self.join_type} "
+                f"runtime={mode} thresh={self.threshold}]")
+
+    def num_partitions(self) -> int:
+        return int(self.mesh.devices.size)
+
+    def _decide(self):
+        with self._lock:
+            if self._inner is not None:
+                return
+            from spark_rapids_tpu.exec.distributed import (
+                TpuIciShuffleExchangeExec)
+            with self.timer("measureTime"):
+                r_list = _gather_list(self.children[1])
+                # LIVE bytes, not pow-2 bucket capacity: a filtered
+                # side keeps its input bucket but holds few live rows
+                from spark_rapids_tpu.exec.basic import (
+                    _overlapped_live_counts)
+                counts = _overlapped_live_counts(r_list)
+            rbytes = sum(
+                n * max(1, b.nbytes() // max(b.capacity, 1))
+                for n, b in zip(counts, r_list))
+            replay = _ReplayExec(self.children[1].schema, r_list)
+            if rbytes <= self.threshold:
+                self.metric("adaptiveBroadcastJoins").add(1)
+                self._mode = "broadcast"
+                self._inner = TpuSortMergeJoinExec(
+                    self.join_type, self.left_keys, self.right_keys,
+                    self.condition, self.schema, self.children[0],
+                    TpuBroadcastExchangeExec(replay), using=self.using,
+                    broadcast="right",
+                    sub_partition_rows=self.sub_partition_rows,
+                    out_batch_rows=self.out_batch_rows)
+            else:
+                self.metric("adaptiveShuffledJoins").add(1)
+                self._mode = "shuffled"
+                lex = TpuIciShuffleExchangeExec(
+                    self.children[0], self.left_keys,
+                    canon_int64=self.canon_int64)
+                rex = TpuIciShuffleExchangeExec(
+                    replay, self.right_keys,
+                    canon_int64=self.canon_int64)
+                self._inner = TpuSortMergeJoinExec(
+                    self.join_type, self.left_keys, self.right_keys,
+                    self.condition, self.schema, lex, rex,
+                    partitioned=True, using=self.using,
+                    sub_partition_rows=self.sub_partition_rows,
+                    out_batch_rows=self.out_batch_rows)
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        self._decide()
+        d = self.num_partitions()
+        if self._mode == "shuffled":
+            yield from self._inner.execute(partition)
+            return
+        # broadcast: stream-side partitions strided over the adaptive
+        # node's fixed partition count
+        n_lp = self._inner.num_partitions()
+        for lp in range(partition, n_lp, d):
+            yield from self._inner.execute(lp)
+
+
 def _tag_join(meta):
     from spark_rapids_tpu.plan.overrides import tag_expression as _tag_e
     cpu = meta.cpu
@@ -1022,6 +1137,15 @@ def _convert_join(cpu, ch, conf):
             type(le.dtype) is not type(re.dtype)
             and isinstance(le.dtype, _INT_FAMILY)
             for le, re in zip(cpu.left_keys, cpu.right_keys))
+        if (conf.get(C.ADAPTIVE_ENABLED) and thresh and thresh > 0
+                and not multiproc
+                and jt in ("inner", "left", "left_semi", "left_anti")):
+            # the planner could not prove the build side small (else
+            # the static broadcast above fired) — defer to runtime
+            return TpuAdaptiveJoinExec(
+                jt, cpu.left_keys, cpu.right_keys, cpu.condition,
+                cpu.schema, ch[0], ch[1], thresh, canon, cpu.using,
+                bounds["sub_partition_rows"], bounds["out_batch_rows"])
         lex = TpuIciShuffleExchangeExec(ch[0], cpu.left_keys,
                                        canon_int64=canon)
         rex = TpuIciShuffleExchangeExec(ch[1], cpu.right_keys,

@@ -91,7 +91,7 @@ def _escape_set(ch: str) -> Optional[np.ndarray]:
     return None
 
 
-_ESC_LIT = {"n": 10, "t": 9, "r": 13, "f": 12, "a": 7, "e": 27, "0": 0}
+_ESC_LIT = {"n": 10, "t": 9, "r": 13, "f": 12, "a": 7, "e": 27}
 
 
 class _Parser:
@@ -189,7 +189,7 @@ class _Parser:
             raise Unsupported("repetition count too large")
         return lo, hi
 
-    def _clone(self, fs: int, fe: int, mapping=None) -> Tuple[int, int]:
+    def _clone(self, fs: int, fe: int) -> Tuple[int, int]:
         """Deep-copy an NFA fragment (for counted repetition)."""
         mapping: Dict[int, int] = {}
         stack = [fs]
@@ -275,8 +275,8 @@ class _Parser:
                 raise Unsupported(f"anchor escape \\{nxt}")
             if nxt in ("p", "P"):
                 raise Unsupported("\\p classes")
-            if nxt.isdigit() and nxt != "0":
-                raise Unsupported("backreference")
+            if nxt.isdigit():
+                raise Unsupported("backreference / octal escape")
             code = _ESC_LIT.get(nxt, None)
             if code is None:
                 if ord(nxt) > 127:
@@ -321,7 +321,7 @@ class _Parser:
                 code = _ESC_LIT.get(nxt)
                 if code is None:
                     if nxt.isalnum():
-                        raise Unsupported(f"escape \\{nxt}")
+                        raise Unsupported(f"escape \\{nxt} in class")
                     code = ord(nxt)
                 lo_c = code
             else:
@@ -335,7 +335,11 @@ class _Parser:
                 hc = self.take()
                 if hc == "\\":
                     hc = self.take()
-                    hi_c = _ESC_LIT.get(hc, ord(hc))
+                    hi_c = _ESC_LIT.get(hc)
+                    if hi_c is None:
+                        if hc.isalnum():
+                            raise Unsupported(f"escape \\{hc} in class")
+                        hi_c = ord(hc)
                 else:
                     if ord(hc) > 127:
                         raise Unsupported("non-ASCII pattern")
@@ -392,13 +396,18 @@ def compile_regex(pattern: str) -> Optional[DeviceRegex]:
             # Java scopes ^/$ to the adjacent ALTERNATIVE, not the whole
             # pattern ('^a|b' == (^a)|(b)) — reject top-level '|'
             depth = 0
+            in_class = False
             i = 0
             while i < len(body):
                 ch = body[i]
                 if ch == "\\":
                     i += 2
                     continue
-                if ch == "(":
+                if in_class:
+                    in_class = ch != "]"
+                elif ch == "[":
+                    in_class = True
+                elif ch == "(":
                     depth += 1
                 elif ch == ")":
                     depth -= 1
@@ -482,11 +491,12 @@ def _end_ok_mask(data, lengths, rx: DeviceRegex, xp):
     last_b = xp.take_along_axis(
         data, last.astype(xp.int64 if xp is np else xp.int32), axis=1)
     is_nl = (last_b == 10) | (last_b == 13)
-    before_final = (pos == ln - 1) & is_nl & (ln >= 1)
     last2 = xp.clip(ln - 2, 0, w - 1)
     last2_b = xp.take_along_axis(
         data, last2.astype(xp.int64 if xp is np else xp.int32), axis=1)
     crlf = (last2_b == 13) & (last_b == 10) & (ln >= 2)
+    # Java's Dollar never matches BETWEEN \r and \n of a final CRLF
+    before_final = (pos == ln - 1) & is_nl & (ln >= 1) & ~crlf
     before_crlf = (pos == ln - 2) & crlf
     return at_end | before_final | before_crlf
 

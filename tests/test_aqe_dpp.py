@@ -189,3 +189,92 @@ def test_dpp_survives_column_pruning(fact_dir):
     assert scan is not None
     assert scan.metrics["dppPrunedFiles"].value == 8, (
         scan.metrics["dppPrunedFiles"].value)
+
+
+# -- round-4 TRUE AQE step: broadcast-after-measure join flip
+# [REF: GpuCustomShuffleReaderExec / DynamicJoinSelection; VERDICT r3 #8]
+
+def _adaptive_tables(sel):
+    rng = np.random.default_rng(81)
+    n = 40_000
+    left = pa.table({"k": pa.array(rng.integers(0, 5000, n)),
+                     "v": pa.array(rng.uniform(-5, 5, n))})
+    # right side BIG pre-filter (planner sees the upper bound), small
+    # or big post-filter depending on `sel`
+    right = pa.table({"k": pa.array(rng.integers(0, 6000, n)),
+                      "w": pa.array(rng.integers(0, 1000 if sel else 2,
+                                                 n))})
+    return left, right
+
+
+def _find_node(node, name):
+    if type(node).__name__ == name:
+        return node
+    for c in node.children:
+        r = _find_node(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+def test_adaptive_join_flips_to_broadcast_at_runtime():
+    """The planned shuffled join collapses to broadcast once the
+    filtered build side measures under the threshold."""
+    left, right = _adaptive_tables(sel=True)
+    # threshold UNDER the unfiltered upper bound (so the static planner
+    # cannot broadcast) but far above the filtered build side's real
+    # size — only the runtime measurement can discover the flip
+    conf = {"spark.rapids.shuffle.mode": "ICI",
+            "spark.sql.adaptive.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": 64 << 10}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(left).join(
+            s.createDataFrame(right).filter(F.col("w") == 3), "k",
+            "inner"),
+        conf=conf, ignore_order=True, approx_float=True)
+    s = tpu_session(dict(conf))
+    df = s.createDataFrame(left).join(
+        s.createDataFrame(right).filter(F.col("w") == 3), "k", "inner")
+    out = df.toArrow()
+    assert out.num_rows > 0
+    j = _find_node(df._last_plan, "TpuAdaptiveJoinExec")
+    assert j is not None
+    assert j._mode == "broadcast"
+    assert j.metric("adaptiveBroadcastJoins").value == 1
+    # no collective ran: the plan has no materialized ICI exchange
+    assert _find_node(df._last_plan, "TpuIciShuffleExchangeExec") is None
+
+
+def test_adaptive_join_stays_shuffled_when_big():
+    left, right = _adaptive_tables(sel=False)
+    conf = {"spark.rapids.shuffle.mode": "ICI",
+            "spark.sql.adaptive.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": 64 << 10}
+    s = tpu_session(dict(conf))
+    df = s.createDataFrame(left).join(
+        s.createDataFrame(right).filter(F.col("w") == 1), "k", "inner")
+    out = df.toArrow()
+    assert out.num_rows > 0
+    j = _find_node(df._last_plan, "TpuAdaptiveJoinExec")
+    assert j is not None
+    assert j._mode == "shuffled"
+    assert j.metric("adaptiveShuffledJoins").value == 1
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s2: s2.createDataFrame(left).join(
+            s2.createDataFrame(right).filter(F.col("w") == 1), "k",
+            "inner"),
+        conf=conf, ignore_order=True, approx_float=True)
+
+
+def test_adaptive_off_keeps_planned_shuffle():
+    left, right = _adaptive_tables(sel=True)
+    conf = {"spark.rapids.shuffle.mode": "ICI",
+            "spark.sql.adaptive.enabled": False,
+            "spark.sql.autoBroadcastJoinThreshold": 64 << 10}
+    s = tpu_session(dict(conf))
+    df = s.createDataFrame(left).join(
+        s.createDataFrame(right).filter(F.col("w") == 3), "k", "inner")
+    df.toArrow()
+    assert _find_node(df._last_plan, "TpuAdaptiveJoinExec") is None
+    assert _find_node(df._last_plan,
+                      "TpuIciShuffleExchangeExec") is not None
