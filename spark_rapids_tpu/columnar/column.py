@@ -310,7 +310,26 @@ def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
     null_mask = np.asarray(arr.is_null())
     validity_np = ~null_mask if null_mask.any() else None
 
+    if (pa.types.is_dictionary(arr.type)
+            and isinstance(dt, (T.StringType, T.BinaryType))
+            and len(arr.dictionary) > 0):
+        # device dictionary DECODE [REF: SURVEY N6 phase-2]: transfer
+        # int32 indices + the (small) dictionary byte matrix and expand
+        # with a device gather — H2D bytes drop from n*W to n*4 + D*W
+        idx = np.asarray(arr.indices.fill_null(0)).astype(np.int32)
+        dmat, dlens = _string_to_matrix(arr.dictionary)
+        d_idx = jnp.asarray(idx)
+        data = jnp.take(jnp.asarray(dmat), d_idx, axis=0)
+        lengths = jnp.take(jnp.asarray(dlens), d_idx)
+        return DeviceColumn(
+            dt, data,
+            None if validity_np is None else jnp.asarray(validity_np),
+            lengths)
+
     if isinstance(dt, (T.StringType, T.BinaryType)):
+        if pa.types.is_dictionary(arr.type):
+            # empty dictionary (all-null column): decode to plain first
+            arr = arr.cast(T.to_arrow(dt))
         mat, lengths = _string_to_matrix(arr)
         return DeviceColumn(
             dt, jnp.asarray(mat),

@@ -280,6 +280,10 @@ def from_arrow(at) -> DataType:
         return StructType(
             tuple(StructField(f.name, from_arrow(f.type)) for f in at)
         )
+    if pa.types.is_dictionary(at):
+        # dictionary-encoded columns carry their VALUE type (the
+        # encoding is a physical detail the device decode unwraps)
+        return from_arrow(at.value_type)
     raise NotImplementedError(f"arrow type {at}")
 
 

@@ -340,6 +340,20 @@ BROADCAST_THRESHOLD = (
     .create_with_default(10 << 20)
 )
 
+PARQUET_DEVICE_DICT = (
+    conf("spark.rapids.tpu.parquet.deviceDictDecode")
+    .doc("Read parquet string columns dictionary-encoded and expand "
+         "them ON DEVICE (indices + a small dictionary ride the "
+         "host→device transfer instead of full byte matrices; the "
+         "expansion is a device gather). The decode-on-device half of "
+         "the reference's GPU parquet path that makes sense on TPU — "
+         "decompression stays on host (no TPU decompress engine). "
+         "[REF: GpuParquetScan.scala; SURVEY N6 phase-2]")
+    .category("io")
+    .boolean()
+    .create_with_default(True)
+)
+
 JOIN_TARGET_ROWS = (
     conf("spark.rapids.tpu.join.targetRows")
     .doc("Row-capacity cap for one in-core sort-merge join. When either "

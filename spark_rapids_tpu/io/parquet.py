@@ -148,13 +148,14 @@ class CpuParquetScanExec(CpuExec):
         end = len(fields) - np_ - nf
         return [f.name for f in fields[:end]]
 
-    def _read_file(self, fi: int) -> pa.Table:
+    def _read_file(self, fi, dict_strings=False) -> pa.Table:
         """Read one file's pruned columns + append partition/file cols.
 
         Columns missing from a file (schema evolution: added after the
         file was written) materialize as nulls — Delta/Spark semantics."""
         path = self.paths[fi]
         cols = self._data_columns()
+        by_name = {f.name: f for f in self.schema.fields}
         if self.relation.format == "orc":
             import pyarrow.orc as po
             orc = po.ORCFile(path)
@@ -162,7 +163,12 @@ class CpuParquetScanExec(CpuExec):
             read_cols = [c for c in cols if c in present]
             tbl = orc.read(columns=read_cols)
         else:
-            pf = pq.ParquetFile(path)
+            read_dict = None
+            if dict_strings:
+                read_dict = [c for c in cols
+                             if isinstance(by_name[c].dtype,
+                                           (T.StringType, T.BinaryType))]
+            pf = pq.ParquetFile(path, read_dictionary=read_dict)
             present = set(pf.schema_arrow.names)
             read_cols = [c for c in cols if c in present]
             filters = self.relation.filters
@@ -181,7 +187,6 @@ class CpuParquetScanExec(CpuExec):
             else:
                 tbl = pf.read(columns=read_cols)  # reuse the open file
         if len(read_cols) < len(cols):
-            by_name = {f.name: f for f in self.schema.fields}
             for c in cols:
                 if c not in present:
                     tbl = tbl.append_column(
@@ -250,11 +255,17 @@ class TpuParquetScanExec(TpuExec):
         if not idxs:
             return
         with cf.ThreadPoolExecutor(max_workers=self.num_threads) as pool:
-            futures = [pool.submit(self._cpu._read_file, fi)
+            from spark_rapids_tpu import conf as C
+            dict_dec = bool(self._cpu.conf.get(C.PARQUET_DEVICE_DICT))
+            futures = [pool.submit(self._cpu._read_file, fi, dict_dec)
                        for fi in idxs]
             for fut in futures:
                 with self.timer("scanTime"):
                     tbl = fut.result()
+                ndict = sum(1 for c in tbl.columns
+                            if pa.types.is_dictionary(c.type))
+                if ndict:
+                    self.metric("dictDecodedColumns").add(ndict)
                 with self.timer():
                     b = host_to_device(tbl)
                     b = DeviceBatch(self.schema, b.columns, b.sel,
