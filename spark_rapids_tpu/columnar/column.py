@@ -307,12 +307,20 @@ def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
     ensure_initialized()
     if isinstance(arr, pa.ChunkedArray):
         arr = arr.combine_chunks()
+    if pa.types.is_dictionary(arr.type) and (
+            not isinstance(dt, (T.StringType, T.BinaryType))
+            or len(arr.dictionary) == 0
+            or arr.dictionary.null_count > 0):
+        # device dict decode handles only string dictionaries with no
+        # null VALUES (index-level nulls are fine); everything else
+        # decodes to plain first — is_null() on a DictionaryArray does
+        # NOT see nulls stored in the dictionary values
+        arr = arr.cast(arr.type.value_type)
     null_mask = np.asarray(arr.is_null())
     validity_np = ~null_mask if null_mask.any() else None
 
     if (pa.types.is_dictionary(arr.type)
-            and isinstance(dt, (T.StringType, T.BinaryType))
-            and len(arr.dictionary) > 0):
+            and isinstance(dt, (T.StringType, T.BinaryType))):
         # device dictionary DECODE [REF: SURVEY N6 phase-2]: transfer
         # int32 indices + the (small) dictionary byte matrix and expand
         # with a device gather — H2D bytes drop from n*W to n*4 + D*W
@@ -327,9 +335,6 @@ def arrow_column_to_device(arr, dt: T.DataType) -> DeviceColumn:
             lengths)
 
     if isinstance(dt, (T.StringType, T.BinaryType)):
-        if pa.types.is_dictionary(arr.type):
-            # empty dictionary (all-null column): decode to plain first
-            arr = arr.cast(T.to_arrow(dt))
         mat, lengths = _string_to_matrix(arr)
         return DeviceColumn(
             dt, jnp.asarray(mat),
