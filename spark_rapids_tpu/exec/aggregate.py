@@ -877,7 +877,9 @@ class TpuHashAggregateExec(TpuExec):
             if not slices[i]:
                 continue
             bs = [s.get() for s in slices[i]]
-            out.append(merge_fn(concat_device_batches(schema, bs)))
+            bcounts = [s.live_rows for s in slices[i]]
+            out.append(merge_fn(concat_device_batches(
+                schema, bs, counts=bcounts)))
             for s in slices[i]:
                 s.close()
         return out

@@ -54,11 +54,11 @@ def _gather_list(child, partition=None):
     return [compact(b) for p in parts for b in child.execute(p)]
 
 
-def _concat_or_empty(schema, batches):
+def _concat_or_empty(schema, batches, counts=None):
     from spark_rapids_tpu.columnar.column import empty_batch
     if not batches:
         return empty_batch(schema)
-    return concat_device_batches(schema, batches)
+    return concat_device_batches(schema, batches, counts=counts)
 
 
 def _gather_all(child, schema, device: bool, partition=None):
@@ -660,10 +660,12 @@ class TpuSortMergeJoinExec(TpuExec):
             with mgr.transient(min(2 * max(pair_bytes, 1), mgr.budget)):
                 lb = _concat_or_empty(
                     self.children[0].schema,
-                    [s.get() for s in l_slices[i]])
+                    [s.get() for s in l_slices[i]],
+                    counts=[s.live_rows for s in l_slices[i]])
                 rb = _concat_or_empty(
                     self.children[1].schema,
-                    [s.get() for s in r_slices[i]])
+                    [s.get() for s in r_slices[i]],
+                    counts=[s.live_rows for s in r_slices[i]])
                 with self.timer():
                     yield from self._merge_join(lb, rb, jt)
                 for s in l_slices[i] + r_slices[i]:

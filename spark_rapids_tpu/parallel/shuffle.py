@@ -295,7 +295,11 @@ def split_to_spillables(batches, ids_fn, nbuckets: int, mgr, key: tuple,
                 ("split_cut", size) + base_key,
                 lambda s=size: build_cut(s))
             part = cut_fn(laid, int(offs[i]), n)
-            out[i].append(SpillableBatch(part, mgr, reserve=False))
+            sp = SpillableBatch(part, mgr, reserve=False)
+            # the split KNOWS each slice's live count — downstream
+            # concats read it instead of paying a device round trip
+            sp.live_rows = n
+            out[i].append(sp)
         del laid, merged
     return out
 
