@@ -868,10 +868,18 @@ class TpuHashAggregateExec(TpuExec):
         k = int(min(64, max(2, -(-total // cap))))
         keys = [BoundReference(i, g.dtype)
                 for i, g in enumerate(self.grouping)]
-        pid_fn = make_pid_fn(keys, k)
+        # NOT the default shuffle seed: in final/staged mode the partials
+        # arrived via a seed-42 hash-mod-nparts exchange, so re-hashing
+        # with seed 42 would collapse every key into k/gcd(k,nparts)
+        # buckets (often one) and re-create the exploded concat this
+        # fallback exists to avoid — same reason the join sub-partition
+        # path uses its own SUB_SEED.
+        AGG_SEED = 0x41475242
+        pid_fn = make_pid_fn(keys, k, seed=AGG_SEED)
         slices = split_to_spillables(
             partials, lambda b, aux: pid_fn(b), k, mgr,
-            ("aggrepart", k, fingerprint(keys), fingerprint(schema)))
+            ("aggrepart", k, AGG_SEED, fingerprint(keys),
+             fingerprint(schema)))
         out = []
         for i in range(k):
             if not slices[i]:

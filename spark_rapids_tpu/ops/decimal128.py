@@ -175,6 +175,21 @@ def scale_up(a: jnp.ndarray, k: int) -> jnp.ndarray:
     return a
 
 
+def scale_up_checked(a: jnp.ndarray, k: int, precision: int
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(a * 10^k, ok) where ok ⇔ |a * 10^k| < 10^precision, decided
+    BEFORE the multiply: |a| < 10^(precision-k) ⇔ the exact product
+    fits, so a wrap mod 2^128 can never land back inside the valid
+    range and be returned as a plausible wrong value."""
+    assert k >= 0
+    rem = precision - k
+    if rem <= 0:
+        ok = cmp_eq(a, jnp.zeros_like(a))
+    else:
+        ok = fits_precision(a, rem)
+    return scale_up(a, k), ok
+
+
 def divmod_small(a: jnp.ndarray, d: int
                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(|a| // d, |a| % d) with the SIGN of a applied to the quotient

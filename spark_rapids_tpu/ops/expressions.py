@@ -1201,14 +1201,19 @@ class Cast(Expression):
                 k = dst.scale - src.scale
                 d = (c.data if D128.is128(src)
                      else D128.from_i64(c.data))
-                d = (D128.scale_up(d, k) if k >= 0
-                     else D128.scale_down_round(d, -k))
+                if k >= 0:
+                    # checked: overflow decided BEFORE the multiply so a
+                    # wrap mod 2^128 can't return a plausible wrong value
+                    d, fits = D128.scale_up_checked(d, k, dst.precision)
+                else:
+                    d = D128.scale_down_round(d, -k)
+                    fits = D128.fits_precision(d, dst.precision)
             elif T.is_integral(src):
-                d = D128.scale_up(D128.from_i64(
-                    c.data.astype(jnp.int64)), dst.scale)
+                d, fits = D128.scale_up_checked(
+                    D128.from_i64(c.data.astype(jnp.int64)),
+                    dst.scale, dst.precision)
             else:
                 raise NotImplementedError(f"cast {src}→{dst} on device")
-            fits = D128.fits_precision(d, dst.precision)
             validity = (fits if c.validity is None
                         else c.validity & fits)
             if not big_dst:
@@ -1240,7 +1245,11 @@ class Cast(Expression):
             validity = (fits if c.validity is None
                         else c.validity & fits)
             if dst.precision <= T.DecimalType.MAX_LONG_DIGITS:
-                out = np.array([int(v) for v in out], dtype=np.int64)
+                # overflowed rows are already null — zero their payload
+                # so the int64 narrowing can't raise
+                out = np.array([int(v) if f else 0
+                                for v, f in zip(out, fits)],
+                               dtype=np.int64)
             return HostCol(dst, out, validity)
         if isinstance(dst, T.DoubleType):
             out = np.array([int(v) / (10.0 ** src.scale)

@@ -480,25 +480,36 @@ def _end_ok_mask(data, lengths, rx: DeviceRegex, xp):
     """[B, W+1] — position p is a legal match END.
 
     Unanchored: any p <= len.  ``$``: p == len, or just before a final
-    \\n, \\r\\n or \\r (Java Pattern ``$`` under find)."""
+    line terminator (Java Pattern ``$`` under find, non-UNIX_LINES):
+    \\n, \\r\\n, \\r, and the Unicode terminators \\u0085 (UTF-8 C2 85)
+    and \\u2028/\\u2029 (E2 80 A8|A9)."""
     b, w = data.shape
     pos = xp.arange(w + 1, dtype=xp.int32)[None, :]
     ln = lengths[:, None].astype(xp.int32)
     if not rx.anchored_end:
         return pos <= ln
     at_end = pos == ln
-    last = xp.clip(ln - 1, 0, w - 1)
-    last_b = xp.take_along_axis(
-        data, last.astype(xp.int64 if xp is np else xp.int32), axis=1)
+    idt = xp.int64 if xp is np else xp.int32
+
+    def byte_at(off):
+        ix = xp.clip(ln - off, 0, w - 1)
+        return xp.take_along_axis(data, ix.astype(idt), axis=1)
+
+    last_b = byte_at(1)
+    last2_b = byte_at(2)
+    last3_b = byte_at(3)
     is_nl = (last_b == 10) | (last_b == 13)
-    last2 = xp.clip(ln - 2, 0, w - 1)
-    last2_b = xp.take_along_axis(
-        data, last2.astype(xp.int64 if xp is np else xp.int32), axis=1)
     crlf = (last2_b == 13) & (last_b == 10) & (ln >= 2)
     # Java's Dollar never matches BETWEEN \r and \n of a final CRLF
     before_final = (pos == ln - 1) & is_nl & (ln >= 1) & ~crlf
     before_crlf = (pos == ln - 2) & crlf
-    return at_end | before_final | before_crlf
+    nel = (last2_b == 0xC2) & (last_b == 0x85) & (ln >= 2)
+    lsep = ((last3_b == 0xE2) & (last2_b == 0x80)
+            & ((last_b == 0xA8) | (last_b == 0xA9)) & (ln >= 3))
+    before_nel = (pos == ln - 2) & nel
+    before_lsep = (pos == ln - 3) & lsep
+    return (at_end | before_final | before_crlf
+            | before_nel | before_lsep)
 
 
 def match_lens(data, lengths, rx: DeviceRegex, xp):

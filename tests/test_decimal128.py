@@ -273,3 +273,34 @@ def test_small_decimal_window_sum_falls_back():
            .select(F.sum("d").over(w).alias("sd")).toArrow())
     assert out.column("sd").to_pylist()[0] == decimal.Decimal(
         99 * 10 ** 17)
+
+
+def test_cast_scale_up_overflow_is_null():
+    """ADVICE r4 (high): scale-up casts must decide overflow BEFORE the
+    10^k multiply — a wrap mod 2^128 landing back inside 10^precision
+    must not be returned as a plausible wrong value."""
+    vals = [decimal.Decimal(340282366920938463463374607431769),
+            decimal.Decimal(10) ** 31, decimal.Decimal(-(10 ** 33)),
+            decimal.Decimal(7), decimal.Decimal(0), None]
+    t = pa.table({"d": pa.array(vals, type=pa.decimal128(38, 0))})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            col("d").cast("decimal(38,6)").alias("up")))
+    out = tpu_session().createDataFrame(t).select(
+        col("d").cast("decimal(38,6)").alias("up")).toArrow()
+    py = out.column("up").to_pylist()
+    assert py[0] is None and py[2] is None      # would wrap / overflow
+    assert py[1] == decimal.Decimal(10) ** 31   # exactly at the edge
+    assert py[3] == decimal.Decimal(7)
+    assert py[4] == decimal.Decimal(0) and py[5] is None
+
+
+def test_int_to_decimal_overflow_is_null():
+    t = pa.table({"i": pa.array([10 ** 17, -10 ** 17, 5, 0, None])})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            col("i").cast("decimal(18,6)").alias("d")))
+    py = (tpu_session().createDataFrame(t)
+          .select(col("i").cast("decimal(18,6)").alias("d"))
+          .toArrow().column("d").to_pylist())
+    assert py[0] is None and py[1] is None and py[2] == 5

@@ -233,3 +233,20 @@ def test_regex_anchor_alternation_stays_on_host():
         lambda s: s.createDataFrame(t).select(
             "s", F.rlike(col("s"), "^abc|def").alias("m")),
         allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_rlike_dollar_unicode_terminators():
+    """ADVICE r4 (low): Java Pattern '$' (non-UNIX_LINES) also matches
+    before a final \\u0085/\\u2028/\\u2029.  The CPU oracle shares the
+    DFA, so assert against hard-coded Java semantics, not the oracle."""
+    strs = ["ab", "ab\u0085", "ab\u2028", "ab\u2029",
+            "ab\u0085x", "ab\u2028\u2028", "ab\r\n", "ab\n"]
+    java = [True, True, True, True, False, False, True, True]
+    t = pa.table({"s": pa.array(strs)})
+    out = (tpu_session().createDataFrame(t)
+           .select(F.rlike(col("s"), "ab$").alias("m"))
+           .toArrow().column("m").to_pylist())
+    assert out == java
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            "s", F.rlike(col("s"), "ab$").alias("m")))
