@@ -215,6 +215,31 @@ MIN_BUCKET_ROWS = (
     .create_with_default(1 << 10)
 )
 
+AGG_BUCKET_ROWS = (
+    conf("spark.rapids.tpu.agg.bucketRows")
+    .doc("Grouped aggregates coalesce input batches up to this many live "
+         "rows before each partial-pass kernel. Fewer, larger partial "
+         "sorts beat many small ones on TPU: each partial chain pays a "
+         "fixed dispatch cost through the host tunnel, and the "
+         "hash-capped key encoding keeps the sort operand count flat as "
+         "the bucket grows. 0 disables coalescing.")
+    .integer()
+    .create_with_default(1 << 18)
+)
+
+AGG_SKIP_RATIO = (
+    conf("spark.rapids.sql.agg.skipAggPassReductionRatio")
+    .doc("When a grouped aggregate's first partial pass keeps more than "
+         "this fraction of its input rows (grouping keys are nearly "
+         "unique), later batches skip the per-batch sort+reduce and "
+         "emit raw update buffers; the merge pass does the single real "
+         "reduction [REF: GpuHashAggregateExec "
+         "skipAggPassReductionRatio]. 1.0 disables skipping.")
+    .double()
+    .check(lambda v: 0.0 < v <= 1.0, "in (0, 1]")
+    .create_with_default(0.9)
+)
+
 CONCURRENT_TASKS = (
     conf("spark.rapids.sql.concurrentGpuTasks")
     .doc("Number of tasks that may hold the device semaphore concurrently "

@@ -215,6 +215,11 @@ def segment_groupby(
             # keep-leftmost scan: end row sees the start value
             e["agg"] = batcher.add("first", data_s)
             e["vfirst"] = batcher.add("first", valid_s)
+            # a group with no LIVE rows (the forced global-aggregate
+            # row over empty input) must be null, not a dead row's
+            # validity bit
+            e["nlive"] = batcher.add("add", live_sorted.astype(jnp.int32),
+                                     key="nlive")
         else:
             raise ValueError(f"unknown reduction kind {kind}")
         plans.append(e)
@@ -238,7 +243,8 @@ def segment_groupby(
         elif kind in ("min", "max") and e.get("orderable"):
             agg = decode_orderable(agg, c.dtype)
         elif kind == "first":
-            validity = batcher.get(e["vfirst"])
+            validity = (batcher.get(e["vfirst"])
+                        & (batcher.get(e["nlive"]) > 0))
         out_vals.append(DeviceColumn(c.dtype, to_front(agg),
                                      to_front(validity), None))
 
@@ -394,6 +400,80 @@ def segment_collect(key_cols, sel, value_col: DeviceColumn, cap: int,
     return mat, counts_g.astype(jnp.int32)
 
 
+def _needs_sorted_extreme(dt: T.DataType) -> bool:
+    """Min/Max/First inputs whose values cannot ride a single-uint64
+    buffer through the partial/merge protocol (multi-limb encodings):
+    handled on the holistic single-kernel path instead."""
+    from spark_rapids_tpu.ops import decimal128 as D128
+    return isinstance(dt, (T.StringType, T.BinaryType)) or D128.is128(dt)
+
+
+def is_holistic_fn(f: AggregateFunction) -> bool:
+    """Functions that require the single-kernel gathered path (no
+    partial/final split): collect/percentile, and min/max/first over
+    multi-limb dtypes.  The ONE definition — the exec's routing, the
+    collect kernel's classification, and the planner's exchange gating
+    all call this."""
+    if isinstance(f, (CollectList, Percentile)):
+        return True
+    return (isinstance(f, (Min, Max, First)) and f.child is not None
+            and _needs_sorted_extreme(f.input_dtype))
+
+
+def segment_extreme(key_cols, sel, value_col: DeviceColumn, kind: str
+                    ) -> DeviceColumn:
+    """min/max/first of ``value_col`` per group for ANY orderable dtype
+    (strings and decimal128 included) — the holistic twin of
+    ``segment_groupby``'s single-limb reductions: one stable sort on
+    (exclusion, keys[, null-flag, value]) and the answer is a single
+    row gather per group (min = first valid row, max = last valid row,
+    first = first LIVE row, nulls included — Spark First semantics).
+    Output in the same compacted group order as ``segment_groupby``."""
+    b = int(sel.shape[0])
+    if kind == "first":
+        contrib = sel
+        tail: list = []
+    else:
+        contrib = sel & value_col.valid_mask()
+        tail = [ORD._flag_part(~contrib)] + ORD.column_order_parts(
+            value_col, True, True, distinguish_neg_zero=False)
+    limbs, key_limbs = ORD.group_sort_limbs(list(key_cols), sel, tail)
+    sorted_limbs, perm = ORD.sort_by_keys(limbs)
+    live_sorted = jnp.take(sel, perm)
+    # boundaries over the KEY limbs only (trailing null-flag/value parts
+    # must NOT split groups; tail bits may share the last key limb)
+    key_sorted = [jnp.take(l, perm) for l in key_limbs]
+    diff = jnp.zeros((b,), jnp.bool_)
+    for l in key_sorted:
+        diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
+    boundary = diff.at[0].set(True)
+    is_end = jnp.concatenate([boundary[1:], jnp.ones((1,), jnp.bool_)])
+    rank = (~(is_end & live_sorted)).astype(jnp.uint8)
+    _, perm2 = ORD.sort_by_keys([rank])
+    iota = jnp.arange(b, dtype=jnp.int32)
+    start_scan = segmented_scan(_keep_first, iota, boundary)
+    contrib_sorted = jnp.take(contrib, perm)
+    n_contrib = segmented_scan(jnp.add, contrib_sorted.astype(jnp.int32),
+                               boundary)
+    starts_g = jnp.take(start_scan, perm2)
+    counts_g = jnp.take(n_contrib, perm2)
+    idx = (starts_g + counts_g - 1) if kind == "max" else starts_g
+    idx = jnp.clip(idx, 0, b - 1)
+    data_s = jnp.take(value_col.data, perm, axis=0)
+    row_data = jnp.take(data_s, idx, axis=0)
+    lengths = None
+    if value_col.lengths is not None:
+        lengths = jnp.take(jnp.take(value_col.lengths, perm), idx)
+    if kind == "first":
+        base = (jnp.take(jnp.take(value_col.valid_mask(), perm), idx)
+                if value_col.validity is not None
+                else jnp.ones((b,), jnp.bool_))
+        validity = base & (counts_g > 0)  # empty group → null
+    else:
+        validity = counts_g > 0
+    return DeviceColumn(value_col.dtype, row_data, validity, lengths)
+
+
 def segment_percentile(key_cols, sel, value_col: DeviceColumn,
                        pct: float, interpolate: bool
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -533,6 +613,8 @@ def update_value_cols(fns: Sequence[AggregateFunction], batch: DeviceBatch
         elif isinstance(fn, First):
             out.append((c, "first"))
         elif isinstance(fn, _VarianceBase):
+            # variance children arrive pre-cast to double (analysis.py
+            # wraps them), decimals included
             x = c.data.astype(jnp.float64)
             out.append((DeviceColumn(T.DoubleT, x, c.validity), "sum"))
             out.append((DeviceColumn(T.DoubleT, x * x, c.validity), "sum"))
@@ -611,7 +693,8 @@ class TpuHashAggregateExec(TpuExec):
     def __init__(self, grouping: Sequence[Expression],
                  fns: Sequence[AggregateFunction],
                  schema: T.StructType, child: TpuExec,
-                 mode: str = "complete", has_nans: bool = True):
+                 mode: str = "complete", has_nans: bool = True,
+                 bucket_rows: int = 1 << 18, skip_ratio: float = 1.0):
         super().__init__(schema, child)
         self.grouping = list(grouping)
         self.fns = list(fns)
@@ -619,6 +702,10 @@ class TpuHashAggregateExec(TpuExec):
         self.mode = mode
         # spark.rapids.sql.hasNans=false elides NaN total-order handling
         self.has_nans = has_nans
+        # spark.rapids.tpu.agg.bucketRows: partial-pass input coalescing
+        self.bucket_rows = bucket_rows
+        # spark.rapids.sql.agg.skipAggPassReductionRatio
+        self.skip_ratio = skip_ratio
 
     def node_string(self):
         keys = ", ".join(str(g) for g in self.grouping)
@@ -668,8 +755,7 @@ class TpuHashAggregateExec(TpuExec):
 
     @property
     def _has_collect(self) -> bool:
-        return any(isinstance(f, (CollectList, Percentile))
-                   for f in self.fns)
+        return any(is_holistic_fn(f) for f in self.fns)
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         if self.mode != "complete":
@@ -736,9 +822,7 @@ class TpuHashAggregateExec(TpuExec):
                     if pre is not None:
                         m = pre(m)
                     keys = [g.eval_tpu(m) for g in grouping]
-                    normal = [f for f in fns
-                              if not isinstance(f,
-                                                (CollectList, Percentile))]
+                    normal = [f for f in fns if not is_holistic_fn(f)]
                     vals = update_value_cols(normal, m)
                     ok, ov, sel = segment_groupby(keys, m.sel, vals,
                                                   has_nans=has_nans)
@@ -759,8 +843,20 @@ class TpuHashAggregateExec(TpuExec):
                                     f, ApproxPercentile))
                             cols.append(DeviceColumn(
                                 f.result_dtype, v, vv))
+                        elif is_holistic_fn(f):
+                            kind = ("min" if isinstance(f, Min) else
+                                    "max" if isinstance(f, Max)
+                                    else "first")
+                            cols.append(segment_extreme(
+                                keys, m.sel, f.child.eval_tpu(m), kind))
                         else:
                             cols.append(next(normal_res))
+                    if not grouping:
+                        # global holistic aggregate: exactly one output
+                        # row even over an empty input (count-style
+                        # validity already nulls the value columns)
+                        sel = jnp.arange(m.capacity,
+                                         dtype=jnp.int32) < 1
                     return DeviceBatch(schema, tuple(cols), sel,
                                        compacted=True)
                 return run
@@ -808,31 +904,134 @@ class TpuHashAggregateExec(TpuExec):
             manager=mgr))
         return self._reduce_merge_final(partials)
 
+    def _coalesced(self, stream) -> Iterator[DeviceBatch]:
+        """Group input batches up to ``bucket_rows`` capacity before the
+        partial pass: each partial chain pays a fixed host-tunnel
+        dispatch cost, so fewer/larger sorts win (the hash-capped key
+        encoding keeps sort operands flat as the bucket grows)."""
+        cap = self.bucket_rows
+        if not cap:
+            yield from stream
+            return
+        from spark_rapids_tpu.columnar.column import compact
+        group: List[DeviceBatch] = []
+        acc = 0
+
+        def emit():
+            if len(group) == 1:
+                return group[0]
+            with self.timer("concatTime"):
+                batches = [compact(b) for b in group]
+                return concat_device_batches(batches[0].schema, batches)
+
+        for b in stream:
+            if b.capacity >= cap:
+                yield b
+                continue
+            if group and acc + b.capacity > cap:
+                yield emit()
+                group, acc = [], 0
+            group.append(b)
+            acc += b.capacity
+        if group:
+            yield emit()
+
+    def _decide_skip(self, outs1: List[DeviceBatch], n_in: int) -> bool:
+        """Should later batches skip the per-batch reduction?
+        ``outs1`` = the first input batch's partial(s) (plural when the
+        OOM-retry split it), ``n_in`` its live rows [REF:
+        GpuHashAggregateExec skipAggPassReductionRatio]."""
+        if self.skip_ratio >= 1.0:
+            return False
+        # small batches can't establish the ratio (64 rows → 60 groups
+        # says nothing about 6M rows)
+        if n_in < 4096:
+            return False
+        from spark_rapids_tpu.exec.basic import _overlapped_live_counts
+        n_groups = sum(_overlapped_live_counts(outs1))
+        return (n_groups / max(n_in, 1)) > self.skip_ratio
+
+    def _partial_stream(self, stream, pre, pre_key, mgr
+                        ) -> Tuple[Optional[List[DeviceBatch]], bool]:
+        """Shared partial-pass driver (complete AND staged-partial
+        modes): coalesce, run the first group's partial under retry,
+        decide skip-agg-pass from its reduction ratio, stream the rest.
+        Returns (partials | None for an empty stream, skip)."""
+        from spark_rapids_tpu.exec.basic import _overlapped_live_counts
+        from spark_rapids_tpu.runtime.memory import with_retry
+        stream = self._coalesced(stream)
+        first = next(stream, None)
+        if first is None:
+            return None, False
+
+        def closure_partial(b):
+            with mgr.transient(b.nbytes()):
+                return self._partial(b, pre, pre_key)
+
+        n_in = (_overlapped_live_counts([first])[0]
+                if self.skip_ratio < 1.0 else 0)
+        outs1 = list(with_retry(
+            iter([first]), closure_partial,
+            max_attempts=mgr.retry_max_attempts, manager=mgr))
+        skip = self._decide_skip(outs1, n_in)
+        if skip:
+            self.metric("skippedAggPasses").add(1)
+
+        def closure(b):
+            with mgr.transient(b.nbytes()):
+                if skip:
+                    return self._update_raw(b, pre, pre_key)
+                return self._partial(b, pre, pre_key)
+
+        partials = outs1 + list(with_retry(
+            stream, closure, max_attempts=mgr.retry_max_attempts,
+            manager=mgr))
+        return partials, skip
+
     def _execute_grouped(self, src, pre, pre_key) -> List[DeviceBatch]:
         """Update-per-batch under the OOM-retry framework: a RetryOOM
         spills the arbiter's pool and re-runs the batch; repeated
         pressure halves it by rows (partials merge regardless — the
         repartition-fallback-friendly shape [REF: withRetry +
         GpuAggregateIterator])."""
-        from spark_rapids_tpu.runtime.memory import get_manager, with_retry
+        from spark_rapids_tpu.runtime.memory import get_manager
         mgr = get_manager()
-
-        def closure(b):
-            with mgr.transient(b.nbytes()):
-                return self._partial(b, pre, pre_key)
-
-        partials: List[DeviceBatch] = []
-        for p in range(src.num_partitions()):
-            # lazy: one upstream batch live at a time, so retry spills
-            # actually free HBM instead of fighting a pinned input list
-            partials.extend(with_retry(
-                src.execute(p), closure,
-                max_attempts=mgr.retry_max_attempts, manager=mgr))
-        if not partials:
+        # lazy: one upstream batch live at a time, so retry spills
+        # actually free HBM instead of fighting a pinned input list
+        partials, _skip = self._partial_stream(
+            (b for p in range(src.num_partitions())
+             for b in src.execute(p)), pre, pre_key, mgr)
+        if partials is None:
             from spark_rapids_tpu.columnar.column import empty_batch
-            partials.append(self._partial(
-                empty_batch(src.schema), pre, pre_key))
+            partials = [self._partial(empty_batch(src.schema), pre,
+                                      pre_key)]
         return self._merge_bounded(partials, self._merge_final)
+
+    def _update_raw(self, batch: DeviceBatch, pre=None,
+                    pre_key=()) -> DeviceBatch:
+        """Buffer-schema batch WITHOUT the per-batch reduction — the
+        skip-agg-pass path: keys + per-row update buffers pass straight
+        to the merge, whose single reduction then does all the work.
+        Cheap elementwise kernel (no sort, no scans)."""
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, fingerprint)
+        grouping, fns = self.grouping, self.fns
+        buffer_schema = self._buffer_schema()
+
+        def build():
+            def run(b):
+                if pre is not None:
+                    b = pre(b)
+                keys = [g.eval_tpu(b) for g in grouping]
+                vals = [c for c, _ in update_value_cols(fns, b)]
+                return DeviceBatch(buffer_schema, tuple(keys + vals),
+                                   b.sel)
+            return run
+
+        fn = cached_kernel(
+            ("agg_raw", pre_key, fingerprint(grouping),
+             fingerprint(fns)), build)
+        return fn(batch)
 
     def _merge_bounded(self, partials: List[DeviceBatch],
                        merge_fn) -> List[DeviceBatch]:
@@ -900,23 +1099,19 @@ class TpuHashAggregateExec(TpuExec):
         child = self.children[0]
         with self.timer():
             if self.mode == "partial":
-                from spark_rapids_tpu.runtime.memory import (
-                    get_manager, with_retry)
+                from spark_rapids_tpu.runtime.memory import get_manager
                 mgr = get_manager()
                 src, pre, pre_key = fuse_upstream(child)
-
-                def closure(b):
-                    with mgr.transient(b.nbytes()):
-                        return self._partial(b, pre, pre_key)
-
-                partials = list(with_retry(
-                    src.execute(partition), closure,
-                    max_attempts=mgr.retry_max_attempts, manager=mgr))
-                if not partials:
+                partials, skip = self._partial_stream(
+                    src.execute(partition), pre, pre_key, mgr)
+                if partials is None:
                     yield empty_batch(self._buffer_schema())
                     return
-                if len(partials) == 1:
-                    outs = [partials[0]]
+                if len(partials) == 1 or skip:
+                    # skip mode: a local combine would do exactly the
+                    # reduction the ratio said is useless — ship raw
+                    # buffers to the exchange; the final pass reduces
+                    outs = partials
                 else:
                     outs = self._merge_bounded(partials,
                                                self._merge_buffers)

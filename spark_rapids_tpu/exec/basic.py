@@ -508,6 +508,28 @@ class TpuCoalesceBatchesExec(TpuExec):
         return out
 
 
+_BIG_BUCKET_ROWS = int(__import__("os").environ.get(
+    "SPARK_RAPIDS_TPU_BIG_BUCKET_WARN_ROWS", str(1 << 22)))
+
+
+def warn_big_bucket(where: str, bucket: int) -> None:
+    """Stderr breadcrumb when any single device allocation crosses the
+    warn threshold (default 4M rows).  A bucket that large is one bad
+    shape away from a TPU worker kernel fault / HBM OOM that kills the
+    process without a Python traceback — the breadcrumb names the call
+    site so a post-mortem has somewhere to start."""
+    if bucket < _BIG_BUCKET_ROWS:
+        return
+    import sys
+    import traceback
+    stack = traceback.extract_stack(limit=3)
+    # stack[-1] = here, stack[-2] = the concat, stack[-3] = its caller
+    frame = stack[-3] if len(stack) >= 3 else stack[0]
+    print(f"[tpuq] WARNING: {where} building a {bucket}-row bucket "
+          f"(caller {frame.name}:{frame.lineno})",
+          file=sys.stderr, flush=True)
+
+
 def _overlapped_live_counts(batches) -> List[int]:
     """Live-row counts for many batches with ONE overlapped transfer
     round trip (sequential scalar pulls cost a full tunnel round trip
@@ -543,6 +565,7 @@ def _concat_compacted_fast(schema: T.StructType,
         counts = _overlapped_live_counts(batches)
     total = sum(counts)
     out_bucket = round_up_pow2(max(total, 1))
+    warn_big_bucket("concat", out_bucket)
     nfields = len(schema.fields)
     is_str = [batches[0].columns[ci].is_string for ci in range(nfields)]
     widths = tuple(
@@ -675,6 +698,7 @@ def concat_device_batches(schema: T.StructType,
     if bucket is None:
         bucket = round_up_pow2(max(total, 1))
     assert bucket >= total, (bucket, total)
+    warn_big_bucket("concat", bucket)
     cols = []
     for ci, f in enumerate(schema.fields):
         parts_data = []

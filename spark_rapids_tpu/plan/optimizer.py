@@ -143,6 +143,45 @@ def _rebuild_chain(filters: List[L.Filter], leaf, remap=None):
     return node
 
 
+# Narrowed-arrow-table memo: pa.Table.select is zero-copy but creates a
+# NEW object each call, and the device scan cache (exec/basic.py) keys
+# on table identity — without this memo every execution of a pruned plan
+# would re-transfer H2D.  Entries die with their parent table.
+_narrow_memo: dict = {}
+
+
+def _narrow_table(table, names: Tuple[str, ...]):
+    import weakref
+    key = (id(table), names)
+    hit = _narrow_memo.get(key)
+    if hit is not None:
+        return hit
+    out = table.select(list(names))
+    try:
+        weakref.finalize(table, _narrow_memo.pop, key, None)
+    except TypeError:
+        return out
+    _narrow_memo[key] = out
+    return out
+
+
+def _prune_inmemory(rel: L.InMemoryRelation, required: Set[int]):
+    """Narrowed in-memory relation + old→new index map.  The H2D analog
+    of parquet projection pushdown [REF: Spark's ColumnPruning +
+    InMemoryTableScanExec partition pruning — here the win is not
+    transferring unused columns through the host↔device tunnel]."""
+    fields = rel.schema.fields
+    if not required:
+        required = {0}
+    keep = sorted(required)
+    index_map = {old: new for new, old in enumerate(keep)}
+    names = tuple(fields[i].name for i in keep)
+    new_rel = dataclasses.replace(
+        rel, table=_narrow_table(rel.table, names),
+        schema=T.StructType(tuple(fields[i] for i in keep)))
+    return new_rel, index_map
+
+
 def _prune_relation(rel: L.ParquetRelation, required: Set[int],
                     need_file_name: bool):
     """Narrowed relation + old→new index map."""
@@ -185,25 +224,62 @@ def _make_remap(index_map, fn_idx):
     return remap
 
 
+def _head_required_refs(plan, filters) -> Tuple[List, Set[int]]:
+    """(head exprs, referenced column indexes) of a Project|Aggregate
+    head over a Filter* chain — shared by the parquet and in-memory
+    pruning rules so the two can never disagree on required columns."""
+    if isinstance(plan, L.Project):
+        head_exprs = list(plan.exprs)
+    else:
+        head_exprs = (list(plan.grouping)
+                      + [f.child for f in plan.aggregates
+                         if getattr(f, "child", None) is not None])
+    required: Set[int] = set()
+    for e in head_exprs:
+        collect_refs(e, required)
+    for f in filters:
+        collect_refs(f.condition, required)
+    return head_exprs, required
+
+
+def _inmemory_prune_head(plan) -> Optional[L.LogicalPlan]:
+    """Project|Aggregate → Filter* → InMemoryRelation: narrow the arrow
+    table to referenced columns before the H2D transfer."""
+    filters = []
+    node = plan.child
+    while isinstance(node, L.Filter):
+        filters.append(node)
+        node = node.child
+    if not isinstance(node, L.InMemoryRelation):
+        return None
+    head_exprs, required = _head_required_refs(plan, filters)
+    if len(required) >= len(node.schema.fields):
+        return None
+    if _has_file_name_marker(head_exprs):
+        return None
+    new_rel, index_map = _prune_inmemory(node, required)
+    remap = _make_remap(index_map, None)
+    child = _rebuild_chain(filters, new_rel, remap)
+    if isinstance(plan, L.Project):
+        exprs = [transform_expr(e, remap) for e in plan.exprs]
+        return L.Project(child, exprs, plan.schema)
+    grouping = [transform_expr(e, remap) for e in plan.grouping]
+    aggs = [transform_expr(a, remap) for a in plan.aggregates]
+    return L.Aggregate(child, grouping, aggs, plan.schema)
+
+
 def optimize(plan: L.LogicalPlan, conf=None) -> L.LogicalPlan:
     plan = _rewrite_children(plan, conf)
 
     if isinstance(plan, (L.Project, L.Aggregate)):
+        mem = _inmemory_prune_head(plan)
+        if mem is not None:
+            return mem
         filters, rel = _filter_chain(plan.child)
         # the inner Filter rule may already have attached row-group
         # filters (bottom-up order) — pruning only needs columns unset
         if rel is not None and rel.columns is None:
-            if isinstance(plan, L.Project):
-                head_exprs = list(plan.exprs)
-            else:
-                head_exprs = (list(plan.grouping)
-                              + [f.child for f in plan.aggregates
-                                 if getattr(f, "child", None) is not None])
-            required: Set[int] = set()
-            for e in head_exprs:
-                collect_refs(e, required)
-            for f in filters:
-                collect_refs(f.condition, required)
+            head_exprs, required = _head_required_refs(plan, filters)
             need_fn = isinstance(plan, L.Project) and _has_file_name_marker(
                 head_exprs)
             pushed = rel.filters

@@ -262,25 +262,10 @@ def _tag_aggregate(meta: ExecMeta):
             continue
         if not isinstance(fn, CountStar):
             meta.tag_expressions([fn.child])
-        from spark_rapids_tpu.ops.decimal128 import is128 as _is128
-        if (isinstance(fn, (Min, Max, First, _VarianceBase))
-                and fn.child is not None and _is128(fn.input_dtype)):
-            meta.will_not_work(
-                f"aggregate {fn.name} over decimal128 input not yet on "
-                "device (sum/count/avg are)")
-        if isinstance(fn, (Min, Max, First)) and isinstance(
-                fn.input_dtype, (T.StringType, T.BinaryType)):
-            meta.will_not_work(
-                f"{fn.name} over {fn.input_dtype.simple_name} input not yet "
-                "supported on device (string agg buffers)")
         if isinstance(fn, _VarianceBase) and not T.is_numeric(
                 fn.input_dtype):
             meta.will_not_work(f"{fn.name} needs a numeric input")
         if isinstance(fn, CollectList):
-            if not cpu.grouping:
-                meta.will_not_work(
-                    "global collect_list (no grouping keys) not on "
-                    "device yet")
             if isinstance(fn.input_dtype,
                           (T.StringType, T.BinaryType, T.DecimalType,
                            T.ArrayType)):
@@ -295,8 +280,14 @@ def _convert_aggregate(cpu, ch, conf):
     from spark_rapids_tpu.exec.distributed import ici_active
     from spark_rapids_tpu.ops.aggregates import CollectList, Percentile
     has_nans = bool(conf.get(C.HAS_NANS))
-    has_collect = any(isinstance(f, (CollectList, Percentile))
-                      for f in cpu.fns)
+    tuning = dict(has_nans=has_nans,
+                  bucket_rows=conf.get(C.AGG_BUCKET_ROWS),
+                  skip_ratio=conf.get(C.AGG_SKIP_RATIO))
+    from spark_rapids_tpu.exec.aggregate import is_holistic_fn
+    # holistic functions (collect/percentile, and min/max/first over
+    # multi-limb dtypes) run the single-kernel gathered path — they
+    # cannot ride buffer batches through a partial/final split
+    has_collect = any(is_holistic_fn(f) for f in cpu.fns)
     if ici_active(conf) and cpu.grouping and not has_collect:
         # distributed: {partial agg → hash exchange on keys → final agg}
         # — one SPMD all_to_all per shuffle stage (SURVEY §5.8)
@@ -304,16 +295,15 @@ def _convert_aggregate(cpu, ch, conf):
             TpuIciShuffleExchangeExec)
         from spark_rapids_tpu.ops.expressions import BoundReference
         partial = TpuHashAggregateExec(cpu.grouping, cpu.fns, None, ch[0],
-                                       mode="partial", has_nans=has_nans)
+                                       mode="partial", **tuning)
         partial.schema = partial._buffer_schema()
         keys = [BoundReference(i, g.dtype)
                 for i, g in enumerate(cpu.grouping)]
         exchange = TpuIciShuffleExchangeExec(partial, keys)
         return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema,
-                                    exchange, mode="final",
-                                    has_nans=has_nans)
+                                    exchange, mode="final", **tuning)
     return TpuHashAggregateExec(cpu.grouping, cpu.fns, cpu.schema, ch[0],
-                                has_nans=has_nans)
+                                **tuning)
 
 
 def _register_lazy_rules():

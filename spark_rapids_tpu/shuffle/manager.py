@@ -91,8 +91,10 @@ class ShuffleWriter:
     def write_batch(self, cols: Sequence[HostColView], pids: np.ndarray,
                     live: Optional[np.ndarray]) -> int:
         """Serialize one batch's rows into per-partition sections."""
+        # scratch=True: sections are consumed (written to the map file)
+        # before this thread serializes its next batch
         sections = serialize_partitions(cols, pids, live, self.nparts,
-                                        self.nthreads)
+                                        self.nthreads, scratch=True)
         sizes = np.array([len(s) for s in sections], np.int64)
         self._f.write(sizes.tobytes())
         for s in sections:

@@ -80,6 +80,16 @@ def _tudo_lib():
                 ctypes.c_int, ctypes.POINTER(_ColDesc), ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_int32, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_int32]
+            if hasattr(_lib, "tudo_scatter_sizes"):
+                _lib.tudo_scatter_sizes.argtypes = [
+                    ctypes.c_int, ctypes.POINTER(_ColDesc),
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p]
+                _lib.tudo_scatter_write.argtypes = [
+                    ctypes.c_int, ctypes.POINTER(_ColDesc),
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p]
     return _lib
 
 
@@ -111,12 +121,36 @@ def _descs(cols: Sequence[HostColView]):
     return arr, keepalive
 
 
+import threading as _threading
+
+_scratch_tls = _threading.local()
+
+
+def _scratch_buf(nbytes: int) -> np.ndarray:
+    """Thread-local grow-only output buffer.  np.empty pays soft page
+    faults on every first touch — measured 80-160 ms for a 64 MB
+    serialize on the single-core shuffle hosts, 4-6x the actual scatter
+    time; steady-state writers serialize into warm pages instead."""
+    buf = getattr(_scratch_tls, "buf", None)
+    if buf is None or buf.size < nbytes:
+        buf = np.empty(max(nbytes, 1 << 20), np.uint8)
+        buf[::4096] = 0  # touch pages now, off the steady-state path
+        _scratch_tls.buf = buf
+    return buf
+
+
 def serialize_partitions(
     cols: Sequence[HostColView], pids: np.ndarray,
     live: Optional[np.ndarray], nparts: int, nthreads: int = 4,
+    scratch: bool = False,
 ) -> List[memoryview]:
     """Bucket rows by pid and serialize each partition: one tudo buffer
-    per partition (dead rows dropped)."""
+    per partition (dead rows dropped).
+
+    ``scratch=True`` serializes into a THREAD-LOCAL reusable buffer: the
+    returned memoryviews alias it and are only valid until this thread's
+    next scratch call — for callers (the shuffle file writer) that
+    consume the sections before serializing the next batch."""
     n = int(pids.shape[0])
     pids = np.ascontiguousarray(pids.astype(np.int32, copy=False))
     live8 = (None if live is None else
@@ -124,12 +158,35 @@ def serialize_partitions(
     lib = _tudo_lib()
     if lib is None:
         return _py_serialize_partitions(cols, pids, live8, nparts)
+    descs, keep = _descs(cols)
+    sizes = np.empty(nparts, np.int64)
+    import os
+    effective_threads = min(int(nthreads), os.cpu_count() or 1)
+    if hasattr(lib, "tudo_scatter_write") and effective_threads <= 1:
+        # streaming scatter: sequential source reads + one write cursor
+        # per partition — 3-4x the permutation gather on the single-core
+        # hosts the shuffle writer runs on (native/tudo.cpp rationale).
+        # With >1 EFFECTIVE thread the threaded per-partition gather
+        # wins, and spark.rapids.shuffle.multiThreaded.writer.threads
+        # stays honored.
+        work = np.empty(nparts * (1 + len(cols)), np.int64)
+        lib.tudo_scatter_sizes(len(cols), descs, _ptr(pids), _ptr(live8),
+                               n, nparts, _ptr(sizes), _ptr(work))
+        offsets = np.zeros(nparts, np.int64)
+        np.cumsum(sizes[:-1], out=offsets[1:])
+        total = int(sizes.sum())
+        out = (_scratch_buf(total) if scratch
+               else np.empty(total, np.uint8))
+        lib.tudo_scatter_write(len(cols), descs, _ptr(pids), _ptr(live8),
+                               n, nparts, _ptr(out), _ptr(offsets),
+                               _ptr(work))
+        mv = memoryview(out)
+        return [mv[int(offsets[p]):int(offsets[p] + sizes[p])]
+                for p in range(nparts)]
     idx = np.empty(n, np.int32)
     starts = np.empty(nparts + 1, np.int64)
     lib.tudo_bucket_rows(_ptr(pids), _ptr(live8), n, nparts,
                          _ptr(idx), _ptr(starts))
-    descs, keep = _descs(cols)
-    sizes = np.empty(nparts, np.int64)
     lib.tudo_partition_sizes(len(cols), descs, _ptr(idx), _ptr(starts),
                              nparts, _ptr(sizes))
     offsets = np.zeros(nparts, np.int64)

@@ -133,6 +133,20 @@ class Column:
     def between(self, low, high) -> "Column":
         return (self >= low) & (self <= high)
 
+    def isin(self, *values) -> "Column":
+        """Membership test [REF: Spark Column.isin / catalyst In] —
+        lowered as an OR chain of equalities, which XLA fuses into one
+        elementwise program (the device needs no dedicated In kernel)."""
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        if not values:
+            from spark_rapids_tpu.sql.column import lit
+            return lit(False)
+        out = self == values[0]
+        for v in values[1:]:
+            out = out | (self == v)
+        return out
+
     def when(self, cond: "Column", value) -> "Column":
         u = self._u
         if u.op != "casewhen" or u.payload == "closed":

@@ -256,7 +256,8 @@ def test_merge_explosion_repartition_fallback():
         "k": pa.array(rng.permutation(n)),  # unique keys
         "v": pa.array(rng.integers(0, 100, n)),
     })
-    s = tpu_session({"spark.rapids.tpu.batchRows": 4096})
+    s = tpu_session({"spark.rapids.tpu.batchRows": 4096,
+                     "spark.rapids.tpu.agg.bucketRows": 4096})
     df = (s.createDataFrame(t).groupBy("k")
           .agg(F.sum("v").alias("sv"), F.count("*").alias("c")))
     out = df.toArrow()
@@ -345,3 +346,106 @@ def test_wide_key_groupby_null_positions_stay_distinct():
         lambda s: s.createDataFrame(t).groupBy("a", "b", "c", "d")
         .agg(F.count("*").alias("n"), F.sum("v").alias("sv")),
         ignore_order=True)
+
+
+# ---------------------------------------------------------------------------
+# holistic min/max/first (string + decimal128 inputs) and global collect
+# ---------------------------------------------------------------------------
+
+def _str_table(n=2000, seed=5):
+    rng = np.random.default_rng(seed)
+    words = ["apple", "Banana", "cherry", "", "zebra", "éclair",
+             "apple pie", "APPLE"]
+    s = [None if i % 17 == 0 else words[rng.integers(0, len(words))]
+         for i in range(n)]
+    return pa.table({
+        "k": pa.array(rng.integers(0, 12, n)),
+        "s": pa.array(s, pa.string()),
+        "v": pa.array(rng.integers(0, 100, n)),
+    })
+
+
+def test_min_max_string_grouped():
+    t = _str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.min(F.col("s")).alias("mn"),
+            F.max(F.col("s")).alias("mx"),
+            F.count(F.col("s")).alias("c")),
+        ignore_order=True)
+
+
+def test_first_string_grouped():
+    t = _str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.first(F.col("s")).alias("f")),
+        ignore_order=True)
+
+
+def test_min_max_string_global():
+    t = _str_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.min(F.col("s")).alias("mn"),
+            F.max(F.col("s")).alias("mx")))
+
+
+def test_min_max_string_global_empty_is_null():
+    t = pa.table({"k": pa.array([], pa.int64()),
+                  "s": pa.array([], pa.string())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.min(F.col("s")).alias("mn"),
+            F.first(F.col("s")).alias("f")))
+
+
+def _d128_table(n=500, seed=9):
+    import decimal
+    rng = np.random.default_rng(seed)
+    dt = pa.decimal128(25, 2)
+    vals = [None if i % 11 == 0 else
+            decimal.Decimal(int(rng.integers(-10**9, 10**9)) * 10**11
+                            + int(rng.integers(0, 10**11))) / 100
+            for i in range(n)]
+    return pa.table({
+        "k": pa.array(rng.integers(0, 8, n)),
+        "d": pa.array(vals, dt),
+    })
+
+
+def test_min_max_first_decimal128_grouped():
+    t = _d128_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.min(F.col("d")).alias("mn"),
+            F.max(F.col("d")).alias("mx"),
+            F.first(F.col("d")).alias("f")),
+        ignore_order=True)
+
+
+def test_variance_decimal128_grouped():
+    t = _d128_table()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.stddev_samp(F.col("d")).alias("sd")),
+        ignore_order=True, approx_float=True)
+
+
+def test_global_collect_list():
+    rng = np.random.default_rng(3)
+    n = 400
+    t = pa.table({
+        "v": pa.array([None if i % 7 == 0 else int(rng.integers(0, 50))
+                       for i in range(n)], pa.int64()),
+    })
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.collect_list(F.col("v")).alias("l")))
+
+
+def test_global_collect_list_empty():
+    t = pa.table({"v": pa.array([], pa.int64())})
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).agg(
+            F.collect_list(F.col("v")).alias("l")))
