@@ -401,11 +401,22 @@ class CpuGlobalLimitExec(CpuExec):
 
 
 class TpuGlobalLimitExec(TpuExec):
-    """[REF: limit.scala :: GpuGlobalLimitExec]"""
+    """[REF: limit.scala :: GpuGlobalLimitExec]
+
+    Multi-executor mode: LIMIT takes ANY n rows (Spark semantics), so no
+    row exchange is needed — processes allgather their live-row counts
+    and each emits its quota of the first-come budget in process order.
+    """
+
+    _multiproc_gather_ok = True
 
     def __init__(self, n: int, child: TpuExec):
         super().__init__(child.schema, child)
         self.n = n
+        from spark_rapids_tpu.parallel.executor import get_executor
+        self._ctx = get_executor()
+        self._stage = (self._ctx.next_stage_id()
+                       if self._ctx is not None else None)
 
     def node_string(self):
         return f"TpuGlobalLimit [{self.n}]"
@@ -416,16 +427,40 @@ class TpuGlobalLimitExec(TpuExec):
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         remaining = self.n
         child = self.children[0]
-        for p in range(child.num_partitions()):
-            for b in child.execute(p):
-                if remaining <= 0:
-                    return
-                with self.timer():
-                    live_prefix = jnp.cumsum(b.sel.astype(jnp.int32))
-                    keep = b.sel & (live_prefix <= remaining)
-                    out = b.with_sel(keep)
-                remaining -= int(jnp.sum(keep.astype(jnp.int32)))
-                yield out
+        if self._ctx is not None:
+            from spark_rapids_tpu.exec.distributed import owned_partitions
+            ctx = self._ctx
+            # drain lazily only until n local rows are seen — reporting
+            # the CAPPED count keeps the quota math exact (counts past
+            # n can never change any process's quota) while preserving
+            # LIMIT's early termination
+            batches: List[DeviceBatch] = []
+            local = 0
+            for p in owned_partitions(child):
+                if local >= self.n:
+                    break
+                for b in child.execute(p):
+                    batches.append(b)
+                    local += _overlapped_live_counts([b])[0]
+                    if local >= self.n:
+                        break
+            replies = ctx.client.allgather(
+                self._stage + ":limit", min(local, self.n), ctx.timeout)
+            before = sum(replies[:ctx.process_id])
+            remaining = max(0, min(local, self.n - before))
+            stream = iter(batches)
+        else:
+            stream = (b for p in range(child.num_partitions())
+                      for b in child.execute(p))
+        for b in stream:
+            if remaining <= 0:
+                return
+            with self.timer():
+                live_prefix = jnp.cumsum(b.sel.astype(jnp.int32))
+                keep = b.sel & (live_prefix <= remaining)
+                out = b.with_sel(keep)
+            remaining -= int(jnp.sum(keep.astype(jnp.int32)))
+            yield out
 
 
 class CpuUnionExec(CpuExec):

@@ -242,14 +242,12 @@ class TpuIciShuffleExchangeExec(TpuExec):
 
             from spark_rapids_tpu.runtime.kernel_cache import (
                 cached_kernel, fingerprint)
-            base_key = (self.nparts, self.canon_int64,
-                        fingerprint(self.keys), fingerprint(schema))
+            base_key = self._base_key(schema)
+            aux = self._aux_args(sharded)
             with self.timer("partitionTime"):
                 count_fn = cached_kernel(
-                    ("ici_count",) + base_key,
-                    lambda: SH.build_count_program(
-                        self.mesh, self.keys, d, self.canon_int64))
-                counts = np.asarray(count_fn(sharded))  # [d*d]
+                    ("ici_count",) + base_key, self._count_builder())
+                counts = np.asarray(count_fn(sharded, *aux))  # [d*d]
                 cap = round_up_pow2(max(int(counts.max()), 1), 8)
             # per-device collective working set: the [d*cap] layout and
             # the [d*cap] received block
@@ -257,11 +255,36 @@ class TpuIciShuffleExchangeExec(TpuExec):
                 with self.timer("collectiveTime"):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,
-                        lambda: SH.build_shuffle_program(
-                            self.mesh, self.keys, d, cap,
-                            self.canon_int64))
-                    self._result = shuffle_fn(sharded)
+                        self._shuffle_builder(cap))
+                    self._result = shuffle_fn(sharded, *aux)
         return self._result
+
+    # -- pid-program hooks (overridden by the RANGE exchange) ---------------
+    def _base_key(self, schema) -> tuple:
+        from spark_rapids_tpu.runtime.kernel_cache import fingerprint
+        return (self.nparts, self.canon_int64, fingerprint(self.keys),
+                fingerprint(schema))
+
+    def _aux_args(self, sharded) -> tuple:
+        """Extra traced arguments for the count/shuffle programs."""
+        return ()
+
+    def _count_builder(self):
+        return lambda: SH.build_count_program(
+            self.mesh, self.keys, self.nparts, self.canon_int64)
+
+    def _shuffle_builder(self, cap: int):
+        return lambda: SH.build_shuffle_program(
+            self.mesh, self.keys, self.nparts, cap, self.canon_int64)
+
+    def _local_pid(self, batch, base_key):
+        """Partition ids of a LOCAL shard (multiproc count phase)."""
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+        fn = cached_kernel(
+            ("ici_mp_pid",) + base_key,
+            lambda: SH.make_pid_fn(self.keys, self.nparts,
+                                   self.canon_int64))
+        return fn(batch)
 
     def _materialize_multiproc(self) -> Optional[DeviceBatch]:
         """Rendezvous-coordinated collective shuffle across executor
@@ -299,8 +322,7 @@ class TpuIciShuffleExchangeExec(TpuExec):
             parts, rows, widths, has_val = _accumulate_shards(
                 self.children[0], local_devices, len(local_devices),
                 partitions=owned_partitions(self.children[0]))
-        base_key = (self.nparts, self.canon_int64,
-                    fingerprint(self.keys), fingerprint(schema))
+        base_key = self._base_key(schema)
         # the payload carries the stage's structural fingerprint: stage
         # ids are plan-conversion-ordered, so if executors ever run
         # DIFFERENT queries (or the same queries in different order)
@@ -353,19 +375,16 @@ class TpuIciShuffleExchangeExec(TpuExec):
                 sharded = _batch_from_shards(self.mesh, schema, shards,
                                              local_b, global_devices=d)
             del parts, shards
+            aux = self._aux_args(sharded)
             with self.timer("partitionTime"):
                 # per-shard counts via a plain LOCAL jit: a
                 # cross-process count program's output shards would not
                 # be addressable
-                pid_fn = cached_kernel(
-                    ("ici_mp_pid",) + base_key,
-                    lambda: SH.make_pid_fn(self.keys, d,
-                                           self.canon_int64))
                 local_max = 0
                 for li in range(len(local_devices)):
                     shard_b = _local_shard(sharded, local_ids[li])
                     cnt = SH.local_partition_counts(
-                        shard_b, pid_fn(shard_b), d)
+                        shard_b, self._local_pid(shard_b, base_key), d)
                     local_max = max(local_max,
                                     int(np.asarray(cnt).max()))
             counts = ctx.client.allgather(self._stage + ":counts",
@@ -376,10 +395,8 @@ class TpuIciShuffleExchangeExec(TpuExec):
                 with self.timer("collectiveTime"):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,
-                        lambda: SH.build_shuffle_program(
-                            self.mesh, self.keys, d, cap,
-                            self.canon_int64))
-                    self._result = shuffle_fn(sharded)
+                        self._shuffle_builder(cap))
+                    self._result = shuffle_fn(sharded, *aux)
         return self._result
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
@@ -398,6 +415,102 @@ class TpuIciShuffleExchangeExec(TpuExec):
         self.metric("numOutputRows").add(n)
         self.metric("numOutputBatches").add(1)
         yield block
+
+
+class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
+    """RANGE-partitioned collective exchange [REF:
+    GpuRangePartitioning.scala + GpuShuffleExchangeExecBase]: sampled
+    order-key boundaries (agreed across executor processes via a
+    rendezvous allgather) route each row to the partition owning its key
+    range, so partition p's received rows all order before partition
+    p+1's — a local per-partition sort then yields a TOTAL order.  The
+    distribution mechanism for global Sort/Window-without-keys/TopN."""
+
+    def __init__(self, child: TpuExec, orders, mesh=None):
+        # keys only drive fingerprints/tagging; pids come from orders
+        super().__init__(child, [o.expr for o in orders], mesh=mesh)
+        self.orders = list(orders)
+        self._bounds: Optional[List[np.ndarray]] = None
+
+    def node_string(self):
+        ks = ", ".join(str(o.expr) for o in self.orders)
+        return f"TpuIciRangeExchange [range({ks}) over {self.nparts}dev]"
+
+    def _base_key(self, schema) -> tuple:
+        from spark_rapids_tpu.runtime.kernel_cache import fingerprint
+        return ("range", self.nparts, fingerprint(list(self.orders)),
+                fingerprint(schema))
+
+    def _sample_bounds(self, sharded) -> List[np.ndarray]:
+        """Per-limb boundary arrays uint64[nparts-1]: sample local
+        shards' key limbs, (multiproc: allgather the samples so every
+        process derives IDENTICAL boundaries), lexsort, take
+        quantiles."""
+        import jax.numpy as jnp
+        from spark_rapids_tpu.exec.sort import _encode_key_limbs
+        local_ids = (self._ctx.local_partition_ids(self.mesh)
+                     if self._ctx is not None
+                     else list(range(self.nparts)))
+        samples = []
+        for p in local_ids:
+            shard = _local_shard(sharded, p)
+            limbs = _encode_key_limbs(shard, self.orders)
+            # slice to the shard's LIVE count: nonzero pads with index 0,
+            # and a sparse shard would otherwise flood the sample with
+            # one (possibly dead) row's key, collapsing the quantiles
+            live = int(jnp.sum(shard.sel.astype(jnp.int32)))
+            k = min(shard.capacity, 256, max(live, 0))
+            if k == 0:
+                continue
+            idx = jnp.nonzero(shard.sel, size=min(shard.capacity, 256),
+                              fill_value=0)[0][:k]
+            samples.append([np.asarray(jnp.take(l, idx))
+                            for l in limbs])
+        if not samples:
+            # no live rows on this process — boundaries still must be
+            # agreed; contribute empty arrays per limb
+            shard = _local_shard(sharded, local_ids[0])
+            nlimbs = len(_encode_key_limbs(shard, self.orders))
+            samples.append([np.zeros(0, np.uint64)
+                            for _ in range(nlimbs)])
+        cols = [np.concatenate([s[i] for s in samples]).astype(np.uint64)
+                for i in range(len(samples[0]))]
+        if self._ctx is not None:
+            payload = [c.tolist() for c in cols]
+            replies = self._ctx.client.allgather(
+                self._stage + ":range", payload, self._ctx.timeout)
+            cols = [np.concatenate([np.array(r[i], dtype=np.uint64)
+                                    for r in replies])
+                    for i in range(len(cols))]
+        n = len(cols[0])
+        if n == 0:
+            # degenerate: no live sample anywhere — any agreed
+            # boundaries are correct (rows all route to one partition)
+            return [np.zeros(self.nparts - 1, np.uint64) for _ in cols]
+        order = np.lexsort(list(reversed(cols)))
+        picks = [order[min(n - 1, (i + 1) * n // self.nparts)]
+                 for i in range(self.nparts - 1)]
+        return [c[picks] for c in cols]
+
+    def _aux_args(self, sharded) -> tuple:
+        if self._bounds is None:
+            self._bounds = self._sample_bounds(sharded)
+        return (self._bounds,)
+
+    def _count_builder(self):
+        return lambda: SH.build_range_count_program(
+            self.mesh, self.orders, self.nparts)
+
+    def _shuffle_builder(self, cap: int):
+        return lambda: SH.build_range_shuffle_program(
+            self.mesh, self.orders, self.nparts, cap)
+
+    def _local_pid(self, batch, base_key):
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+        fn = cached_kernel(
+            ("ici_mp_range_pid",) + base_key,
+            lambda: SH.range_pid_fn(self.orders))
+        return fn(batch, self._bounds)
 
 
 def ici_active(conf) -> bool:

@@ -484,17 +484,48 @@ class CpuTopNExec(CpuExec):
             return
 
 
+def _table_to_b64(t) -> str:
+    import base64
+    import io
+
+    import pyarrow as pa
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return base64.b64encode(sink.getvalue()).decode()
+
+
+def _b64_to_table(s: str):
+    import base64
+
+    import pyarrow as pa
+    return pa.ipc.open_stream(base64.b64decode(s)).read_all()
+
+
 class TpuTopNExec(TpuExec):
     """Per-partition device topN, then one merge sort of the winners.
 
     Each partition reduces to ≤ n live rows *before* the cross-partition
     gather, so the merge concat moves P·n rows, not the whole input —
-    the reference's GpuTopN/TakeOrderedAndProject shape."""
+    the reference's GpuTopN/TakeOrderedAndProject shape.  In
+    multi-executor mode each process reduces its slice the same way,
+    the ≤ n winner rows allgather host-side through the rendezvous (they
+    are tiny by construction), and process 0 emits the global answer —
+    the driver-side final reduce of Spark's TakeOrderedAndProject."""
+
+    # gathers child partitions, but multiproc execution is handled
+    # internally (winner-row allgather) — exempt from the structural
+    # multiproc gather guard
+    _multiproc_gather_ok = True
 
     def __init__(self, orders: Sequence[SortOrder], n: int, child: TpuExec):
         super().__init__(child.schema, child)
         self.orders = list(orders)
         self.n = int(n)
+        from spark_rapids_tpu.parallel.executor import get_executor
+        self._ctx = get_executor()
+        self._stage = (self._ctx.next_stage_id()
+                       if self._ctx is not None else None)
 
     def node_string(self):
         return f"TpuTopN [n={self.n}]"
@@ -519,10 +550,18 @@ class TpuTopNExec(TpuExec):
         from spark_rapids_tpu.exec.sort import sort_batch
         child = self.children[0]
         winners = []
-        for p in range(child.num_partitions()):
+        parts = range(child.num_partitions())
+        if self._ctx is not None:
+            from spark_rapids_tpu.exec.distributed import owned_partitions
+            parts = owned_partitions(child)
+        for p in parts:
             t = self._local_topn(p)
             if t is not None:
                 winners.append(t)
+        if self._ctx is not None:
+            winners = self._merge_across_executors(winners)
+            if winners is None:
+                return
         if not winners:
             return
         merged = concat_device_batches(self.schema, winners)
@@ -532,6 +571,41 @@ class TpuTopNExec(TpuExec):
             out = s.with_sel(keep)
         self.metric("numOutputBatches").add(1)
         yield out
+
+    def _merge_across_executors(self, winners):
+        """Allgather ≤ n local winner rows; only process 0 returns
+        batches (the union over executors must not duplicate the global
+        answer)."""
+        from spark_rapids_tpu.columnar.column import (
+            device_to_host, host_to_device)
+        from spark_rapids_tpu.exec.sort import sort_batch
+        ctx = self._ctx
+        payload = None
+        if winners:
+            # reduce the per-partition winners to THIS process's top-n
+            # before shipping: the rendezvous payload is then ≤ n rows,
+            # not partitions×n
+            local = concat_device_batches(self.schema, winners)
+            s = sort_batch(local, self.orders)
+            keep = s.sel & (jnp.arange(s.capacity,
+                                       dtype=jnp.int32) < self.n)
+            local = compact(s.with_sel(keep))
+            payload = _table_to_b64(device_to_host(local))
+        replies = ctx.client.allgather(self._stage + ":topn", payload,
+                                       ctx.timeout)
+        if ctx.process_id != 0:
+            return None
+        out = []
+        for r in replies:
+            if r is None:
+                continue
+            t = _b64_to_table(r)
+            if t.num_rows == 0:
+                continue
+            b = host_to_device(t)
+            out.append(DeviceBatch(self.schema, b.columns, b.sel,
+                                   compacted=True))
+        return out
 
 
 # ---------------------------------------------------------------------------

@@ -94,20 +94,30 @@ class TpuSortExec(TpuExec):
     over encoded key limbs; range-partitioned out-of-core path when the
     whole partition won't fit the budget (see module docstring)."""
 
-    def __init__(self, orders: Sequence[SortOrder], child: TpuExec):
+    def __init__(self, orders: Sequence[SortOrder], child: TpuExec,
+                 partitioned: bool = False):
         super().__init__(child.schema, child)
         self.orders = list(orders)
+        # downstream of a RANGE exchange: each partition sorts locally
+        # and ascending partition order IS the total order
+        self.partitioned = partitioned
 
     def node_string(self):
-        return f"TpuSort [{', '.join(str(o.expr) for o in self.orders)}]"
+        part = " partitioned" if self.partitioned else ""
+        return (f"TpuSort{part} "
+                f"[{', '.join(str(o.expr) for o in self.orders)}]")
 
     def num_partitions(self) -> int:
+        if self.partitioned:
+            return self.children[0].num_partitions()
         return 1
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu.runtime.memory import RetryOOM, get_manager
         child = self.children[0]
-        batches = [compact(b) for p in range(child.num_partitions())
+        parts = ([partition] if self.partitioned
+                 else range(child.num_partitions()))
+        batches = [compact(b) for p in parts
                    for b in child.execute(p)]
         if not batches:
             return
@@ -176,6 +186,21 @@ def _encode_key_limbs(batch: DeviceBatch, orders: Sequence[SortOrder]
     return ORD.fuse_parts(parts)
 
 
+def pick_quantile_boundaries(cols: List[np.ndarray], nranges: int
+                             ) -> List[np.ndarray]:
+    """Host-side quantile pick over sampled key limbs → per-limb
+    boundary arrays uint64[nranges-1].  THE shared boundary math of the
+    out-of-core sort and the distributed range exchange — one
+    implementation so skew handling can never drift between them."""
+    n = len(cols[0]) if cols else 0
+    if n == 0:
+        return [np.zeros(max(nranges - 1, 0), np.uint64) for _ in cols]
+    order = np.lexsort(list(reversed(cols)))
+    picks = [order[min(n - 1, (i + 1) * n // nranges)]
+             for i in range(nranges - 1)]
+    return [c[picks] for c in cols]
+
+
 def _sample_boundaries(batches: List[DeviceBatch],
                        orders: Sequence[SortOrder], nranges: int
                        ) -> List[np.ndarray]:
@@ -193,20 +218,15 @@ def _sample_boundaries(batches: List[DeviceBatch],
     nlimbs = len(samples[0])
     cols = [np.concatenate([s[i] for s in samples]) for i in
             range(nlimbs)]
-    order = np.lexsort(list(reversed(cols)))
-    n = len(order)
-    picks = [order[min(n - 1, (i + 1) * n // nranges)]
-             for i in range(nranges - 1)]
-    return [c[picks] for c in cols]
+    return pick_quantile_boundaries(cols, nranges)
 
 
 def _range_ids(batch: DeviceBatch, orders: Sequence[SortOrder],
                bounds: List[np.ndarray]) -> jnp.ndarray:
-    """Range id per row: lexicographic searchsorted against boundaries."""
-    from spark_rapids_tpu.exec.join import _lex_search
-    limbs = _encode_key_limbs(batch, orders)
-    blimbs = [jnp.asarray(b) for b in bounds]
-    return _lex_search(blimbs, limbs, "right").astype(jnp.int32)
+    """Range id per row: lexicographic searchsorted against boundaries
+    (delegates to the exchange's pid fn — one range-id implementation)."""
+    from spark_rapids_tpu.parallel.shuffle import range_pid_fn
+    return range_pid_fn(orders)(batch, bounds)
 
 
 def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]
@@ -240,4 +260,12 @@ def _tag_sort(meta):
 
 
 def _convert_sort(cpu, ch, conf):
+    from spark_rapids_tpu.exec.distributed import (
+        TpuIciRangeExchangeExec, ici_active)
+    if ici_active(conf):
+        # distributed total order: range exchange (sampled boundaries)
+        # + per-partition local sort; ascending partition index IS the
+        # global order [REF: GpuRangePartitioning.scala]
+        ex = TpuIciRangeExchangeExec(ch[0], cpu.orders)
+        return TpuSortExec(cpu.orders, ex, partitioned=True)
     return TpuSortExec(cpu.orders, ch[0])

@@ -561,18 +561,26 @@ class TpuWindowExec(TpuExec):
     def __init__(self, partition_by: Sequence[Expression],
                  order_by: Sequence[L.SortOrder],
                  fns: Sequence[L.WindowFunctionSpec],
-                 schema: T.StructType, child: TpuExec):
+                 schema: T.StructType, child: TpuExec,
+                 partitioned: bool = False):
         super().__init__(schema, child)
         self.partition_by = list(partition_by)
         self.order_by = list(order_by)
         self.fns = list(fns)
+        # downstream of a hash exchange on partition_by: each exchange
+        # partition owns disjoint window-partition keys, so the window
+        # runs per partition (the distributed plan shape)
+        self.partitioned = partitioned
 
     def node_string(self):
         parts = ", ".join(str(e) for e in self.partition_by)
         fns = ", ".join(f.kind for f in self.fns)
-        return f"TpuWindow [partitionBy=[{parts}] fns=[{fns}]]"
+        mode = " partitioned" if self.partitioned else ""
+        return f"TpuWindow{mode} [partitionBy=[{parts}] fns=[{fns}]]"
 
     def num_partitions(self) -> int:
+        if self.partitioned:
+            return self.children[0].num_partitions()
         return 1
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
@@ -580,7 +588,9 @@ class TpuWindowExec(TpuExec):
             cached_kernel, fingerprint)
         from spark_rapids_tpu.runtime.memory import get_manager
         child = self.children[0]
-        batches = [compact(b) for p in range(child.num_partitions())
+        parts = ([partition] if self.partitioned
+                 else range(child.num_partitions()))
+        batches = [compact(b) for p in parts
                    for b in child.execute(p)]
         if not batches:
             return
@@ -880,5 +890,16 @@ def _tag_window(meta):
 
 
 def _convert_window(cpu: CpuWindowExec, ch, conf):
+    from spark_rapids_tpu.exec.distributed import (
+        TpuIciShuffleExchangeExec, hashable_on_device, ici_active)
+    if (ici_active(conf) and cpu.partition_by
+            and all(hashable_on_device(e.dtype)
+                    for e in cpu.partition_by)):
+        # distributed: hash-exchange on partition_by — each exchange
+        # partition owns disjoint window-partition keys [REF:
+        # GpuWindowExec under Spark's required ClusteredDistribution]
+        ex = TpuIciShuffleExchangeExec(ch[0], cpu.partition_by)
+        return TpuWindowExec(cpu.partition_by, cpu.order_by, cpu.fns,
+                             cpu.schema, ex, partitioned=True)
     return TpuWindowExec(cpu.partition_by, cpu.order_by, cpu.fns,
                          cpu.schema, ch[0])

@@ -91,10 +91,11 @@ def _as_dict(v):
 
 class DeltaSnapshot:
     def __init__(self, schema: T.StructType, partition_columns: List[str],
-                 files: List[Tuple[str, Dict]]):
+                 files: List[Tuple[str, Dict, Optional[Dict]]]):
         self.schema = schema  # full table schema incl. partition cols
         self.partition_columns = partition_columns
-        self.files = files    # [(abs path, raw partitionValues dict)]
+        # [(abs path, raw partitionValues dict, DV descriptor | None)]
+        self.files = files
 
 
 def _apply_action(state: dict, action: dict) -> None:
@@ -115,7 +116,8 @@ def _apply_action(state: dict, action: dict) -> None:
         if int(p.get("minReaderVersion", 1)) > 2:
             feats = p.get("readerFeatures") or []
             unsupported = [f for f in feats
-                           if f not in ("timestampNtz", "columnMapping")]
+                           if f not in ("timestampNtz", "columnMapping",
+                                        "deletionVectors")]
             if "columnMapping" in feats:
                 raise DeltaProtocolError("delta column mapping feature")
             if unsupported:
@@ -123,12 +125,11 @@ def _apply_action(state: dict, action: dict) -> None:
                     f"delta reader features {unsupported} not supported")
     if "add" in action:
         a = action["add"]
-        if a.get("deletionVector"):
-            raise DeltaProtocolError(
-                "delta deletion vectors are not supported — run VACUUM/"
-                "OPTIMIZE to materialize deletes, or read with the "
-                "reference engine")
-        state["files"][a["path"]] = _as_dict(a.get("partitionValues"))
+        # deletion vectors decode at load (io/deletion_vectors.py) and
+        # apply as a scan-time row mask
+        state["files"][a["path"]] = (
+            _as_dict(a.get("partitionValues")),
+            _as_dict(a.get("deletionVector")) or None)
     if "remove" in action:
         state["files"].pop(action["remove"]["path"], None)
 
@@ -192,8 +193,8 @@ def load_snapshot(table_path: str) -> DeltaSnapshot:
             f"delta log at {table_path} has no metaData action")
     from urllib.parse import unquote
     # add.path is an RFC 2396 percent-encoded relative URI per the spec
-    files = [(os.path.join(table_path, unquote(p)), pv)
-             for p, pv in sorted(state["files"].items())]
+    files = [(os.path.join(table_path, unquote(p)), pv, dv)
+             for p, (pv, dv) in sorted(state["files"].items())]
     return DeltaSnapshot(state["schema"], state["partition_columns"],
                          files)
 
@@ -208,12 +209,17 @@ def delta_relation(table_path: str):
     part_fields = tuple(f for f in snap.schema.fields
                         if f.name in part_cols)
     by_name = {f.name: f for f in part_fields}
-    paths = [p for p, _ in snap.files]
+    paths = [p for p, _, _ in snap.files]
     pvals = [{k: _partition_value(v, by_name[k].dtype)
               for k, v in pv.items() if k in by_name}
-             for _, pv in snap.files]
+             for _, pv, _ in snap.files]
+    deletes = None
+    if any(dv for _, _, dv in snap.files):
+        from spark_rapids_tpu.io.deletion_vectors import read_dv
+        deletes = [read_dv(dv, table_path) if dv else None
+                   for _, _, dv in snap.files]
     schema = T.StructType(data_fields + part_fields)
     return ParquetRelation(
         paths, schema, format="parquet",
         partition_values=pvals if part_fields else None,
-        partition_fields=part_fields)
+        partition_fields=part_fields, deletes=deletes)

@@ -101,7 +101,8 @@ def _resolve_path(p: str, table_path: str) -> str:
 
 
 def load_snapshot(table_path: str):
-    """(table schema, partition field names, [(path, {pcol: value})])."""
+    """(table schema, partition field names, [(path, {pcol: value})],
+    per-file deleted-position arrays | None)."""
     with open(_latest_metadata(table_path)) as f:
         md = json.load(f)
     schema = _schema_from_metadata(md)
@@ -124,7 +125,7 @@ def load_snapshot(table_path: str):
 
     snap_id = md.get("current-snapshot-id")
     if snap_id in (None, -1):
-        return schema, part_cols, []
+        return schema, part_cols, [], None
     snap = next(s for s in md.get("snapshots", [])
                 if s.get("snapshot-id") == snap_id)
     files: List[tuple] = []
@@ -136,6 +137,7 @@ def load_snapshot(table_path: str):
     else:  # v1 inline manifest array
         manifests = [_resolve_path(p, table_path)
                      for p in snap.get("manifests", [])]
+    delete_files: List[str] = []
     for mpath in manifests:
         _, entries = read_container(mpath)
         for e in entries:
@@ -144,11 +146,19 @@ def load_snapshot(table_path: str):
                 continue
             df = e["data_file"]
             content = df.get("content", 0)
+            if content == 1:
+                # v2 POSITION deletes: a parquet file of
+                # (file_path, pos) rows — collected here, applied as
+                # per-file row masks at scan [REF: iceberg spec
+                # "Position Delete Files"; GpuDeleteFilter]
+                delete_files.append(
+                    _resolve_path(df["file_path"], table_path))
+                continue
             if content != 0:
                 raise IcebergProtocolError(
-                    "iceberg delete files (v2 row-level deletes) are "
-                    "not supported — compact the table, or read with "
-                    "the reference engine")
+                    "iceberg EQUALITY delete files (content=2) are not "
+                    "supported — compact the table, or read with the "
+                    "reference engine")
             fmt = str(df.get("file_format", "PARQUET")).upper()
             if fmt != "PARQUET":
                 raise IcebergProtocolError(
@@ -156,12 +166,48 @@ def load_snapshot(table_path: str):
             part = df.get("partition") or {}
             files.append((_resolve_path(df["file_path"], table_path),
                           dict(part)))
-    return schema, part_cols, sorted(files, key=lambda t: t[0])
+    files = sorted(files, key=lambda t: t[0])
+    deletes = None
+    if delete_files:
+        deletes = _load_position_deletes(
+            delete_files, [p for p, _ in files], table_path)
+    return schema, part_cols, files, deletes
+
+
+def _load_position_deletes(delete_files: List[str],
+                           data_paths: List[str], table_path: str):
+    """Read position-delete parquet files → per-data-file sorted
+    position arrays aligned with ``data_paths``.
+
+    The spec's file_path values are the manifests' (possibly
+    absolute/URI) paths; match both the raw string and the resolved
+    local path so synthesized and real tables both hit."""
+    import numpy as np
+    import pyarrow.parquet as pq
+    by_path = {}
+    for i, p in enumerate(data_paths):
+        by_path[p] = i
+        by_path[os.path.abspath(p)] = i
+    acc: dict = {}
+    for dp in delete_files:
+        tbl = pq.read_table(dp, columns=["file_path", "pos"])
+        for fp, pos in zip(tbl.column("file_path").to_pylist(),
+                           tbl.column("pos").to_pylist()):
+            i = by_path.get(fp)
+            if i is None:
+                i = by_path.get(_resolve_path(fp, table_path))
+            if i is None:
+                continue  # deletes for a file not in this snapshot
+            acc.setdefault(i, []).append(pos)
+    out = [None] * len(data_paths)
+    for i, lst in acc.items():
+        out[i] = np.unique(np.asarray(lst, dtype=np.int64))
+    return out
 
 
 def iceberg_relation(table_path: str):
     from spark_rapids_tpu.plan.logical import ParquetRelation
-    schema, part_cols, files = load_snapshot(table_path)
+    schema, part_cols, files, deletes = load_snapshot(table_path)
     data_fields = tuple(f for f in schema.fields
                         if f.name not in part_cols)
     part_fields = tuple(f for f in schema.fields if f.name in part_cols)
@@ -171,4 +217,4 @@ def iceberg_relation(table_path: str):
     return ParquetRelation(
         paths, out_schema, format="parquet",
         partition_values=pvals if part_fields else None,
-        partition_fields=part_fields)
+        partition_fields=part_fields, deletes=deletes)

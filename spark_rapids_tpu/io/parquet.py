@@ -156,6 +156,9 @@ class CpuParquetScanExec(CpuExec):
         path = self.paths[fi]
         cols = self._data_columns()
         by_name = {f.name: f for f in self.schema.fields}
+        dels = (self.relation.deletes[fi]
+                if self.relation.deletes is not None else None)
+        positions = None  # file-absolute row positions of the read rows
         if self.relation.format == "orc":
             import pyarrow.orc as po
             orc = po.ORCFile(path)
@@ -184,8 +187,29 @@ class CpuParquetScanExec(CpuExec):
                        if keep
                        else pf.schema_arrow.empty_table().select(
                            read_cols))
+                if dels is not None and len(dels) and keep:
+                    # delete positions are FILE-absolute; row-group
+                    # pruning shifted local indexes, so rebuild them
+                    rg_rows = [pf.metadata.row_group(i).num_rows
+                               for i in range(pf.metadata.num_row_groups)]
+                    starts = np.concatenate(
+                        [[0], np.cumsum(rg_rows)[:-1]])
+                    positions = np.concatenate(
+                        [np.arange(starts[rg], starts[rg] + rg_rows[rg],
+                                   dtype=np.int64) for rg in keep])
             else:
                 tbl = pf.read(columns=read_cols)  # reuse the open file
+        if dels is not None and len(dels) and tbl.num_rows:
+            # row mask from the deleted positions (sorted searchsorted
+            # membership — dels can be large, positions larger)
+            if positions is None:
+                positions = np.arange(tbl.num_rows, dtype=np.int64)
+            ix = np.searchsorted(dels, positions)
+            hit = np.zeros(len(positions), bool)
+            in_rng = ix < len(dels)
+            hit[in_rng] = dels[ix[in_rng]] == positions[in_rng]
+            self.metric("deletedRows").add(int(hit.sum()))
+            tbl = tbl.filter(pa.array(~hit))
         if len(read_cols) < len(cols):
             for c in cols:
                 if c not in present:

@@ -167,3 +167,16 @@ class DateDiff(Expression):
         r = self.right.eval_cpu(batch)
         return HostCol(self.dtype, (l.data - r.data).astype(np.int32),
                        merge_validity_h(l.validity, r.validity))
+
+
+# -- TypeSig declarations (see expressions.py) ------------------------------
+from spark_rapids_tpu.ops import expressions as E  # noqa: E402
+
+for _cls in (Year, Month, DayOfMonth):
+    _cls.type_sig = E.SIG_INTEGRAL
+    _cls.input_sig = E.SIG_DATETIME
+for _cls in (DateAdd, DateSub):
+    _cls.type_sig = E.SIG_DATETIME
+    _cls.input_sig = E.SIG_DATETIME | E.SIG_INTEGRAL
+DateDiff.type_sig = E.SIG_INTEGRAL
+DateDiff.input_sig = E.SIG_DATETIME

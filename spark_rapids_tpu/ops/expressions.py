@@ -66,10 +66,57 @@ def _is_float(dt: T.DataType) -> bool:
     return isinstance(dt, (T.FloatType, T.DoubleType))
 
 
+# ---------------------------------------------------------------------------
+# TypeSig: per-expression declared type support [REF: TypeChecks.scala ::
+# TypeSig/ExprChecks].  Each expression class declares the type TAGS its
+# device lowering accepts for inputs (``input_sig``) and produces
+# (``type_sig``); the plan-rewrite engine checks both while tagging and
+# docs_gen emits the per-type support matrix from the same declarations.
+# ---------------------------------------------------------------------------
+
+SIG_TAGS = ("boolean", "byte", "short", "int", "long", "float", "double",
+            "decimal", "string", "binary", "date", "timestamp", "null",
+            "array", "map", "struct")
+
+SIG_ALL_SCALAR = frozenset(SIG_TAGS) - {"array", "map", "struct"}
+SIG_NUMERIC = frozenset({"byte", "short", "int", "long", "float",
+                         "double", "decimal", "null"})
+SIG_INTEGRAL = frozenset({"byte", "short", "int", "long", "null"})
+SIG_FLOATING = frozenset({"float", "double", "null"})
+SIG_STRINGY = frozenset({"string", "binary", "null"})
+SIG_BOOLEAN = frozenset({"boolean", "null"})
+SIG_DATETIME = frozenset({"date", "timestamp", "null"})
+SIG_ALL = frozenset(SIG_TAGS)
+
+
+def sig_tag(dt: T.DataType) -> str:
+    """Type tag of a dtype for TypeSig membership checks."""
+    if isinstance(dt, T.DecimalType):
+        return "decimal"
+    if isinstance(dt, T.ArrayType):
+        return "array"
+    if isinstance(dt, T.MapType):
+        return "map"
+    if isinstance(dt, T.StructType):
+        return "struct"
+    return {T.BooleanType: "boolean", T.ByteType: "byte",
+            T.ShortType: "short", T.IntegerType: "int",
+            T.LongType: "long", T.FloatType: "float",
+            T.DoubleType: "double", T.StringType: "string",
+            T.BinaryType: "binary", T.DateType: "date",
+            T.TimestampType: "timestamp",
+            T.NullType: "null"}.get(type(dt), dt.simple_name)
+
+
 class Expression:
     """Base expression.  Subclasses are dataclasses with typed children."""
 
     dtype: T.DataType
+    # TypeSig declarations; tagging checks result dtype against
+    # ``type_sig`` and every child dtype against ``input_sig`` (None =
+    # same as type_sig).  Default = every scalar type; classes narrow.
+    type_sig: frozenset = SIG_ALL_SCALAR
+    input_sig: Optional[frozenset] = None
 
     @property
     def children(self) -> Sequence["Expression"]:
@@ -1391,3 +1438,33 @@ class Cast(Expression):
 
     def __str__(self):
         return f"cast({self.child} as {self.dtype.simple_name})"
+
+
+# ---------------------------------------------------------------------------
+# TypeSig declarations [REF: TypeChecks.scala — per-op type signatures].
+# ``input_sig`` applies to every child uniformly (a per-parameter split
+# like the reference's ExprChecks is future work), so mixed-arity
+# expressions declare the union of their parameter sigs.
+# ---------------------------------------------------------------------------
+
+for _cls in (Add, Subtract, Multiply, Divide, IntegralDivide, Remainder,
+             UnaryMinus, Abs, Round):
+    _cls.type_sig = SIG_NUMERIC
+for _cls in (Sqrt, Exp, Log, Pow):
+    _cls.type_sig = SIG_FLOATING
+    _cls.input_sig = SIG_NUMERIC
+for _cls in (Floor, Ceil):
+    _cls.type_sig = SIG_NUMERIC
+for _cls in (EqualTo, LessThan, LessThanOrEqual, GreaterThan,
+             GreaterThanOrEqual, EqualNullSafe):
+    _cls.type_sig = SIG_BOOLEAN
+    _cls.input_sig = SIG_ALL_SCALAR
+for _cls in (Not, And, Or):
+    _cls.type_sig = SIG_BOOLEAN
+for _cls in (IsNull, IsNotNull):
+    _cls.type_sig = SIG_BOOLEAN
+    _cls.input_sig = SIG_ALL_SCALAR | frozenset({"array"})
+IsNaN.type_sig = SIG_BOOLEAN
+IsNaN.input_sig = SIG_FLOATING
+# column pass-through carries everything a batch can hold
+BoundReference.type_sig = SIG_ALL

@@ -668,3 +668,12 @@ class XxHash64(Expression):
                 data = (c.data, None)
             h = xxhash_column(data, e.dtype, h, c.valid_mask(), np)
         return HostCol(self.dtype, h.view(np.int64))
+
+
+# -- TypeSig declarations (see expressions.py) ------------------------------
+from spark_rapids_tpu.ops import expressions as _E  # noqa: E402
+
+Murmur3Hash.type_sig = _E.SIG_INTEGRAL
+Murmur3Hash.input_sig = _E.SIG_ALL_SCALAR
+XxHash64.type_sig = _E.SIG_INTEGRAL
+XxHash64.input_sig = _E.SIG_ALL_SCALAR

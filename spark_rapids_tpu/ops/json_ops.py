@@ -1,0 +1,128 @@
+"""JSON expressions — phase-1 host evaluation.
+
+[REF: sql-plugin/../GpuGetJsonObject + spark-rapids-jni
+ get_json_object kernel; GpuJsonToStructs]  The reference runs a CUDA
+JSON tokenizer; the TPU path for byte-matrix JSON scanning is planned as
+a Pallas kernel (SURVEY N9) — until then these expressions evaluate on
+the HOST (the CPU oracle path), and the plan-rewrite engine tags their
+subtree with a clear NOT_ON_TPU reason instead of failing.
+
+Semantics follow Spark's ``get_json_object``:
+
+* malformed JSON input → null (never an error, non-ANSI),
+* path must start with ``$``; ``.field``, ``['field']`` and ``[index]``
+  steps; a missing step → null,
+* a matched STRING value returns its raw (unquoted) text; any other
+  matched value returns its JSON serialization.
+
+Known divergence (documented): numbers re-serialize through Python
+(``1.00`` → ``1.0``) and object key order is preserved but whitespace is
+normalized — byte-exactness with Spark's raw-token extraction is the
+device kernel's job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import re
+from typing import List, Optional
+
+import numpy as np
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.columnar.host import HostBatch, HostCol
+from spark_rapids_tpu.ops.expressions import (
+    SIG_STRINGY, Expression)
+
+_STEP_RE = re.compile(
+    r"\.(?P<field>[A-Za-z_][A-Za-z0-9_]*)"
+    r"|\[\s*'(?P<qfield>[^']*)'\s*\]"
+    r"|\[\s*\"(?P<dqfield>[^\"]*)\"\s*\]"
+    r"|\[\s*(?P<index>\d+)\s*\]")
+
+
+def parse_json_path(path: str) -> Optional[List[object]]:
+    """``$.a.b[0]`` → ['a', 'b', 0]; None when the path is invalid
+    (Spark: invalid path → null result for every row)."""
+    if not path or not path.startswith("$"):
+        return None
+    steps: List[object] = []
+    pos = 1
+    while pos < len(path):
+        m = _STEP_RE.match(path, pos)
+        if m is None:
+            return None
+        if m.group("field") is not None:
+            steps.append(m.group("field"))
+        elif m.group("qfield") is not None:
+            steps.append(m.group("qfield"))
+        elif m.group("dqfield") is not None:
+            steps.append(m.group("dqfield"))
+        else:
+            steps.append(int(m.group("index")))
+        pos = m.end()
+    return steps
+
+
+def extract_json_path(doc: str, steps: List[object]) -> Optional[str]:
+    try:
+        v = json.loads(doc)
+    except (ValueError, TypeError):
+        return None
+    for s in steps:
+        if isinstance(s, int):
+            if not isinstance(v, list) or s >= len(v):
+                return None
+            v = v[s]
+        else:
+            if not isinstance(v, dict) or s not in v:
+                return None
+            v = v[s]
+    if v is None:
+        return None
+    if isinstance(v, str):
+        return v
+    return json.dumps(v, separators=(",", ":"), ensure_ascii=False)
+
+
+@dataclasses.dataclass
+class GetJsonObject(Expression):
+    """get_json_object(json, path) → string | null."""
+
+    child: Expression
+    path: str
+    dtype: T.DataType = dataclasses.field(
+        default_factory=lambda: T.StringT)
+
+    type_sig = SIG_STRINGY
+    input_sig = SIG_STRINGY
+
+    @property
+    def children(self):
+        return (self.child,)
+
+    def eval_cpu(self, batch: HostBatch) -> HostCol:
+        c = self.child.eval_cpu(batch)
+        steps = parse_json_path(self.path)
+        n = len(c.data)
+        out = np.empty(n, dtype=object)
+        validity = np.zeros(n, bool)
+        if steps is not None:
+            for i in range(n):
+                if c.validity is not None and not c.validity[i]:
+                    continue
+                v = c.data[i]
+                if isinstance(v, bytes):
+                    v = v.decode("utf-8", "replace")
+                r = extract_json_path(v, steps)
+                if r is not None:
+                    out[i] = r
+                    validity[i] = True
+        for i in range(n):
+            if out[i] is None:
+                out[i] = ""
+        return HostCol(T.StringT, out, validity)
+
+    def __str__(self):
+        return f"get_json_object({self.child}, {self.path!r})"

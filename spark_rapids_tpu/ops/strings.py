@@ -1404,3 +1404,24 @@ class StringPad(Expression):
                 r = (fill + sb) if self.left else (sb + fill)
             out[i] = r.decode(errors="replace")
         return HostCol(self.dtype, out, c.validity)
+
+
+# -- TypeSig declarations (see expressions.py) ------------------------------
+from spark_rapids_tpu.ops import expressions as E  # noqa: E402
+
+_STR_INT = E.SIG_STRINGY | E.SIG_INTEGRAL
+for _cls in (Upper, Lower, Trim, StringReverse, Concat, StringReplace,
+             RegexpExtract, RegexpReplace):
+    _cls.type_sig = E.SIG_STRINGY
+Length.type_sig = E.SIG_INTEGRAL
+Length.input_sig = E.SIG_STRINGY
+for _cls in (StringComparison, StringPredicate, Like, RLike):
+    _cls.type_sig = E.SIG_BOOLEAN
+    _cls.input_sig = E.SIG_STRINGY
+for _cls in (Substring, StringPad):
+    _cls.type_sig = E.SIG_STRINGY
+    _cls.input_sig = _STR_INT
+StringLocate.type_sig = E.SIG_INTEGRAL
+StringLocate.input_sig = _STR_INT
+Split.type_sig = frozenset({"array"})
+Split.input_sig = E.SIG_STRINGY

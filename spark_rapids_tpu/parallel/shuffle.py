@@ -168,6 +168,59 @@ def exchange_collective(batch_laid: DeviceBatch, axis: str, nparts: int,
     return jax.tree.map(coll, batch_laid)
 
 
+def range_pid_fn(orders):
+    """batch, boundary-limbs → int32 partition ids by RANGE: each row's
+    orderable key limbs lexicographically searchsorted against nparts-1
+    sampled boundary rows [REF: GpuRangePartitioning.scala — there a
+    sorted-table bound search on the CPU; here the same search runs
+    vectorized on device, sharing the sort machinery's key encoding]."""
+    def pids(batch: DeviceBatch, blimbs) -> jnp.ndarray:
+        from spark_rapids_tpu.exec.join import _lex_search
+        from spark_rapids_tpu.exec.sort import _encode_key_limbs
+        limbs = _encode_key_limbs(batch, orders)
+        bl = [jnp.asarray(b) for b in blimbs]
+        return _lex_search(bl, limbs, "right").astype(jnp.int32)
+
+    return pids
+
+
+def build_range_count_program(mesh: jax.sharding.Mesh, orders,
+                              nparts: int):
+    """Phase-1 SPMD program for the RANGE exchange: per-device
+    per-partition live-row counts.  Boundary limbs ride as traced,
+    mesh-replicated arguments (data-dependent — never baked into the
+    cached executable)."""
+    axis = mesh.axis_names[0]
+    pid_fn = range_pid_fn(orders)
+
+    def step(batch: DeviceBatch, blimbs) -> jnp.ndarray:
+        return local_partition_counts(batch, pid_fn(batch, blimbs),
+                                      nparts)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    rep = jax.sharding.PartitionSpec()
+    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, rep),
+                                 out_specs=spec))
+
+
+def build_range_shuffle_program(mesh: jax.sharding.Mesh, orders,
+                                nparts: int, cap: int):
+    """Phase-2 SPMD program for the RANGE exchange: layout → all_to_all
+    → flat received batch (partition p holds key range p)."""
+    axis = mesh.axis_names[0]
+    pid_fn = range_pid_fn(orders)
+
+    def step(batch: DeviceBatch, blimbs) -> DeviceBatch:
+        laid = partition_layout(batch, pid_fn(batch, blimbs), nparts,
+                                cap)
+        return exchange_collective(laid, axis, nparts, cap)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    rep = jax.sharding.PartitionSpec()
+    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, rep),
+                                 out_specs=spec))
+
+
 def build_count_program(mesh: jax.sharding.Mesh, keys, nparts: int,
                         canon_int64=()):
     """Phase-1 SPMD program: per-device per-partition live-row counts."""

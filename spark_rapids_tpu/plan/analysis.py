@@ -319,6 +319,14 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
                 out = E.Or(out, one(lit + term))
             return out
         return S.RLike(child, pattern)
+    if op == "get_json_object":
+        from spark_rapids_tpu.ops.json_ops import GetJsonObject
+        child = resolve(u.children[0], schema)
+        if not isinstance(child.dtype, (T.StringType, T.BinaryType)):
+            raise AnalysisException(
+                "get_json_object needs a string operand, got "
+                f"{child.dtype.simple_name}")
+        return GetJsonObject(child, str(u.payload))
     if op == "regexp_extract":
         pattern, idx = u.payload
         S.check_regex_supported(pattern)

@@ -72,6 +72,11 @@ class ParquetRelation(LogicalPlan):
     # dynamic partition pruning: (build-side Project plan yielding the
     # join key column, partition column name) — filled by the optimizer
     dpp: Optional[tuple] = None
+    # row-level deletes, aligned with ``paths``: per file a SORTED
+    # int64 array of deleted row positions (or None) — filled by the
+    # Delta (deletion vectors) / Iceberg (v2 position deletes) loaders,
+    # applied as a row mask at scan time
+    deletes: Optional[List] = None
 
 
 @dataclasses.dataclass

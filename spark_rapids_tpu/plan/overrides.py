@@ -142,7 +142,7 @@ def tag_expression(e: Expression, meta: ExecMeta):
         r = hook(meta.conf)
         if r:
             meta.will_not_work(f"expression {name}: {r}")
-    from spark_rapids_tpu.ops.expressions import BoundReference
+    from spark_rapids_tpu.ops.expressions import BoundReference, sig_tag
     if isinstance(e, BoundReference):
         # direct column pass-through supports everything a batch can
         # carry (incl. array<numeric>); computed expressions stay
@@ -152,6 +152,22 @@ def tag_expression(e: Expression, meta: ExecMeta):
         r = is_device_supported_type(e.dtype)
     if r:
         meta.will_not_work(f"expression {e}: {r}")
+    # per-op TypeSig [REF: TypeChecks.scala]: the class declares which
+    # type tags its device lowering produces/accepts — checked here,
+    # rendered as the support matrix in docs/supported_ops.md
+    cls = type(e)
+    tag = sig_tag(e.dtype)
+    if tag not in cls.type_sig:
+        meta.will_not_work(
+            f"expression {name} does not produce {tag} on device "
+            f"(type sig: {', '.join(sorted(cls.type_sig))})")
+    in_sig = cls.input_sig if cls.input_sig is not None else cls.type_sig
+    for c in e.children:
+        ctag = sig_tag(c.dtype)
+        if ctag not in in_sig:
+            meta.will_not_work(
+                f"expression {name} does not accept a {ctag} input on "
+                f"device (input sig: {', '.join(sorted(in_sig))})")
     if not hasattr(e, "eval_tpu") or (
             type(e).eval_tpu is Expression.eval_tpu):
         meta.will_not_work(f"expression {name} has no TPU implementation")
@@ -493,8 +509,7 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
     """
     from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
     from spark_rapids_tpu.exec.distributed import TpuIciShuffleExchangeExec
-    from spark_rapids_tpu.exec.join import (
-        TpuBroadcastExchangeExec, TpuSortMergeJoinExec)
+    from spark_rapids_tpu.exec.join import TpuBroadcastExchangeExec
     from spark_rapids_tpu.exec.sort import TpuSortExec
     from spark_rapids_tpu.exec.window import TpuWindowExec
     from spark_rapids_tpu import conf as C
@@ -505,13 +520,22 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
         target = max(conf.get(C.BATCH_SIZE_BYTES)
                      // _estimated_row_bytes(node.schema),
                      conf.min_bucket_rows)
+        # row-capped at batchRows: static-shape kernels compile per
+        # pow-2 bucket, so an unbounded byte target (512 MB / 8-byte
+        # rows = a 64M-row bucket) would hand downstream operators a
+        # bucket the batch-size knob was set to avoid
+        target = min(target, conf.batch_rows)
         return TpuCoalesceBatchesExec(node, target_rows=target)
-    if isinstance(node, (TpuSortExec, TpuSortMergeJoinExec, TpuWindowExec)):
+    if isinstance(node, (TpuSortExec, TpuWindowExec)):
         # RequireSingleBatch is only made plan-visible for single-
         # partition children: there it replaces the operator's internal
         # concat 1:1.  Multi-partition children keep the operator's own
         # cross-partition gather (one concat) — a per-partition coalesce
-        # below it would copy every row twice.
+        # below it would copy every row twice.  JOINS are deliberately
+        # NOT here (round-5 fix): a pre-concatenated whole side would
+        # bypass TpuSortMergeJoin's row-capped sub-partitioning — the
+        # single giant gather (6M rows → one 8M bucket on TPC-H q10)
+        # is exactly what killed the r4 TPU worker.
         node._children = tuple(
             TpuCoalesceBatchesExec(c, require_single=True)
             if isinstance(c, TpuExec) and c.num_partitions() == 1
@@ -531,9 +555,12 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
 # non-collective shuffle exchanges are in-process only); the structural
 # checks below catch every gather point and partition-structure change,
 # including CPU-fallback nodes.
+# Sort/Window/TopN/GlobalLimit are distributable since round 5: Sort
+# rides a RANGE exchange + per-partition local sorts, Window a hash
+# exchange on partition_by, TopN/GlobalLimit reduce locally then
+# rendezvous-allgather their (tiny) winner rows / counts.
 _MULTIPROC_UNSUPPORTED = {
-    "TpuSortExec", "TpuGlobalLimitExec", "TpuTakeOrderedAndProjectExec",
-    "TpuWindowExec", "TpuBroadcastExchangeExec", "TpuExpandExec",
+    "TpuBroadcastExchangeExec", "TpuExpandExec",
     "TpuGenerateExec", "TpuPythonUDFExec", "TpuSampleExec",
     "CpuSortExec", "CpuGlobalLimitExec", "CpuTakeOrderedAndProjectExec",
     "CpuWindowExec", "CpuSampleExec", "CpuPythonUDFExec",
@@ -566,18 +593,23 @@ def _validate_multiproc(plan) -> None:
                 "against another")
         if isinstance(node, CpuJoinExec):
             bad(name, "CPU-fallback joins gather one slice per process")
+        gather_ok = getattr(node, "_multiproc_gather_ok", False)
         for c in node.children:
             # structural guards (catch CPU fallbacks and any operator
             # missed by name): a gather point collapses partitions this
             # process only partly owns; a partition-structure change
-            # above an exchange breaks local-partition ownership
+            # above an exchange breaks local-partition ownership.
+            # Nodes flagged _multiproc_gather_ok (TopN, GlobalLimit)
+            # gather via an explicit cross-process allgather instead.
             if (not isinstance(node, TpuIciShuffleExchangeExec)
+                    and not gather_ok
                     and c.num_partitions() > 1
                     and node.num_partitions() == 1):
                 bad(name, "it gathers all partitions into one, but "
                     "each executor holds only its slice")
             if (has_exchange(c) and not isinstance(
                     node, TpuIciShuffleExchangeExec)
+                    and not gather_ok
                     and node.num_partitions() != c.num_partitions()):
                 bad(name, "it re-groups partitions above a collective "
                     "exchange, breaking local-partition ownership")

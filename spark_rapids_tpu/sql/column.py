@@ -133,6 +133,12 @@ class Column:
     def between(self, low, high) -> "Column":
         return (self >= low) & (self <= high)
 
+    def getField(self, name: str) -> "Column":
+        """Struct field access — rewritten to the flattened physical
+        column by the DataFrame layer (structs are stored
+        struct-of-arrays)."""
+        return Column(UExpr("getfield", name, (self._u,)))
+
     def isin(self, *values) -> "Column":
         """Membership test [REF: Spark Column.isin / catalyst In] —
         lowered as an OR chain of equalities, which XLA fuses into one

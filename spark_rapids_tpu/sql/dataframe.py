@@ -53,10 +53,68 @@ def _to_column(c: Union[str, Column]) -> Column:
     return _col(c) if isinstance(c, str) else c
 
 
+class StructSpec:
+    """One logical STRUCT column, physically stored flattened
+    (struct-of-arrays — the TPU-native layout; arrow stores structs the
+    same way).  ``fields``: [(field name, physical column name)];
+    ``null_col``: physical bool column marking null structs (absent when
+    the struct column has no nulls).
+    [REF: complexTypeCreator.scala / cuDF struct columns — here structs
+    are a FRONTEND view; every kernel sees plain columns]"""
+
+    __slots__ = ("fields", "null_col")
+
+    def __init__(self, fields, null_col=None):
+        self.fields = list(fields)
+        self.null_col = null_col
+
+    @property
+    def phys_cols(self):
+        out = [p for _, p in self.fields]
+        if self.null_col:
+            out.append(self.null_col)
+        return out
+
+    def renamed(self, new_name: str) -> "StructSpec":
+        return StructSpec(
+            [(f, f"{new_name}.{f}") for f, _ in self.fields],
+            f"{new_name}#null" if self.null_col else None)
+
+
 class DataFrame:
-    def __init__(self, session, plan: L.LogicalPlan):
+    def __init__(self, session, plan: L.LogicalPlan, structs=None):
         self.session = session
         self._plan = plan
+        # logical struct columns over the flattened physical schema
+        self._structs: dict = dict(structs or {})
+
+    def _derive(self, plan: L.LogicalPlan,
+                structs="inherit") -> "DataFrame":
+        """New frame over ``plan``; struct specs propagate when every
+        physical column survived (schema-preserving ops), else pass the
+        recomputed specs explicitly."""
+        if structs == "inherit":
+            names = set(plan.schema.field_names())
+            structs = {k: v for k, v in self._structs.items()
+                       if all(p in names for p in v.phys_cols)}
+        return DataFrame(self.session, plan, structs)
+
+    # every transformation body below constructs through this (a plain
+    # textual stand-in for `DataFrame(self.session, ...)` that keeps
+    # struct specs flowing)
+    _derive_ctor = _derive
+
+    @staticmethod
+    def _adopt_structs(out: "DataFrame", other: "DataFrame"
+                       ) -> "DataFrame":
+        """Merge the right join side's struct specs into the result
+        (kept only when every physical column survived)."""
+        names = set(out.schema.field_names())
+        for k, v in other._structs.items():
+            if k not in out._structs and all(p in names
+                                             for p in v.phys_cols):
+                out._structs[k] = v
+        return out
 
     # -- metadata -----------------------------------------------------------
     @property
@@ -66,6 +124,17 @@ class DataFrame:
     @property
     def columns(self) -> List[str]:
         return self.schema.field_names()
+
+    def _expand_struct_names(self, cols):
+        """Replace bare struct-column names with their physical columns
+        (null flag included — null structs group/sort as one value)."""
+        out = []
+        for c in cols:
+            if isinstance(c, str) and c in self._structs:
+                out.extend(self._structs[c].phys_cols)
+            else:
+                out.append(c)
+        return out
 
     # -- transformations ----------------------------------------------------
     def select(self, *cols) -> "DataFrame":
@@ -84,22 +153,95 @@ class DataFrame:
         if any(self._window_u(c) is not None for c in cols
                if not (isinstance(c, str) and c == "*")):
             return self._select_with_windows(cols)
+        from spark_rapids_tpu.ops.expressions import BoundReference
         exprs = []
         fields = []
+        new_structs = {}
+
+        def add_ref(name):
+            i = self.schema.field_index(name)
+            f = self.schema.fields[i]
+            exprs.append(BoundReference(i, f.dtype, f.nullable))
+            fields.append(f)
+
         for c in cols:
             if isinstance(c, str) and c == "*":
                 for i, f in enumerate(self.schema.fields):
-                    from spark_rapids_tpu.ops.expressions import BoundReference
                     exprs.append(BoundReference(i, f.dtype, f.nullable))
                     fields.append(f)
                 continue
+            if isinstance(c, str) and c in self._structs:
+                # selecting a struct column = selecting its flattened
+                # physical columns; the spec rides along
+                spec = self._structs[c]
+                for p in spec.phys_cols:
+                    add_ref(p)
+                new_structs[c] = spec
+                continue
             u = _to_column(c)._u
-            e = AN.resolve(u, self.schema)
+            if (u.op == "alias" and u.children[0].op == "attr"
+                    and u.children[0].payload in self._structs):
+                # struct rename: re-emit the physical columns under the
+                # new name's flattened layout
+                spec = self._structs[u.children[0].payload]
+                new = spec.renamed(u.payload)
+                for (_, old_p), (_, new_p) in zip(spec.fields,
+                                                  new.fields):
+                    i = self.schema.field_index(old_p)
+                    f = self.schema.fields[i]
+                    exprs.append(BoundReference(i, f.dtype, f.nullable))
+                    fields.append(T.StructField(new_p, f.dtype,
+                                                f.nullable))
+                if spec.null_col:
+                    i = self.schema.field_index(spec.null_col)
+                    f = self.schema.fields[i]
+                    exprs.append(BoundReference(i, f.dtype, f.nullable))
+                    fields.append(T.StructField(new.null_col, f.dtype,
+                                                f.nullable))
+                new_structs[u.payload] = new
+                continue
+            mk = u.children[0] if u.op == "alias" else u
+            if mk.op == "make_struct":
+                # F.struct(...): emit one physical column per field +
+                # record the spec [REF: complexTypeCreator CreateStruct]
+                sname = (u.payload if u.op == "alias"
+                         else f"struct_{len(new_structs)}")
+                sfields = []
+                for fname, fu in zip(mk.payload, mk.children):
+                    e = AN.resolve(fu, self.schema)
+                    pname = f"{sname}.{fname}"
+                    exprs.append(e)
+                    fields.append(T.StructField(pname, e.dtype))
+                    sfields.append((fname, pname))
+                new_structs[sname] = StructSpec(sfields, None)
+                continue
+            u2 = self._rewrite_struct_ref(u)
+            e = AN.resolve(u2, self.schema)
             name = self._output_name(u, e)
             exprs.append(e)
             fields.append(T.StructField(name, e.dtype))
         schema = T.StructType(tuple(fields))
-        return DataFrame(self.session, L.Project(self._plan, exprs, schema))
+        out = self._derive_ctor(L.Project(self._plan, exprs, schema))
+        out._structs.update(new_structs)
+        return out
+
+    def _rewrite_struct_ref(self, u: UExpr) -> UExpr:
+        """col('s') for a logical struct has no physical column; rewrite
+        getField chains to the flattened name ('s'.getField('a') →
+        attr 's.a')."""
+        if u.op == "getfield":
+            child = self._rewrite_struct_ref(u.children[0])
+            if child.op == "attr":
+                return UExpr("attr", f"{child.payload}.{u.payload}")
+            raise AN.AnalysisException(
+                "getField is only supported on (nested) column "
+                "references")
+        if not u.children:
+            return u
+        kids = tuple(self._rewrite_struct_ref(c) for c in u.children)
+        if all(a is b for a, b in zip(kids, u.children)):
+            return u
+        return UExpr(u.op, u.payload, kids)
 
     @staticmethod
     def _generate_u(c) -> Optional[UExpr]:
@@ -160,7 +302,7 @@ class DataFrame:
             e = AN.resolve(u, ext_schema)
             exprs.append(e)
             fields.append(T.StructField(self._output_name(u, e), e.dtype))
-        return DataFrame(self.session, L.Project(
+        return self._derive_ctor( L.Project(
             plan, exprs, T.StructType(tuple(fields))))
 
     @staticmethod
@@ -241,7 +383,7 @@ class DataFrame:
                 _, i, name, dt = spec
                 exprs.append(BoundReference(nc + i, dt, True))
                 fields.append(T.StructField(name, dt, True))
-        return DataFrame(self.session, L.Project(
+        return self._derive_ctor( L.Project(
             plan, exprs, T.StructType(tuple(fields))))
 
     def mapInPandas(self, fn, schema) -> "DataFrame":
@@ -250,7 +392,7 @@ class DataFrame:
         if not isinstance(schema, T.StructType):
             raise AN.AnalysisException(
                 "mapInPandas needs a StructType output schema")
-        return DataFrame(self.session,
+        return self._derive_ctor(
                          L.MapInPandas(self._plan, fn, schema))
 
     @staticmethod
@@ -324,7 +466,7 @@ class DataFrame:
                 idx = offsets[skey] + j
                 exprs.append(BoundReference(idx, dtype, True))
                 fields.append(T.StructField(name, dtype))
-        return DataFrame(self.session, L.Project(
+        return self._derive_ctor( L.Project(
             plan, exprs, T.StructType(tuple(fields))))
 
     @staticmethod
@@ -335,40 +477,59 @@ class DataFrame:
             return u.payload
         return str(e)
 
+    def _logical_columns(self) -> List[str]:
+        """Column names as the user sees them: struct fields collapse to
+        the struct name (positioned at its first physical field)."""
+        out = []
+        phys_to_struct = {}
+        for sname, spec in self._structs.items():
+            for p in spec.phys_cols:
+                phys_to_struct[p] = sname
+        seen = set()
+        for n in self.columns:
+            sname = phys_to_struct.get(n)
+            if sname is None:
+                out.append(n)
+            elif sname not in seen:
+                seen.add(sname)
+                out.append(sname)
+        return out
+
     def withColumn(self, name: str, c: Column) -> "DataFrame":
-        if name in self.columns:  # replace in place (pyspark semantics)
-            cols = [(c.alias(name) if n == name else _col(n))
-                    for n in self.columns]
-            return self.select(*cols)
-        return self.select(*[_col(n) for n in self.columns], c.alias(name))
+        cols = self._logical_columns()
+        if name in cols:  # replace in place (pyspark semantics)
+            return self.select(*[(c.alias(name) if n == name else n)
+                                 for n in cols])
+        return self.select(*cols, c.alias(name))
 
     def withColumnRenamed(self, old: str, new: str) -> "DataFrame":
-        cols = [(_col(n).alias(new) if n == old else _col(n))
-                for n in self.columns]
+        cols = [(_col(n).alias(new) if n == old else n)
+                for n in self._logical_columns()]
         return self.select(*cols)
 
     def drop(self, *names) -> "DataFrame":
-        keep = [n for n in self.columns if n not in names]
+        keep = [n for n in self._logical_columns() if n not in names]
         return self.select(*keep)
 
     def filter(self, condition: Union[str, Column]) -> "DataFrame":
         if isinstance(condition, str):
             raise NotImplementedError("SQL-string filters not yet supported")
-        cond = AN.resolve(condition._u, self.schema)
+        cond = AN.resolve(self._rewrite_struct_ref(condition._u),
+                          self.schema)
         if not isinstance(cond.dtype, (T.BooleanType, T.NullType)):
             raise AN.AnalysisException(
                 f"filter condition must be boolean, got {cond.dtype}")
-        return DataFrame(self.session, L.Filter(self._plan, cond))
+        return self._derive_ctor( L.Filter(self._plan, cond))
 
     where = filter
 
     def limit(self, n: int) -> "DataFrame":
-        return DataFrame(self.session, L.Limit(self._plan, n))
+        return self._derive_ctor( L.Limit(self._plan, n))
 
     def union(self, other: "DataFrame") -> "DataFrame":
         if len(other.schema) != len(self.schema):
             raise AN.AnalysisException("union: column count mismatch")
-        return DataFrame(self.session, L.Union([self._plan, other._plan]))
+        return self._derive_ctor( L.Union([self._plan, other._plan]))
 
     unionAll = union
 
@@ -395,19 +556,19 @@ class DataFrame:
         if seed is None:
             import random
             seed = random.randint(0, 2**31 - 1)
-        return DataFrame(self.session,
+        return self._derive_ctor(
                          L.Sample(self._plan, float(fraction), int(seed)))
 
     def repartition(self, num: int, *cols) -> "DataFrame":
         keys = [AN.resolve(_to_column(c)._u, self.schema) for c in cols] or None
-        return DataFrame(self.session,
+        return self._derive_ctor(
                          L.Repartition(self._plan, num, keys))
 
     def groupBy(self, *cols) -> "GroupedData":
         exprs = []
         names = []
-        for c in cols:
-            u = _to_column(c)._u
+        for c in self._expand_struct_names(cols):
+            u = self._rewrite_struct_ref(_to_column(c)._u)
             e = AN.resolve(u, self.schema)
             exprs.append(e)
             names.append(self._output_name(u, e))
@@ -437,22 +598,34 @@ class DataFrame:
         return GroupedData(self, [], []).agg(*aggs)
 
     def orderBy(self, *cols, ascending=None) -> "DataFrame":
-        orders = []
+        # ``ascending`` aligns with the USER's argument list; struct
+        # expansion happens after, each field inheriting its struct's
+        # direction (Spark orders structs field-lexicographically)
+        pairs = []
         for i, c in enumerate(cols):
-            u = _to_column(c)._u
+            a = (None if ascending is None
+                 else (ascending[i] if isinstance(ascending, (list, tuple))
+                       else bool(ascending)))
+            if isinstance(c, str) and c in self._structs:
+                pairs.extend((p, a) for p in
+                             self._structs[c].phys_cols)
+            else:
+                pairs.append((c, a))
+        orders = []
+        for c, a in pairs:
+            u = self._rewrite_struct_ref(_to_column(c)._u)
             asc, nulls_first = True, True
             if u.op == "sortorder":
                 direction, nulls = u.payload
                 asc = direction == "asc"
                 nulls_first = nulls == "nulls_first"
                 u = u.children[0]
-            if ascending is not None:
-                asc = (ascending[i] if isinstance(ascending, (list, tuple))
-                       else bool(ascending))
+            if a is not None:
+                asc = a
                 nulls_first = asc
             e = AN.resolve(u, self.schema)
             orders.append(L.SortOrder(e, asc, nulls_first))
-        return DataFrame(self.session, L.Sort(self._plan, orders))
+        return self._derive_ctor( L.Sort(self._plan, orders))
 
     sort = orderBy
 
@@ -500,9 +673,10 @@ class DataFrame:
                         nullable = f.nullable or how in ("left", "full")
                         fields.append(T.StructField(f.name, f.dtype, nullable))
         schema = T.StructType(tuple(fields))
-        return DataFrame(self.session, L.Join(
+        out = self._derive_ctor(L.Join(
             self._plan, other._plan, how, left_keys, right_keys, None,
             schema))
+        return self._adopt_structs(out, other)
 
     def crossJoin(self, other: "DataFrame") -> "DataFrame":
         return self.join(other, on=[], how="cross")
@@ -568,9 +742,10 @@ class DataFrame:
             for f in other.schema.fields:
                 nullable = f.nullable or how in ("left", "full")
                 fields.append(T.StructField(f.name, f.dtype, nullable))
-        return DataFrame(self.session, L.Join(
+        out = self._derive_ctor(L.Join(
             self._plan, other._plan, how, left_keys, right_keys, res,
             T.StructType(tuple(fields)), using=False))
+        return self._adopt_structs(out, other)
 
     # -- actions ------------------------------------------------------------
     def _execute_plan(self):
@@ -608,10 +783,53 @@ class DataFrame:
         with profile:
             tables = self._pump_partitions(plan, conf)
         if not tables:
-            return pa.table(
+            return self._reassemble_structs(pa.table(
                 {f.name: pa.array([], type=T.to_arrow(f.dtype))
-                 for f in self.schema.fields})
-        return pa.concat_tables(tables)
+                 for f in self.schema.fields}))
+        return self._reassemble_structs(pa.concat_tables(tables))
+
+    def _reassemble_structs(self, t: pa.Table) -> pa.Table:
+        """Physical flattened columns → logical arrow struct columns
+        (the inverse of session._decompose_structs)."""
+        if not self._structs:
+            return t
+        for sname, spec in self._structs.items():
+            names = t.column_names
+            if not all(p in names for _, p in spec.fields):
+                continue
+            def one_chunk(c):
+                if isinstance(c, pa.ChunkedArray):
+                    if c.num_chunks == 0:
+                        return pa.array([], type=c.type)
+                    return pa.concat_arrays(c.chunks)
+                return c
+
+            children = [one_chunk(t.column(p)) for _, p in spec.fields]
+            mask = None
+            if spec.null_col and spec.null_col in names:
+                mask = pa.array(
+                    one_chunk(t.column(spec.null_col)).to_pylist(),
+                    pa.bool_())
+            sa = pa.StructArray.from_arrays(
+                children, names=[f for f, _ in spec.fields], mask=mask)
+            pos = names.index(spec.fields[0][1])
+            drop = {p for _, p in spec.fields}
+            if spec.null_col:
+                drop.add(spec.null_col)
+            arrays, outnames = [], []
+            inserted = False
+            for n in names:
+                if n == spec.fields[0][1]:
+                    arrays.append(sa)
+                    outnames.append(sname)
+                    inserted = True
+                if n in drop:
+                    continue
+                arrays.append(t.column(n))
+                outnames.append(n)
+            assert inserted
+            t = pa.table(dict(zip(outnames, arrays)))
+        return t
 
     @staticmethod
     def _pump_partitions(plan, conf) -> List[pa.Table]:
@@ -748,7 +966,7 @@ class GroupedData:
         fields += [T.StructField(n, f.result_dtype)
                    for n, f in zip(names, fns)]
         schema = T.StructType(tuple(fields))
-        return DataFrame(self.df.session, L.Aggregate(
+        return self.df._derive(L.Aggregate(
             self.df._plan, self.grouping, fns, schema))
 
     def _agg_grouping_sets(self, fns, names) -> DataFrame:
@@ -797,7 +1015,7 @@ class GroupedData:
                   for i, g in enumerate(self.grouping)]
                  + [BoundReference(nk + 1 + i, f.result_dtype)
                     for i, f in enumerate(fns)])
-        return DataFrame(self.df.session, L.Project(
+        return self.df._derive(L.Project(
             agg, exprs, T.StructType(tuple(out_fields))))
 
     def _agg_distinct(self, fns, names) -> DataFrame:
@@ -833,7 +1051,7 @@ class GroupedData:
                   for n, g in zip(self.names, self.grouping)]
         fields.append(T.StructField(names[0], T.LongT))
         schema = T.StructType(tuple(fields))
-        return DataFrame(self.df.session, L.Aggregate(
+        return self.df._derive(L.Aggregate(
             inner, outer_grouping, [outer_fn], schema))
 
     def count(self) -> DataFrame:

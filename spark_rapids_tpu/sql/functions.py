@@ -93,6 +93,35 @@ def xxhash64(*cols) -> Column:
     return Column(UExpr("xxhash64", None, tuple(_cu(c) for c in cols)))
 
 
+def struct(*cols) -> Column:
+    """Create a STRUCT column [REF: complexTypeCreator CreateStruct].
+    Physically lowered to one flattened column per field (the
+    struct-of-arrays layout every kernel already speaks)."""
+    names = []
+    kids = []
+    for i, c in enumerate(cols):
+        if isinstance(c, str):
+            names.append(c.split(".")[-1])
+            kids.append(UExpr("attr", c))
+            continue
+        u = _cu(c)
+        if u.op == "alias":
+            names.append(u.payload)
+        elif u.op == "attr":
+            names.append(str(u.payload).split(".")[-1])
+        else:
+            names.append(f"col{i + 1}")
+        kids.append(u.children[0] if u.op == "alias" else u)
+    return Column(UExpr("make_struct", tuple(names), tuple(kids)))
+
+
+def get_json_object(c, path: str) -> Column:
+    """Extract a JSON path from a JSON string column (host-evaluated;
+    the subtree reports NOT_ON_TPU until the device JSON scanner
+    lands)."""
+    return Column(UExpr("get_json_object", path, (_cu(c),)))
+
+
 def rlike(c, pattern: str) -> Column:
     return Column(UExpr("rlike", pattern, (_cu(c),)))
 

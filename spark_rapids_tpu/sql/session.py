@@ -55,6 +55,48 @@ class TpuSessionBuilder:
         return TpuSession(self._conf)
 
 
+def _decompose_structs(table: pa.Table):
+    """Flatten arrow STRUCT columns into per-field physical columns
+    ('s.a', 's.b' [+ 's#null' when the struct has nulls]) — the engine's
+    struct-of-arrays data model; the DataFrame layer keeps the logical
+    view and toArrow reassembles [REF: cuDF struct columns /
+    complexTypeCreator — here structs never reach a kernel at all]."""
+    if not any(pa.types.is_struct(f.type) for f in table.schema):
+        return table, {}
+    from spark_rapids_tpu.sql.dataframe import StructSpec
+    arrays, names = [], []
+    structs: Dict[str, object] = {}
+    for name in table.column_names:
+        col = table.column(name)
+        t = col.type
+        if not pa.types.is_struct(t):
+            arrays.append(col)
+            names.append(name)
+            continue
+        if any(pa.types.is_struct(t.field(i).type)
+               or pa.types.is_map(t.field(i).type)
+               for i in range(t.num_fields)):
+            raise NotImplementedError(
+                f"struct column {name!r}: nested struct/map fields are "
+                "not supported yet (one level of struct nesting)")
+        arr = col.combine_chunks()
+        null_col = None
+        if arr.null_count > 0:
+            null_col = f"{name}#null"
+            arrays.append(pa.chunked_array([arr.is_null()]))
+            names.append(null_col)
+        flat = arr.flatten()  # parent nulls applied to children
+        fields = []
+        for i in range(t.num_fields):
+            f = t.field(i)
+            pname = f"{name}.{f.name}"
+            arrays.append(flat[i])
+            names.append(pname)
+            fields.append((f.name, pname))
+        structs[name] = StructSpec(fields, null_col)
+    return pa.table(dict(zip(names, arrays))), structs
+
+
 def _infer_arrow_type(values: List[Any]) -> pa.DataType:
     """Scan ALL values (pyspark-style): int → int64 (LongType), numeric
     int/float mixes promote to float64."""
@@ -112,11 +154,13 @@ class TpuSession:
         from spark_rapids_tpu.sql.dataframe import DataFrame
 
         table = self._to_arrow(data, schema)
+        table, structs = _decompose_structs(table)
         st = T.StructType(tuple(
             T.StructField(n, T.from_arrow(table.schema.field(n).type))
             for n in table.column_names))
         nparts = int(self.conf.get("spark.default.parallelism", 1))
-        return DataFrame(self, InMemoryRelation(table, st, nparts))
+        return DataFrame(self, InMemoryRelation(table, st, nparts),
+                         structs)
 
     def _to_arrow(self, data, schema) -> pa.Table:
         if isinstance(data, pa.Table):

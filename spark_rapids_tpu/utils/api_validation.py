@@ -49,7 +49,8 @@ FUNCTIONS_API = [
     "first", "sqrt", "exp", "log", "abs", "floor", "ceil", "round",
     "pow", "coalesce", "when", "concat", "substring", "upper", "lower",
     "length", "trim", "ltrim", "rtrim", "replace", "instr", "locate",
-    "split", "reverse", "lpad", "rpad", "rlike", "regexp_extract",
+    "split", "reverse", "lpad", "rpad", "rlike", "get_json_object",
+    "regexp_extract",
     "regexp_replace", "hash", "xxhash64", "year", "month", "dayofmonth",
     "date_add", "date_sub", "datediff", "from_utc_timestamp",
     "to_utc_timestamp", "var_samp", "var_pop", "stddev_samp",

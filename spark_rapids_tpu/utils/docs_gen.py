@@ -46,12 +46,25 @@ def generate_supported_ops_md() -> str:
             continue
         seen.add(rule.name)
         lines.append(f"| {rule.name} | {rule.desc} |")
+    # short column headers for the per-type support matrix
+    sig_cols = [("boolean", "BOOL"), ("byte", "B"), ("short", "SH"),
+                ("int", "I"), ("long", "L"), ("float", "F"),
+                ("double", "D"), ("decimal", "DEC"), ("string", "STR"),
+                ("binary", "BIN"), ("date", "DATE"), ("timestamp", "TS"),
+                ("null", "NULL"), ("array", "ARR"), ("map", "MAP"),
+                ("struct", "STCT")]
     lines += [
         "",
         "## Expressions",
         "",
-        "| Expression | Notes |",
-        "|---|---|",
+        "Per-type INPUT support (the declared TypeSig, checked during "
+        "plan tagging): S = the device lowering accepts that input "
+        "type, blank = CPU fallback.  `→` lists the result types when "
+        "narrower than the inputs.",
+        "",
+        "| Expression | " + " | ".join(h for _, h in sig_cols)
+        + " | Notes |",
+        "|---|" + "---|" * len(sig_cols) + "---|",
     ]
     mods = (E, S, D, HH)
     rows = []
@@ -73,9 +86,16 @@ def generate_supported_ops_md() -> str:
                     "`spark.rapids.sql.incompatibleOps.enabled=true`")
             if getattr(cls, "ansi_sensitive", False):
                 notes.append("falls back under `spark.sql.ansi.enabled`")
-            rows.append((name, "; ".join(notes)))
-    for name, notes in sorted(set(rows)):
-        lines.append(f"| {name} | {notes} |")
+            in_sig = (cls.input_sig if cls.input_sig is not None
+                      else cls.type_sig)
+            if cls.type_sig != in_sig:
+                notes.insert(0, "→ " + ", ".join(sorted(
+                    cls.type_sig)))
+            cells = " | ".join("S" if tag in in_sig else ""
+                               for tag, _ in sig_cols)
+            rows.append((name, cells, "; ".join(notes)))
+    for name, cells, notes in sorted(set(rows)):
+        lines.append(f"| {name} | {cells} | {notes} |")
     lines += [
         "",
         "## Aggregate functions",

@@ -196,16 +196,37 @@ def test_iceberg_group_by_partition_oracle(tmp_path):
         ignore_order=True)
 
 
-def test_iceberg_delete_files_gated(tmp_path):
+def test_iceberg_position_deletes_read(tmp_path):
+    """Round-5: v2 position-delete files apply as scan-time row masks
+    [REF: iceberg spec Position Delete Files / GpuDeleteFilter]."""
+    d = str(tmp_path / "ice")
+    os.makedirs(os.path.join(d, "data"))
+    f1 = _data_file(d, "f1.parquet", [1, 2, 3, 4], [1.0, 2.0, 3.0, 4.0])
+    f2 = _data_file(d, "f2.parquet", [5, 6], [5.0, 6.0])
+    delp = os.path.join(d, "data", "del1.parquet")
+    pq.write_table(pa.table({
+        "file_path": pa.array([f1, f1, f2], type=pa.string()),
+        "pos": pa.array([0, 2, 1], type=pa.int64()),
+    }), delp)
+    dentry = {"status": 1, "data_file": {
+        "content": 1, "file_path": delp, "file_format": "PARQUET",
+        "partition": {"part": None}, "record_count": 3}}
+    _make_iceberg(tmp_path, [_entry(f1, 1), _entry(f2, 2), dentry])
+    s = tpu_session()
+    out = s.read.iceberg(d).orderBy("id").toArrow()
+    assert out.column("id").to_pylist() == [2, 4, 5]
+
+
+def test_iceberg_equality_deletes_gated(tmp_path):
     d = str(tmp_path / "ice")
     os.makedirs(os.path.join(d, "data"))
     f1 = _data_file(d, "f1.parquet", [1], [1.0])
     bad = {"status": 1, "data_file": {
-        "content": 1, "file_path": f1, "file_format": "PARQUET",
+        "content": 2, "file_path": f1, "file_format": "PARQUET",
         "partition": {"part": None}, "record_count": 1}}
     _make_iceberg(tmp_path, [bad])
     s = tpu_session()
-    with pytest.raises(IcebergProtocolError, match="delete files"):
+    with pytest.raises(IcebergProtocolError, match="EQUALITY"):
         s.read.iceberg(d).toArrow()
 
 

@@ -156,19 +156,60 @@ def test_delta_checkpoint(tmp_path):
     assert out.column("id").to_pylist() == [1, 2]
 
 
-def test_delta_deletion_vector_gated(tmp_path):
+def test_delta_deletion_vector_file_read(tmp_path):
+    """Round-5: deletion vectors apply as a scan-time row mask
+    [REF: PROTOCOL.md Deletion Vectors / GpuDeltaParquetFileFormat]."""
+    from spark_rapids_tpu.io.deletion_vectors import write_dv_file
     d = str(tmp_path / "dv")
     log = os.path.join(d, "_delta_log")
     os.makedirs(log)
-    _write_part(d, "p.parquet", [1], [1.0])
+    _write_part(d, "p.parquet", [1, 2, 3, 4, 5, 6],
+                [1.0, 2.0, 3.0, 4.0, 5.0, 6.0])
+    desc = write_dv_file(os.path.join(d, "dv1.bin"), [1, 3, 5])
     _commit(log, 0, [_meta(),
                      {"add": {"path": "p.parquet", "partitionValues": {},
                               "size": 1, "modificationTime": 0,
                               "dataChange": True,
-                              "deletionVector": {"storageType": "u"}}}])
+                              "deletionVector": desc}}])
     s = tpu_session()
-    with pytest.raises(DeltaProtocolError, match="deletion vector"):
-        s.read.delta(d).toArrow()
+    out = s.read.delta(d).orderBy("id").toArrow()
+    assert out.column("id").to_pylist() == [1, 3, 5]
+
+
+def test_delta_deletion_vector_inline(tmp_path):
+    from spark_rapids_tpu.io.deletion_vectors import (
+        serialize_bitmap_array, z85_encode)
+    d = str(tmp_path / "dvi")
+    log = os.path.join(d, "_delta_log")
+    os.makedirs(log)
+    _write_part(d, "p.parquet", [10, 20, 30], [1.0, 2.0, 3.0])
+    blob = serialize_bitmap_array([0, 2])
+    pad = (-len(blob)) % 4
+    desc = {"storageType": "i",
+            "pathOrInlineDv": z85_encode(blob + b"\0" * pad),
+            "sizeInBytes": len(blob), "cardinality": 2}
+    _commit(log, 0, [_meta(),
+                     {"add": {"path": "p.parquet", "partitionValues": {},
+                              "size": 1, "modificationTime": 0,
+                              "dataChange": True,
+                              "deletionVector": desc}}])
+    s = tpu_session()
+    out = s.read.delta(d).toArrow()
+    assert out.column("id").to_pylist() == [20]
+
+
+def test_deletion_vector_bitmap_round_trip():
+    import numpy as np
+    from spark_rapids_tpu.io.deletion_vectors import (
+        parse_bitmap_array, serialize_bitmap_array)
+    rng = np.random.default_rng(2)
+    # spans array + bitmap containers, two high buckets, 16-bit keys
+    pos = sorted(set(
+        rng.integers(0, 5000, 300).tolist()
+        + rng.integers(1 << 33, (1 << 33) + 70_000, 6000).tolist()
+        + [0, 65535, 65536, (1 << 40)]))
+    got = parse_bitmap_array(serialize_bitmap_array(pos))
+    assert got.tolist() == pos
 
 
 def test_delta_schema_evolution_null_fills(tmp_path):

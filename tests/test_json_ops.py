@@ -1,0 +1,75 @@
+"""get_json_object — Spark path semantics, malformed-input nulls.
+
+[REF: integration_tests json_test.py get_json_object cases]
+Host-evaluated phase 1: the subtree reports NOT_ON_TPU (allow_non_tpu)
+until the device JSON scanner lands.
+"""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+DOCS = [
+    '{"a": 1, "b": {"c": "x"}, "d": [1, 2, 3]}',
+    '{"a": "str", "b": {}, "d": []}',
+    '{"a": null}',
+    'not json at all',
+    '',
+    None,
+    '{"b": {"c": {"deep": true}}}',
+    '[{"a": 10}, {"a": 20}]',
+]
+
+
+def _t():
+    return pa.table({"j": pa.array(DOCS, pa.string())})
+
+
+@pytest.mark.parametrize("path", [
+    "$.a", "$.b.c", "$.d[1]", "$.missing", "$['b']['c']", "$[0].a",
+])
+def test_get_json_object_paths(path):
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(_t()).select(
+            F.get_json_object(col("j"), path).alias("r")),
+        allow_non_tpu=["Project", "InMemoryScan"])
+
+
+def test_get_json_object_semantics():
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    out = s.createDataFrame(_t()).select(
+        F.get_json_object(col("j"), "$.a").alias("a"),
+        F.get_json_object(col("j"), "$.b").alias("b"),
+        F.get_json_object(col("j"), "$.d").alias("d"),
+    ).toArrow()
+    a = out.column("a").to_pylist()
+    assert a[0] == "1"          # number serialized
+    assert a[1] == "str"        # string UNQUOTED
+    assert a[2] is None         # JSON null -> null
+    assert a[3] is None         # malformed -> null
+    assert a[5] is None         # null input -> null
+    assert out.column("b").to_pylist()[0] == '{"c":"x"}'  # compact obj
+    assert out.column("d").to_pylist()[0] == "[1,2,3]"
+
+
+def test_get_json_object_invalid_path_is_null():
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    out = s.createDataFrame(_t()).select(
+        F.get_json_object(col("j"), "a.b").alias("r")).toArrow()
+    assert out.column("r").to_pylist() == [None] * len(DOCS)
+
+
+def test_get_json_object_reports_fallback():
+    s = tpu_session({"spark.rapids.sql.test.enabled": False})
+    df = s.createDataFrame(_t()).select(
+        F.get_json_object(col("j"), "$.a").alias("r"))
+    df.toArrow()
+    fb = df.fallback_summary()
+    assert fb["fallback_ops"] >= 1
+    assert any("GetJsonObject" in r or "TPU implementation" in r
+               for r in fb["fallback_reasons"])
