@@ -1048,7 +1048,8 @@ def q6_sf(session, t):
 
 # ONE definition each for the breadth queries and their conf — the
 # subprocess child and the in-process oracle checks must measure the
-# same configuration
+# same configuration.  TPUQ_BENCH_CONF_JSON merges experiment overrides
+# into the conf (A/B tuning without editing the scoreboard's builders).
 TPCH_BUILDERS = {
     "q1": q1, "q2": q2, "q3": q3, "q4": q4, "q5": q5, "q6": q6_sf,
     "q7": q7, "q8": q8, "q9": q9, "q10": q10, "q11": q11, "q12": q12,
@@ -1057,6 +1058,8 @@ TPCH_BUILDERS = {
 }
 TPCH_SF1_CONF = {"spark.rapids.sql.enabled": True,
                  "spark.rapids.tpu.batchRows": 1 << 16}
+TPCH_SF1_CONF.update(json.loads(os.environ.get(
+    "TPUQ_BENCH_CONF_JSON", "{}")))
 
 
 def _sf1_query_main(name: str) -> None:
@@ -1071,6 +1074,28 @@ def _sf1_query_main(name: str) -> None:
     # the honest progress meter for operator breadth: how much of this
     # query's plan ran on device [REF: ExplainPlanImpl as a metric]
     print("TPCH_SF1_FALLBACK=" + json.dumps(dfq.fallback_summary()))
+    # per-op time breakdown of the LAST run — the profiling signal for
+    # the breadth-query tail (opTime accumulates across reps)
+    ops = []
+
+    def walk(nd):
+        ms = {k: m.value for k, m in getattr(nd, "metrics", {}).items()
+              if m.value}
+        t_any = max([v for k, v in ms.items() if k.endswith("Time")],
+                    default=0)
+        if t_any:
+            ops.append((round(float(t_any), 3), type(nd).__name__,
+                        {k: (round(v, 3) if isinstance(v, float) else v)
+                         for k, v in ms.items()}))
+        for c in nd.children:
+            walk(c)
+
+    try:
+        walk(dfq._last_plan)
+        ops.sort(key=lambda t: t[0], reverse=True)
+        print("TPCH_SF1_OPTIME=" + json.dumps(ops[:8]))
+    except Exception as e:  # diagnostics must never fail the run
+        print(f"TPCH_SF1_OPTIME_ERR={e}")
 
 
 def _sf1_query_subprocess(name: str, mark, budget_s: float):

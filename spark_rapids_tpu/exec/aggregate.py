@@ -905,36 +905,62 @@ class TpuHashAggregateExec(TpuExec):
         return self._reduce_merge_final(partials)
 
     def _coalesced(self, stream) -> Iterator[DeviceBatch]:
-        """Group input batches up to ``bucket_rows`` capacity before the
+        """Group input batches up to ``bucket_rows`` LIVE rows before the
         partial pass: each partial chain pays a fixed host-tunnel
         dispatch cost, so fewer/larger sorts win (the hash-capped key
-        encoding keeps sort operands flat as the bucket grows)."""
+        encoding keeps sort operands flat as the bucket grows).
+
+        Count pulls are WINDOWED: live counts for up to 32 batches come
+        back in ONE overlapped tunnel round trip and thread into the
+        concats — a per-concat pull costs a full ~40-90 ms round trip
+        and alone regressed TPC-H q1 3x."""
         cap = self.bucket_rows
         if not cap:
             yield from stream
             return
         from spark_rapids_tpu.columnar.column import compact
-        group: List[DeviceBatch] = []
-        acc = 0
+        from spark_rapids_tpu.exec.basic import _overlapped_live_counts
 
-        def emit():
-            if len(group) == 1:
-                return group[0]
-            with self.timer("concatTime"):
-                batches = [compact(b) for b in group]
-                return concat_device_batches(batches[0].schema, batches)
+        def flush(window) -> Iterator[DeviceBatch]:
+            if not window:
+                return
+            if len(window) == 1:
+                yield window[0]
+                return
+            counts = _overlapped_live_counts(window)  # one round trip
+            group: List[DeviceBatch] = []
+            gcounts: List[int] = []
+            acc = 0
+            for b, n in zip(window, counts):
+                if group and acc + n > cap:
+                    yield self._emit_group(group, gcounts, compact)
+                    group, gcounts, acc = [], [], 0
+                group.append(b)
+                gcounts.append(n)
+                acc += n
+            if group:
+                yield self._emit_group(group, gcounts, compact)
 
+        window: List[DeviceBatch] = []
+        wcap = 0
         for b in stream:
-            if b.capacity >= cap:
+            if b.capacity >= cap and not window:
                 yield b
                 continue
-            if group and acc + b.capacity > cap:
-                yield emit()
-                group, acc = [], 0
-            group.append(b)
-            acc += b.capacity
-        if group:
-            yield emit()
+            window.append(b)
+            wcap += b.capacity
+            if len(window) >= 32 or wcap >= 8 * cap:
+                yield from flush(window)
+                window, wcap = [], 0
+        yield from flush(window)
+
+    def _emit_group(self, group, gcounts, compact) -> DeviceBatch:
+        if len(group) == 1:
+            return group[0]
+        with self.timer("concatTime"):
+            batches = [compact(b) for b in group]
+            return concat_device_batches(batches[0].schema, batches,
+                                         counts=gcounts)
 
     def _decide_skip(self, outs1: List[DeviceBatch], n_in: int) -> bool:
         """Should later batches skip the per-batch reduction?
@@ -968,12 +994,13 @@ class TpuHashAggregateExec(TpuExec):
             with mgr.transient(b.nbytes()):
                 return self._partial(b, pre, pre_key)
 
-        n_in = (_overlapped_live_counts([first])[0]
-                if self.skip_ratio < 1.0 else 0)
-        outs1 = list(with_retry(
-            iter([first]), closure_partial,
-            max_attempts=mgr.retry_max_attempts, manager=mgr))
-        skip = self._decide_skip(outs1, n_in)
+        with self.timer("decideTime"):
+            n_in = (_overlapped_live_counts([first])[0]
+                    if self.skip_ratio < 1.0 else 0)
+            outs1 = list(with_retry(
+                iter([first]), closure_partial,
+                max_attempts=mgr.retry_max_attempts, manager=mgr))
+            skip = self._decide_skip(outs1, n_in)
         if skip:
             self.metric("skippedAggPasses").add(1)
 
@@ -983,9 +1010,10 @@ class TpuHashAggregateExec(TpuExec):
                     return self._update_raw(b, pre, pre_key)
                 return self._partial(b, pre, pre_key)
 
-        partials = outs1 + list(with_retry(
-            stream, closure, max_attempts=mgr.retry_max_attempts,
-            manager=mgr))
+        with self.timer("partialTime"):
+            partials = outs1 + list(with_retry(
+                stream, closure, max_attempts=mgr.retry_max_attempts,
+                manager=mgr))
         return partials, skip
 
     def _execute_grouped(self, src, pre, pre_key) -> List[DeviceBatch]:
@@ -1005,7 +1033,8 @@ class TpuHashAggregateExec(TpuExec):
             from spark_rapids_tpu.columnar.column import empty_batch
             partials = [self._partial(empty_batch(src.schema), pre,
                                       pre_key)]
-        return self._merge_bounded(partials, self._merge_final)
+        with self.timer("mergeTime"):
+            return self._merge_bounded(partials, self._merge_final)
 
     def _update_raw(self, batch: DeviceBatch, pre=None,
                     pre_key=()) -> DeviceBatch:

@@ -439,11 +439,14 @@ class TpuGlobalLimitExec(TpuExec):
             for p in owned_partitions(child):
                 if local >= self.n:
                     break
-                for b in child.execute(p):
-                    batches.append(b)
-                    local += _overlapped_live_counts([b])[0]
-                    if local >= self.n:
-                        break
+                # counts pulled ONE overlapped round trip per partition
+                # (a per-batch pull costs a full tunnel round trip);
+                # early termination still checked between partitions
+                part = list(child.execute(p))
+                if not part:
+                    continue
+                batches.extend(part)
+                local += sum(_overlapped_live_counts(part))
             replies = ctx.client.allgather(
                 self._stage + ":limit", min(local, self.n), ctx.timeout)
             before = sum(replies[:ctx.process_id])

@@ -482,15 +482,8 @@ class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
             cols = [np.concatenate([np.array(r[i], dtype=np.uint64)
                                     for r in replies])
                     for i in range(len(cols))]
-        n = len(cols[0])
-        if n == 0:
-            # degenerate: no live sample anywhere — any agreed
-            # boundaries are correct (rows all route to one partition)
-            return [np.zeros(self.nparts - 1, np.uint64) for _ in cols]
-        order = np.lexsort(list(reversed(cols)))
-        picks = [order[min(n - 1, (i + 1) * n // self.nparts)]
-                 for i in range(self.nparts - 1)]
-        return [c[picks] for c in cols]
+        from spark_rapids_tpu.exec.sort import pick_quantile_boundaries
+        return pick_quantile_boundaries(cols, self.nparts)
 
     def _aux_args(self, sharded) -> tuple:
         if self._bounds is None:

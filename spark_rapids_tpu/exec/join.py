@@ -489,8 +489,9 @@ class TpuSortMergeJoinExec(TpuExec):
             lpart = rpart = partition
         else:
             lpart = rpart = None
-        l_list = _gather_list(self.children[0], lpart)
-        r_list = _gather_list(self.children[1], rpart)
+        with self.timer("gatherTime"):
+            l_list = _gather_list(self.children[0], lpart)
+            r_list = _gather_list(self.children[1], rpart)
         nokey = jt == "cross" or not self.left_keys
         mgr = get_manager()
         total = (sum(b.nbytes() for b in l_list)
@@ -641,8 +642,9 @@ class TpuSortMergeJoinExec(TpuExec):
                 batches, lambda b, aux: pid_fn(b), k, mgr,
                 ("subpart", SUB_SEED, canon, fingerprint(keys)))
 
-        l_slices = split(l_list, self.left_keys)
-        r_slices = split(r_list, self.right_keys)
+        with self.timer("partitionTime"):
+            l_slices = split(l_list, self.left_keys)
+            r_slices = split(r_list, self.right_keys)
         for i in range(k):
             # inner/semi emit only matched left rows: an empty side means
             # an empty pair output (left/anti/full still must run to emit

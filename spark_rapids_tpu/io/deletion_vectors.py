@@ -147,14 +147,20 @@ def parse_bitmap_array(blob: bytes) -> np.ndarray:
 
 
 def dv_file_name(table_path: str, path_or_inline: str) -> str:
-    """'u' storage: pathOrInlineDv = z85([random prefix bytes +] 16-byte
-    UUID); file = <prefix>/deletion_vector_<uuid>.bin under the table."""
+    """'u' storage: pathOrInlineDv = <raw random prefix chars> + the
+    20-char z85 encoding of the 16-byte UUID (delta-spark splits with
+    dropRight(20)/takeRight(20) — the PREFIX is raw text, only the UUID
+    is encoded); file = <prefix>/deletion_vector_<uuid>.bin."""
     import uuid as _uuid
-    raw = z85_decode(path_or_inline)
-    prefix, uid = raw[:-16], raw[-16:]
+    if len(path_or_inline) < 20:
+        raise ValueError(
+            f"deletion vector path {path_or_inline!r} shorter than a "
+            "z85 UUID")
+    prefix = path_or_inline[:-20]
+    uid = z85_decode(path_or_inline[-20:])
     name = f"deletion_vector_{_uuid.UUID(bytes=uid)}.bin"
     if prefix:
-        return os.path.join(table_path, prefix.decode("ascii"), name)
+        return os.path.join(table_path, prefix, name)
     return os.path.join(table_path, name)
 
 

@@ -1468,3 +1468,54 @@ IsNaN.type_sig = SIG_BOOLEAN
 IsNaN.input_sig = SIG_FLOATING
 # column pass-through carries everything a batch can hold
 BoundReference.type_sig = SIG_ALL
+
+
+# ---------------------------------------------------------------------------
+# Columnar device UDF — the RapidsUDF hook, TPU-first
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DeviceUDF(Expression):
+    """User function over RAW column arrays, run INSIDE the fused XLA
+    program [REF: spark-rapids RapidsUDF — there a JNI hook handing the
+    user cuDF columns; here the user writes jax and XLA fuses it with
+    the surrounding expression tree, which is strictly stronger: no
+    kernel-launch boundary at all].
+
+    Contract: ``fn(*arrays) -> array`` must be pure, shape-preserving
+    jax (traceable; no host syncs); nulls propagate as the intersection
+    of input validities (Spark null-safe semantics); numeric/boolean/
+    datetime columns only (strings ride byte matrices whose layout is
+    not a stable public surface yet)."""
+
+    fn: object
+    args: Tuple[Expression, ...]
+    dtype: T.DataType
+    fname: str = "device_udf"
+
+    type_sig = SIG_ALL_SCALAR - SIG_STRINGY | frozenset({"null"})
+    input_sig = SIG_ALL_SCALAR - SIG_STRINGY | frozenset({"null"})
+
+    @property
+    def children(self):
+        return tuple(self.args)
+
+    def eval_tpu(self, batch: DeviceBatch) -> DeviceColumn:
+        cols = [a.eval_tpu(batch) for a in self.args]
+        out = self.fn(*[c.data for c in cols])
+        out = jnp.asarray(out).astype(T.to_numpy_dtype(self.dtype))
+        validity = merge_validity_d(*[c.validity for c in cols])
+        return DeviceColumn(self.dtype, out, validity)
+
+    def eval_cpu(self, batch: HostBatch) -> HostCol:
+        # the same jax fn runs on host arrays (jax.numpy accepts numpy;
+        # on the CPU backend this IS the oracle of the device run)
+        cols = [a.eval_cpu(batch) for a in self.args]
+        out = np.asarray(self.fn(*[c.data for c in cols])).astype(
+            T.to_numpy_dtype(self.dtype))
+        validity = merge_validity_h(*[c.validity for c in cols])
+        return HostCol(self.dtype, out, validity)
+
+    def __str__(self):
+        args = ", ".join(str(a) for a in self.args)
+        return f"{self.fname}({args})"

@@ -365,6 +365,17 @@ def resolve(u: UExpr, schema: T.StructType) -> E.Expression:
         return XxHash64([resolve(c, schema) for c in u.children])
     if op == "input_file_name":
         return E.InputFileName()
+    if op == "device_udf":
+        fn, dt, name = u.payload
+        args = tuple(resolve(c, schema) for c in u.children)
+        for a in args:
+            if isinstance(a.dtype, (T.StringType, T.BinaryType)):
+                raise AnalysisException(
+                    "device_udf arguments must be numeric/boolean/"
+                    f"datetime columns, got {a.dtype.simple_name} "
+                    "(string byte-matrix layout is not a stable UDF "
+                    "surface)")
+        return E.DeviceUDF(fn, args, dt, name)
     if op == "pyudf":
         raise AnalysisException(
             "python UDFs are only supported as top-level select "

@@ -521,9 +521,9 @@ def insert_coalesce(node: ExecNode, conf: RapidsConf) -> ExecNode:
                      // _estimated_row_bytes(node.schema),
                      conf.min_bucket_rows)
         # row-capped at batchRows: static-shape kernels compile per
-        # pow-2 bucket, so an unbounded byte target (512 MB / 8-byte
-        # rows = a 64M-row bucket) would hand downstream operators a
-        # bucket the batch-size knob was set to avoid
+        # pow-2 bucket, and batchRows is THE documented bound on bucket
+        # size — an unbounded byte target (512 MB / 8-byte rows = a
+        # 64M-row bucket) must never override it
         target = min(target, conf.batch_rows)
         return TpuCoalesceBatchesExec(node, target_rows=target)
     if isinstance(node, (TpuSortExec, TpuWindowExec)):

@@ -125,13 +125,25 @@ class DataFrame:
     def columns(self) -> List[str]:
         return self.schema.field_names()
 
+    def _struct_name_of(self, c) -> Optional[str]:
+        """The struct-column name ``c`` denotes (bare string or a plain
+        ``col('s')`` reference), else None."""
+        if isinstance(c, str):
+            return c if c in self._structs else None
+        if isinstance(c, Column) and c._u.op == "attr" \
+                and c._u.payload in self._structs:
+            return c._u.payload
+        return None
+
     def _expand_struct_names(self, cols):
-        """Replace bare struct-column names with their physical columns
-        (null flag included — null structs group/sort as one value)."""
+        """Replace bare struct-column names/refs with their physical
+        columns (null flag included — null structs group/sort as one
+        value)."""
         out = []
         for c in cols:
-            if isinstance(c, str) and c in self._structs:
-                out.extend(self._structs[c].phys_cols)
+            sname = self._struct_name_of(c)
+            if sname is not None:
+                out.extend(self._structs[sname].phys_cols)
             else:
                 out.append(c)
         return out
@@ -170,13 +182,14 @@ class DataFrame:
                     exprs.append(BoundReference(i, f.dtype, f.nullable))
                     fields.append(f)
                 continue
-            if isinstance(c, str) and c in self._structs:
+            sname = self._struct_name_of(c)
+            if sname is not None:
                 # selecting a struct column = selecting its flattened
                 # physical columns; the spec rides along
-                spec = self._structs[c]
+                spec = self._structs[sname]
                 for p in spec.phys_cols:
                     add_ref(p)
-                new_structs[c] = spec
+                new_structs[sname] = spec
                 continue
             u = _to_column(c)._u
             if (u.op == "alias" and u.children[0].op == "attr"
@@ -606,9 +619,10 @@ class DataFrame:
             a = (None if ascending is None
                  else (ascending[i] if isinstance(ascending, (list, tuple))
                        else bool(ascending)))
-            if isinstance(c, str) and c in self._structs:
+            sname = self._struct_name_of(c)
+            if sname is not None:
                 pairs.extend((p, a) for p in
-                             self._structs[c].phys_cols)
+                             self._structs[sname].phys_cols)
             else:
                 pairs.append((c, a))
         orders = []
@@ -945,8 +959,18 @@ class GroupedData:
         self.names = names
         self.sets = None  # grouping sets (rollup/cube); None = plain
 
+    @staticmethod
+    def _pandas_agg_u(a):
+        u = _to_column(a)._u
+        core = u.children[0] if u.op == "alias" else u
+        if core.op == "pyudf" and core.payload[2]:  # vectorized
+            return u, core
+        return None
+
     def agg(self, *aggs) -> DataFrame:
         from spark_rapids_tpu.ops.aggregates import CountDistinct
+        if any(self._pandas_agg_u(a) is not None for a in aggs):
+            return self._agg_in_pandas(aggs)
         fns = []
         names = []
         for a in aggs:
@@ -968,6 +992,62 @@ class GroupedData:
         schema = T.StructType(tuple(fields))
         return self.df._derive(L.Aggregate(
             self.df._plan, self.grouping, fns, schema))
+
+    def _agg_in_pandas(self, aggs) -> DataFrame:
+        """Grouped-aggregate pandas UDFs [REF: GpuAggregateInPandasExec]
+        — lowered onto the grouped-map bridge: each agg fn(*series) →
+        scalar runs per group inside one applyInPandas wrapper (device
+        co-partitioning and the arrow bridge come for free)."""
+        import pandas as pd
+        if self.sets is not None:
+            raise AN.AnalysisException(
+                "pandas-UDF aggregates under rollup/cube are not "
+                "supported")
+        if not self.names:
+            # global pandas-UDF aggregate: one row — lower by grouping
+            # on a constant key, then drop it
+            from spark_rapids_tpu.sql.functions import lit
+            return (self.df.withColumn("__g", lit(0))
+                    .groupBy("__g").agg(*aggs).drop("__g"))
+        child_names = set(self.df.schema.field_names())
+        for n in self.names:
+            if n not in child_names:
+                raise AN.AnalysisException(
+                    "pandas-UDF aggregates need plain column grouping "
+                    f"keys (got expression {n!r})")
+        specs = []
+        for i, a in enumerate(aggs):
+            got = self._pandas_agg_u(a)
+            if got is None:
+                raise AN.AnalysisException(
+                    "cannot mix pandas-UDF aggregates with built-in "
+                    "aggregate functions in one agg() — split into two "
+                    "aggregations and join")
+            u, core = got
+            fn, dt, _vec, fname = core.payload
+            out_name = u.payload if u.op == "alias" else f"{fname}_{i}"
+            arg_names = []
+            for cu in core.children:
+                if cu.op != "attr" or cu.payload not in child_names:
+                    raise AN.AnalysisException(
+                        "pandas-UDF aggregate arguments must be plain "
+                        "columns (pre-compute expressions with "
+                        "withColumn)")
+                arg_names.append(cu.payload)
+            specs.append((fn, dt, out_name, arg_names))
+        key_names = list(self.names)
+        by_name = {f.name: f for f in self.df.schema.fields}
+        fields = [T.StructField(n, by_name[n].dtype) for n in key_names]
+        fields += [T.StructField(n, dt) for _, dt, n, _ in specs]
+        schema = T.StructType(tuple(fields))
+
+        def wrapper(pdf):
+            row = {k: [pdf[k].iloc[0]] for k in key_names}
+            for fn, _dt, name, arg_names in specs:
+                row[name] = [fn(*[pdf[an] for an in arg_names])]
+            return pd.DataFrame(row)
+
+        return self.applyInPandas(wrapper, schema)
 
     def _agg_grouping_sets(self, fns, names) -> DataFrame:
         """rollup/cube → Expand + Aggregate(keys + grouping id) + drop-gid

@@ -195,6 +195,18 @@ def countDistinct(c) -> Column:
 count_distinct = countDistinct
 
 
+def approx_count_distinct(c, rsd: float = 0.05) -> Column:
+    """approx_count_distinct [REF: GpuApproximateCountDistinct /
+    spark-rapids-jni HLL++].  Implemented EXACTLY via the two-level
+    distinct-aggregate rewrite: an exact count trivially satisfies any
+    ``rsd`` error bound.  The HLL++ sketch (whose value is mergeable
+    fixed-size buffers for huge-cardinality distributed merges) is a
+    planned optimization, not a semantics change."""
+    if not (0.0 <= float(rsd) < 1.0):
+        raise ValueError(f"rsd must be in [0, 1), got {rsd}")
+    return countDistinct(c)
+
+
 def _agg1(kind):
     def fn(c) -> Column:
         return Column(UExpr("agg", kind, (_cu(c),)))
@@ -239,6 +251,35 @@ def _make_udf(f, returnType, vectorized: bool):
 
     call.__name__ = getattr(f, "__name__", "udf")
     return call
+
+
+def device_udf(f=None, returnType="double"):
+    """Columnar DEVICE UDF [REF: spark-rapids RapidsUDF]: ``f`` receives
+    the argument columns' raw device arrays (jax) and returns the result
+    array — it executes INSIDE the fused XLA program of the surrounding
+    expression tree (no launch boundary, no host round trip).  Also
+    usable as ``@device_udf(returnType=...)``.  Numeric/boolean/datetime
+    columns; nulls propagate as intersected validity."""
+    from spark_rapids_tpu.columnar import dtypes as T
+    from spark_rapids_tpu.plan.analysis import _parse_type
+
+    def make(fn):
+        dt = (returnType if isinstance(returnType, T.DataType)
+              else _parse_type(returnType))
+
+        def call(*cols) -> Column:
+            name = getattr(fn, "__name__", "device_udf")
+            return Column(UExpr("device_udf", (fn, dt, name),
+                                tuple(_cu(c) for c in cols)))
+
+        call.__name__ = getattr(fn, "__name__", "device_udf")
+        return call
+
+    if f is None or not callable(f):
+        if f is not None:
+            returnType = f
+        return make
+    return make(f)
 
 
 def udf(f=None, returnType="string"):

@@ -46,6 +46,7 @@ COLUMN_API = ["alias", "cast", "asc", "desc", "isNull", "isNotNull",
               "rlike", "over"]
 FUNCTIONS_API = [
     "col", "lit", "sum", "min", "max", "avg", "count", "countDistinct",
+    "approx_count_distinct",
     "first", "sqrt", "exp", "log", "abs", "floor", "ceil", "round",
     "pow", "coalesce", "when", "concat", "substring", "upper", "lower",
     "length", "trim", "ltrim", "rtrim", "replace", "instr", "locate",
@@ -57,6 +58,7 @@ FUNCTIONS_API = [
     "stddev_pop", "collect_list", "row_number", "rank", "dense_rank",
     "lag", "lead", "explode", "explode_outer", "posexplode",
     "posexplode_outer", "input_file_name", "udf", "pandas_udf",
+    "device_udf",
 ]
 
 

@@ -98,6 +98,21 @@ def generate_supported_ops_md() -> str:
         lines.append(f"| {name} | {cells} | {notes} |")
     lines += [
         "",
+        "### Host-evaluated expressions",
+        "",
+        "Implemented with Spark semantics but not yet device-lowered — "
+        "their subtree reports NOT_ON_TPU and runs the CPU path:",
+        "",
+    ]
+    from spark_rapids_tpu.ops import json_ops as J
+    for name, cls in sorted(vars(J).items()):
+        if (inspect.isclass(cls) and issubclass(cls, E.Expression)
+                and cls is not E.Expression
+                and cls.__module__ == J.__name__
+                and not name.startswith("_")):
+            lines.append(f"- `{name}` (device JSON scanner planned)")
+    lines += [
+        "",
         "## Aggregate functions",
         "",
         "| Function | Notes |",

@@ -449,3 +449,13 @@ def test_global_collect_list_empty():
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.createDataFrame(t).agg(
             F.collect_list(F.col("v")).alias("l")))
+
+
+def test_approx_count_distinct():
+    t = _t()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.approx_count_distinct(F.col("i")).alias("acd")),
+        ignore_order=True)
+    with pytest.raises(ValueError):
+        F.approx_count_distinct(F.col("i"), rsd=1.5)

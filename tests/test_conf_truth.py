@@ -143,15 +143,41 @@ def test_coalesce_inserted_above_h2d():
 
 
 def test_coalesce_merges_small_batches():
-    """Scan falls back to CPU with small batches; the H2D coalesce merges
-    them up to the batchSizeBytes target before device operators."""
+    """The H2D coalesce merges sub-batchRows batches up to its target;
+    the plan-level target is row-capped at batchRows (the documented
+    bucket-size bound — a 512 MB byte target must not override it)."""
+    from spark_rapids_tpu.columnar.column import host_to_device
+    from spark_rapids_tpu.exec.base import TpuExec
+    from spark_rapids_tpu.exec.basic import TpuCoalesceBatchesExec
+    import pyarrow as pa_
+
+    class _Feed(TpuExec):
+        def __init__(self, batches):
+            super().__init__(batches[0].schema)
+            self._batches = batches
+
+        def num_partitions(self):
+            return 1
+
+        def execute(self, p):
+            yield from self._batches
+
+    small = [host_to_device(pa_.table({"a": list(range(i * 256,
+                                                       (i + 1) * 256))}),
+                            min_bucket=8)
+             for i in range(20)]
+    co = TpuCoalesceBatchesExec(_Feed(small), target_rows=4096)
+    outs = list(co.execute(0))
+    assert len(outs) < 5
+    assert sum(int(b.num_rows_host()) for b in outs) == 20 * 256
+
+    # plan-level: the inserted coalesce honors batchRows as the cap
     t = _table(5000)
     s = tpu_session({"spark.rapids.sql.exec.InMemoryScan": False,
                      "spark.rapids.sql.test.enabled": False,
                      "spark.rapids.tpu.batchRows": 256})
     df = s.createDataFrame(t).select((F.col("a") * 2).alias("a2"))
     plan = df._execute_plan()
-    out_tables = df._pump_partitions(plan, s.rapids_conf())
 
     def find(node, name):
         if type(node).__name__ == name:
@@ -162,12 +188,11 @@ def test_coalesce_merges_small_batches():
                 return got
         return None
 
-    co = find(plan, "TpuCoalesceBatchesExec")
-    proj = find(plan, "TpuProjectExec")
-    assert co is not None and proj is not None
-    # ~20 scan batches of 256 rows merged into far fewer device batches
-    assert co.metric("numOutputBatches").value < 5
-    assert proj.metric("numOutputBatches").value < 5
+    co2 = find(plan, "TpuCoalesceBatchesExec")
+    assert co2 is not None and co2.target_rows <= 256
+    out = df.toArrow()
+    assert out.column("a2").to_pylist() == [
+        v * 2 for v in t.column("a").to_pylist()]
 
 
 def test_coalesce_single_batch_under_sort():

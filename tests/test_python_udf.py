@@ -194,3 +194,48 @@ def test_udf_nulls_cross_bridge():
     u = F.udf(lambda v: None if v is None else v * 100, "long")
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: s.createDataFrame(t).select(u(col("x")).alias("y")))
+
+
+# -- grouped-aggregate pandas UDFs [REF: GpuAggregateInPandasExec] ----------
+
+def test_pandas_udf_grouped_agg():
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.column import col
+    from spark_rapids_tpu.utils.harness import (
+        assert_tpu_and_cpu_are_equal_collect)
+    rng = np.random.default_rng(11)
+    t = pa.table({
+        "k": pa.array(rng.integers(0, 7, 900)),
+        "v": pa.array(rng.uniform(-5, 5, 900)),
+    })
+
+    @F.pandas_udf(returnType="double")
+    def wmean(v):
+        return float((v * 2).mean())
+
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            wmean(col("v")).alias("wm")),
+        ignore_order=True, approx_float=True,
+        allow_non_tpu=["FlatMapGroupsInPandas", "InMemoryScan",
+                       "HashAggregate"])
+
+
+def test_pandas_udf_grouped_agg_mixing_rejected():
+    import pyarrow as pa
+    import pytest as _pt
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.column import col
+    from spark_rapids_tpu.utils.harness import tpu_session
+    t = pa.table({"k": pa.array([1, 2]), "v": pa.array([1.0, 2.0])})
+
+    @F.pandas_udf(returnType="double")
+    def m(v):
+        return float(v.mean())
+
+    with _pt.raises(AnalysisException, match="mix"):
+        tpu_session({}).createDataFrame(t).groupBy("k").agg(
+            m(col("v")), F.sum("v"))

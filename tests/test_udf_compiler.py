@@ -173,3 +173,49 @@ def test_none_returning_udf_compiles_with_declared_type():
     df = s.createDataFrame(t).select(u(col("a")).alias("n"))
     assert not _plan_has_bridge(df)
     assert df.toArrow().column("n").to_pylist() == [None] * 20
+
+
+# -- columnar device UDFs [REF: RapidsUDF] ---------------------------------
+
+def test_device_udf_fuses_on_device():
+    import jax.numpy as jnp
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.column import col
+    from spark_rapids_tpu.utils.harness import (
+        assert_tpu_and_cpu_are_equal_collect)
+    rng = np.random.default_rng(5)
+    t = pa.table({
+        "x": pa.array([None if i % 9 == 0 else float(v) for i, v in
+                       enumerate(rng.uniform(0.1, 5, 2000))],
+                      pa.float64()),
+        "y": pa.array(rng.integers(1, 50, 2000)),
+    })
+
+    @F.device_udf(returnType="double")
+    def smooth(x, y):
+        return jnp.log1p(x) * jnp.sqrt(y.astype(jnp.float64))
+
+    # test mode: the UDF must run fused on device, zero fallbacks
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(t).select(
+            (smooth(col("x"), col("y")) + 1.0).alias("r"), col("y")),
+        approx_float=True)
+
+
+def test_device_udf_rejects_string_args():
+    import pyarrow as pa
+    import pytest as _pt
+    from spark_rapids_tpu.plan.analysis import AnalysisException
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.column import col
+    from spark_rapids_tpu.utils.harness import tpu_session
+    t = pa.table({"s": pa.array(["a", "b"])})
+
+    @F.device_udf(returnType="double")
+    def bad(s):
+        return s
+
+    with _pt.raises(AnalysisException, match="device_udf"):
+        tpu_session({}).createDataFrame(t).select(bad(col("s")))
