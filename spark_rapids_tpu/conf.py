@@ -218,13 +218,14 @@ MIN_BUCKET_ROWS = (
 AGG_BUCKET_ROWS = (
     conf("spark.rapids.tpu.agg.bucketRows")
     .doc("Grouped aggregates coalesce input batches up to this many live "
-         "rows before each partial-pass kernel. Fewer, larger partial "
-         "sorts beat many small ones on TPU: each partial chain pays a "
-         "fixed dispatch cost through the host tunnel, and the "
-         "hash-capped key encoding keeps the sort operand count flat as "
-         "the bucket grows. 0 disables coalescing.")
+         "rows before each partial-pass kernel. 0 (default) disables "
+         "coalescing: through a host tunnel each concat costs a count "
+         "round trip plus a gather that EXCEEDS the saved per-chain "
+         "dispatches (measured: TPC-H q1 2.7s uncoalesced vs 6.1s "
+         "coalesced at 256k). On direct-attached hosts with many tiny "
+         "partial batches, set 128k-512k.")
     .integer()
-    .create_with_default(1 << 18)
+    .create_with_default(0)
 )
 
 AGG_SKIP_RATIO = (
