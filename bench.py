@@ -1067,10 +1067,19 @@ def _sf1_query_main(name: str) -> None:
     from spark_rapids_tpu.sql.session import TpuSession
     build = TPCH_BUILDERS[name]
     sf1 = gen_tpch(1.0)
-    dfq = build(TpuSession(dict(TPCH_SF1_CONF)), sf1)
+    # span tracing on for the measured reps: per-span cost is ~1 µs of
+    # perf_counter + one object against multi-second queries, and the
+    # per-op self-time rollup it yields is the profiling signal the
+    # opTime dump below cannot give (parent/child double-counting)
+    conf = dict(TPCH_SF1_CONF)
+    conf["spark.rapids.sql.trace.enabled"] = True
+    dfq = build(TpuSession(conf), sf1)
     dfq.toArrow()  # warm (compile)
     t, _ = timed(lambda: dfq.toArrow(), reps=2)
     print(f"TPCH_SF1_SECONDS={t:.3f}")
+    rollup = getattr(dfq, "_last_rollup", None)
+    if rollup:
+        print("TPCH_SF1_ROLLUP=" + json.dumps(rollup))
     # the honest progress meter for operator breadth: how much of this
     # query's plan ran on device [REF: ExplainPlanImpl as a metric]
     print("TPCH_SF1_FALLBACK=" + json.dumps(dfq.fallback_summary()))
@@ -1099,12 +1108,15 @@ def _sf1_query_main(name: str) -> None:
 
 
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
-    """Returns (seconds | None, fallback_summary | None)."""
+    """Returns (seconds | "timeout" | None, fallback_summary | None,
+    op_rollup | None).  A per-query deadline means one slow query records
+    "timeout" and the run moves on — it can never null every later
+    query the way the old whole-run kill did (BENCH_r05, rc=124)."""
     import subprocess
     budget_s = min(SF1_QUERY_BUDGET_S, budget_s)
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
-        return None, None
+        return None, None, None
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
@@ -1113,19 +1125,21 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             timeout=budget_s)
     except subprocess.TimeoutExpired:
         mark(f"{name}: timed out after {budget_s:.0f}s (compile budget)")
-        return None, None
-    secs = fb = None
+        return "timeout", None, None
+    secs = fb = rollup = None
     for line in (out.stdout or "").splitlines():
         if line.startswith("TPCH_SF1_SECONDS="):
             secs = round(float(line.split("=", 1)[1]), 3)
         elif line.startswith("TPCH_SF1_FALLBACK="):
             fb = json.loads(line.split("=", 1)[1])
+        elif line.startswith("TPCH_SF1_ROLLUP="):
+            rollup = json.loads(line.split("=", 1)[1])
     if secs is not None:
-        return secs, fb
+        return secs, fb, rollup
     # crashed child: surface the failure, don't blur it into a timeout
     mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
          + (out.stderr or "")[-500:].replace("\n", " | "))
-    return None, None
+    return None, None, None
 
 
 def main():
@@ -1186,6 +1200,7 @@ def main():
     checked = {}
     times = {name: None for name in TPCH_BUILDERS}
     fallbacks = {name: None for name in TPCH_BUILDERS}
+    rollups = {name: None for name in TPCH_BUILDERS}
     result = {
         "metric": "tpch_q6_throughput",
         "value": round(ROWS / t_tpu / 1e6, 2),
@@ -1205,6 +1220,7 @@ def main():
         "input_bytes": in_bytes,
         "tpch_sf1_seconds": times,
         "tpch_sf1_fallback": fallbacks,
+        "tpch_sf1_op_rollup": rollups,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
         "host_memcpy_gb_per_s": round(host_memcpy_gb_per_s(), 2),
@@ -1250,8 +1266,8 @@ def main():
         # and the bench still completes; the persistent XLA cache keeps
         # whatever finished compiling, so later runs get further.
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
-        times[name], fallbacks[name] = _sf1_query_subprocess(
-            name, mark, remaining)
+        times[name], fallbacks[name], rollups[name] = (
+            _sf1_query_subprocess(name, mark, remaining))
         mark(f"{name} sf1: {times[name]}s")
         emit()
 

@@ -551,6 +551,45 @@ PROFILE_PATH = (
     .create_with_default("/tmp/tpuq-profile")
 )
 
+TRACE_ENABLED = (
+    conf("spark.rapids.sql.trace.enabled")
+    .doc("Per-query span tracing (the NVTX-range analog): every exec's "
+         "partition pump and internal stages (compile, transfer, compute, "
+         "collective) record spans, exported as Chrome-trace JSON "
+         "(chrome://tracing / Perfetto) plus a per-operator self-time vs "
+         "total-time rollup.")
+    .boolean()
+    .create_with_default(False)
+)
+
+TRACE_PATH = (
+    conf("spark.rapids.sql.trace.path")
+    .doc("Directory for Chrome-trace exports "
+         "(query-<id>.trace.json per traced query).")
+    .string()
+    .create_with_default("/tmp/tpuq-trace")
+)
+
+QUERY_LOG_PATH = (
+    conf("spark.rapids.sql.queryLog.path")
+    .doc("JSONL file appended with one entry per executed query: plan "
+         "tree, device/fallback report, all metrics at their levels, span "
+         "rollup, and cross-links to trace/profile/LORE artifacts. Empty "
+         "disables the file (session.query_history() still records).")
+    .string()
+    .create_with_default("")
+)
+
+QUERY_LOG_MAX_EVENTS = (
+    conf("spark.rapids.sql.queryLog.maxEvents")
+    .doc("Span cap per traced query; spans beyond the cap are counted as "
+         "dropped rather than recorded (bounds tracer memory on "
+         "pathological plans).")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(100000)
+)
+
 FAULT_INJECT = (
     conf("spark.rapids.tpu.test.injectOomAtAlloc")
     .doc("Force an OOM at the Nth device allocation (test hook, mirrors "

@@ -14,11 +14,13 @@ reference's operator pipelining, SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import functools
 import threading
 import time
 from typing import Dict, Iterator, Tuple
 
 from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.runtime import trace
 
 # Metric verbosity levels [REF: GpuMetrics.scala :: MetricsLevel] —
 # ESSENTIAL always collected, MODERATE the default, DEBUG opt-in.
@@ -57,20 +59,85 @@ class Metric:
 
 
 class MetricTimer:
-    def __init__(self, metric: Metric):
+    """Times into a Metric and, when a query tracer is active, opens a
+    span (op=owning exec, stage=metric name) — every existing timer site
+    (opTime, transferTime, collectiveTime, ...) becomes a trace range
+    with zero per-site changes, the NVTX-with-metrics pairing of the
+    reference."""
+
+    __slots__ = ("metric", "op", "_t0", "_tr", "_span")
+
+    def __init__(self, metric: Metric, op: str = None):
         self.metric = metric
+        self.op = op
+        self._tr = None
+        self._span = None
 
     def __enter__(self):
+        if self.op is not None:
+            tr = trace.current()
+            if tr is not None:
+                self._tr = tr
+                self._span = tr.begin(self.op, self.metric.name)
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         self.metric.add(time.perf_counter() - self._t0)
+        if self._span is not None:
+            self._tr.end(self._span)
+            self._tr = self._span = None
         return False
 
 
+def _traced_pump(node: "ExecNode", partition: int, it: Iterator) -> Iterator:
+    """Each ``next()`` on a pump iterator becomes one span, so operator
+    time nests correctly through the iterator chain: a child's pump span
+    opens INSIDE its consumer's on the same thread and its duration
+    subtracts from the consumer's self-time."""
+    op = node.name
+    while True:
+        tr = trace.current()
+        if tr is None:  # tracer closed mid-pump (leaked iterator)
+            yield from it
+            return
+        sp = tr.begin(op, "pump", {"partition": partition})
+        try:
+            batch = next(it)
+        except StopIteration:
+            tr.end(sp)
+            return
+        except BaseException:
+            tr.end(sp)
+            raise
+        tr.end(sp)
+        yield batch
+
+
+def _wrap_execute(fn):
+    @functools.wraps(fn)
+    def execute(self, partition: int) -> Iterator:
+        it = fn(self, partition)
+        if trace.current() is None:  # fast path: tracing off
+            return it
+        return _traced_pump(self, partition, it)
+
+    execute._traced = True
+    return execute
+
+
 class ExecNode:
-    """Base physical operator."""
+    """Base physical operator.
+
+    Subclass ``execute`` methods are auto-wrapped at class-creation time
+    so that, when a query tracer is active, every partition pump emits
+    per-batch spans — no exec opts in or out individually."""
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        fn = cls.__dict__.get("execute")
+        if fn is not None and not getattr(fn, "_traced", False):
+            cls.execute = _wrap_execute(fn)
 
     def __init__(self, schema: T.StructType, *children: "ExecNode"):
         self.schema = schema
@@ -96,7 +163,7 @@ class ExecNode:
         return m
 
     def timer(self, name: str = "opTime") -> MetricTimer:
-        return MetricTimer(self.metric(name))
+        return MetricTimer(self.metric(name), op=self.name)
 
     def num_partitions(self) -> int:
         if self._children:

@@ -597,8 +597,11 @@ def _concat_compacted_fast(schema: T.StructType,
        compact moves the per-batch live prefixes together.
     """
     from spark_rapids_tpu.columnar.column import compact as _compact
+    from spark_rapids_tpu.columnar.column import empty_batch
     from spark_rapids_tpu.runtime.kernel_cache import (
         cached_kernel, fingerprint)
+    if not batches:
+        return empty_batch(schema)
     if counts is None:
         counts = _overlapped_live_counts(batches)
     total = sum(counts)
@@ -717,6 +720,9 @@ def concat_device_batches(schema: T.StructType,
     validity presence (shard-uniformity: every shard of one global
     sharded array must carry identical leaf structure).
     """
+    if not batches:
+        from spark_rapids_tpu.columnar.column import empty_batch
+        return empty_batch(schema)
     if (len(batches) == 1 and bucket is None and min_width == 0
             and force_validity is None):
         return batches[0]

@@ -619,15 +619,17 @@ class TpuSortMergeJoinExec(TpuExec):
                     acc = 0
                 groups[-1].append(c)
                 acc += c.capacity
+        # NOTE: side, not self.broadcast — the runtime strategy pick
+        # passes side="right"/"left" on plans with broadcast=None, and
+        # consulting self.broadcast here built the broadcast batch from
+        # the STREAMED side's schema (IndexError on TPC-H q7 SF1)
         bc = _concat_or_empty(
-            self.children[1 if self.broadcast == "right" else 0].schema,
-            r_list if self.broadcast == "right" else l_list)
+            self.children[1 if side == "right" else 0].schema,
+            r_list if side == "right" else l_list)
         for g in groups:
             gb = _concat_or_empty(
-                self.children[0 if self.broadcast == "right" else 1]
-                .schema, g)
-            lb, rb = ((gb, bc) if self.broadcast == "right"
-                      else (bc, gb))
+                self.children[0 if side == "right" else 1].schema, g)
+            lb, rb = (gb, bc) if side == "right" else (bc, gb)
             with mgr.transient(2 * (gb.nbytes() + bc.nbytes())):
                 with self.timer():
                     yield from self._merge_join(lb, rb, jt)

@@ -17,6 +17,7 @@ from typing import Callable, Dict, Tuple
 
 import jax
 
+from spark_rapids_tpu.runtime import trace
 from spark_rapids_tpu.runtime.faultinj import INJECTOR, retry_device_call
 
 _CACHE: Dict[tuple, Callable] = {}
@@ -53,13 +54,31 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
         if fn is None:
             jfn = jax.jit(builder())
 
-            def fn(*args, __jfn=jfn, **kw):
+            def _call(args, kw, __jfn=jfn):
                 if INJECTOR.armed:
                     def call():
                         INJECTOR.on_execute()
                         return __jfn(*args, **kw)
                     return retry_device_call(call)
                 return __jfn(*args, **kw)
+
+            def fn(*args, __jfn=jfn, **kw):
+                tr = trace.current()
+                if tr is None:
+                    return _call(args, kw)
+                # jax.jit compiles lazily at first call per shape bucket;
+                # the cache-size delta distinguishes an XLA compile from
+                # a hot dispatch, so compiles show as their own stage
+                before = (__jfn._cache_size()
+                          if hasattr(__jfn, "_cache_size") else None)
+                sp = tr.begin("Kernel", "kernel")
+                try:
+                    return _call(args, kw)
+                finally:
+                    if (before is not None
+                            and __jfn._cache_size() > before):
+                        sp.stage = "compile"
+                    tr.end(sp)
 
             _CACHE[key] = fn
         return fn

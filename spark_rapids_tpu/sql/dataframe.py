@@ -783,24 +783,89 @@ class DataFrame:
     def toArrow(self) -> pa.Table:
         import contextlib
         from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import trace
         conf = self.session.rapids_conf()
         plan = self._execute_plan()
         self._last_plan = plan
+        qid = trace.next_query_id()
+        tracer = None
+        if conf.get(C.TRACE_ENABLED):
+            tracer = trace.start_query(
+                qid, max_events=int(conf.get(C.QUERY_LOG_MAX_EVENTS)))
         profile = contextlib.nullcontext()
+        profile_dir = None
         if conf.get(C.PROFILE_ENABLED):
-            # per-query xplane capture [REF: spark-rapids-jni profiler]
+            # per-query xplane capture, dump dir named after the query id
+            # so trace + event-log entries cross-link
+            # [REF: spark-rapids-jni profiler]
             import jax
             import os
-            path = str(conf.get(C.PROFILE_PATH))
-            os.makedirs(path, exist_ok=True)
-            profile = jax.profiler.trace(path)
-        with profile:
-            tables = self._pump_partitions(plan, conf)
-        if not tables:
-            return self._reassemble_structs(pa.table(
-                {f.name: pa.array([], type=T.to_arrow(f.dtype))
-                 for f in self.schema.fields}))
-        return self._reassemble_structs(pa.concat_tables(tables))
+            profile_dir = os.path.join(str(conf.get(C.PROFILE_PATH)),
+                                       f"query-{qid:06d}")
+            os.makedirs(profile_dir, exist_ok=True)
+            profile = jax.profiler.trace(profile_dir)
+        root = (tracer.span("Query", "execute")
+                if tracer is not None else contextlib.nullcontext())
+        error = None
+        try:
+            with profile, root:
+                tables = self._pump_partitions(plan, conf)
+                if not tables:
+                    out = self._reassemble_structs(pa.table(
+                        {f.name: pa.array([], type=T.to_arrow(f.dtype))
+                         for f in self.schema.fields}))
+                else:
+                    out = self._reassemble_structs(pa.concat_tables(tables))
+        except BaseException as e:
+            error = f"{type(e).__name__}: {e}"
+            raise
+        finally:
+            trace.end_query(tracer)
+            self._record_query(qid, tracer, conf, profile_dir, error)
+        return out
+
+    def _record_query(self, qid, tracer, conf, profile_dir, error):
+        """One event-log entry per execution: plan tree, device/fallback
+        report, all metrics at their levels, span rollup, artifact
+        cross-links — the reference's driver-log plan-conversion report,
+        machine-readable."""
+        import time as _time
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import trace
+        plan = self._last_plan
+        override = getattr(self, "_last_override", None)
+        entry = {
+            "query_id": qid,
+            "ts": _time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+            "status": "error" if error else "ok",
+            "plan": plan.tree_string(),
+            "metrics": trace.plan_metrics(plan),
+        }
+        if error:
+            entry["error"] = error
+        if override is not None:
+            entry["fallback"] = override.fallback_summary()
+            entry["fallback_report"] = override.fallback_report()
+        if tracer is not None:
+            entry["wall_s"] = round(tracer.wall_s, 6)
+            rollup = tracer.rollup()
+            entry["op_rollup"] = rollup
+            entry["dropped_spans"] = tracer.dropped
+            self._last_rollup = rollup
+            tf = trace.write_chrome_trace(
+                str(conf.get(C.TRACE_PATH)), tracer)
+            if tf:
+                entry["trace_file"] = tf
+        if profile_dir:
+            entry["profile_dir"] = profile_dir
+        lore = str(conf.get(C.LORE_TAG))
+        if lore:
+            entry["lore_tag"] = lore
+        self._last_query_entry = entry
+        self.session._record_query(entry)
+        log_path = str(conf.get(C.QUERY_LOG_PATH))
+        if log_path:
+            trace.append_query_log(log_path, entry)
 
     def _reassemble_structs(self, t: pa.Table) -> pa.Table:
         """Physical flattened columns → logical arrow struct columns
@@ -937,6 +1002,12 @@ class DataFrame:
         print(self.limit(n).toArrow().to_pandas().to_string())
 
     def explain(self, extended: bool = False):
+        """``explain()`` prints the physical plan; ``explain(True)`` adds
+        the fallback report; ``explain("metrics")`` prints the last
+        execution's per-node metrics (at the configured level) and, when
+        tracing was on, the per-operator self/total-time rollup."""
+        if isinstance(extended, str) and extended.lower() == "metrics":
+            return self._explain_metrics()
         from spark_rapids_tpu.plan.optimizer import optimize
         conf = self.session.rapids_conf()
         cpu = plan_physical(optimize(self._plan, conf), conf)
@@ -945,6 +1016,24 @@ class DataFrame:
         if extended:
             for line in result.fallback_report():
                 print(line)
+
+    def _explain_metrics(self):
+        plan = getattr(self, "_last_plan", None)
+        if plan is None:
+            print("<no execution yet — run collect()/toArrow() first>")
+            return
+        print(plan.tree_string())
+        for op, vals in self.metrics():
+            shown = {k: (round(v, 6) if isinstance(v, float) else v)
+                     for k, v in vals.items()}
+            print(f"  {op}: {shown}")
+        rollup = getattr(self, "_last_rollup", None)
+        if rollup:
+            print("-- per-op time attribution (traced) --")
+            for op, r in sorted(rollup.items(),
+                                key=lambda kv: -kv[1]["self_s"]):
+                print(f"  {op}: self={r['self_s']:.6f}s "
+                      f"total={r['total_s']:.6f}s spans={r['spans']}")
 
     @property
     def write(self):

@@ -139,14 +139,32 @@ class _BuilderDescriptor:
 class TpuSession:
     builder = _BuilderDescriptor()
 
+    # session-held query records beyond this cap evict oldest-first;
+    # the JSONL query log keeps the full record
+    QUERY_HISTORY_CAP = 256
+
     def __init__(self, conf: Optional[Dict[str, Any]] = None):
         self.conf = RuntimeConf(conf or {})
+        self._query_history: List[Dict[str, Any]] = []
         # multi-executor mode joins the global mesh FIRST:
         # jax.distributed.initialize must run before anything touches
         # the XLA backend [REF: RapidsExecutorPlugin.init]
         from spark_rapids_tpu.parallel.executor import init_executor
         init_executor(self.conf.snapshot())
         ensure_initialized()
+
+    # -- observability ------------------------------------------------------
+    def _record_query(self, entry: Dict[str, Any]) -> None:
+        self._query_history.append(entry)
+        if len(self._query_history) > self.QUERY_HISTORY_CAP:
+            del self._query_history[:-self.QUERY_HISTORY_CAP]
+
+    def query_history(self, n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Event-log entries for queries this session has executed,
+        oldest first (same schema as the ``spark.rapids.sql.queryLog``
+        JSONL records).  ``n`` limits to the most recent n."""
+        h = self._query_history
+        return list(h[-n:] if n else h)
 
     # -- data ingestion -----------------------------------------------------
     def createDataFrame(self, data, schema=None) -> "DataFrame":

@@ -10,7 +10,47 @@ from __future__ import annotations
 
 import inspect
 import os
+import re
 import sys
+
+
+def collect_metric_names(pkg_dir: str = None) -> set:
+    """Every metric name created anywhere in the package source.
+
+    Creation sites are all string-literal ``.metric(...)`` /
+    ``.timer(...)`` / ``Metric(...)`` calls plus the defaults table in
+    exec/base.py, so a source scan is exact — the same
+    registry-is-the-doc coupling the config/ops tables get from their
+    live registries."""
+    if pkg_dir is None:
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+    pat = re.compile(
+        r'(?:\.metric\(\s*|\.timer\(\s*(?:name\s*=\s*)?|\bMetric\(\s*)'
+        r'"([A-Za-z]\w*)"')
+    names = {"opTime"}  # .timer() default
+    for root, _dirs, files in os.walk(pkg_dir):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            with open(os.path.join(root, fname)) as f:
+                names.update(pat.findall(f.read()))
+    from spark_rapids_tpu.exec.base import _DEFAULT_METRIC_LEVEL
+    names.update(_DEFAULT_METRIC_LEVEL)
+    return names
+
+
+def check_metrics_documented(doc_path: str = None) -> list:
+    """Metric names created in the package but missing from the
+    docs/observability.md table — run in tier-1 tests so metric drift
+    fails fast.  Returns the sorted list of undocumented names."""
+    if doc_path is None:
+        doc_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "docs", "observability.md")
+    with open(doc_path) as f:
+        documented = set(re.findall(r"`(\w+)`", f.read()))
+    return sorted(collect_metric_names() - documented)
 
 
 def generate_supported_ops_md() -> str:
@@ -148,6 +188,11 @@ def main(out_dir: str = "docs"):
     with open(os.path.join(out_dir, "supported_ops.md"), "w") as f:
         f.write(generate_supported_ops_md())
     print(f"wrote {out_dir}/configs.md and {out_dir}/supported_ops.md")
+    obs = os.path.join(out_dir, "observability.md")
+    if os.path.exists(obs):
+        missing = check_metrics_documented(obs)
+        if missing:
+            print(f"UNDOCUMENTED metrics (add to {obs}): {missing}")
 
 
 if __name__ == "__main__":

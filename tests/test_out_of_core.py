@@ -191,6 +191,40 @@ def test_join_output_rebatched_to_batch_rows():
     assert out.num_rows == exp.num_rows
 
 
+@pytest.mark.parametrize("how", ["inner", "left", "left_semi",
+                                 "left_anti"])
+def test_streamed_join_small_right_side(how):
+    """Runtime strategy pick: left exceeds targetRows, right fits —
+    stream the left in bounded groups against the fully-present right.
+    Regression: the group loop consulted ``self.broadcast`` (None on
+    these plans) instead of the per-side override, so the 'broadcast'
+    batch was built from the STREAMED side's list against the other
+    side's schema — the TPC-H q7 SF1 IndexError."""
+    l, r = _join_tables(n=30_000, m=3_000, seed=41)
+    conf = {"spark.sql.autoBroadcastJoinThreshold": 0,
+            "spark.rapids.tpu.join.targetRows": 4096,
+            "spark.rapids.tpu.batchRows": 8192}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            how),
+        conf=conf, ignore_order=True, approx_float=True)
+
+
+def test_streamed_join_small_left_side():
+    l, r = _join_tables(n=3_000, m=30_000, seed=43)
+    s = tpu_session({"spark.sql.autoBroadcastJoinThreshold": 0,
+                     "spark.rapids.tpu.join.targetRows": 4096,
+                     "spark.rapids.tpu.batchRows": 8192})
+    df = s.createDataFrame(l).join(s.createDataFrame(r), "k", "inner")
+    out = df.toArrow()
+    j = _find(df._last_plan, "TpuSortMergeJoinExec")
+    assert j.metric("streamedJoins").value == 1
+    cpu = tpu_session({"spark.rapids.sql.enabled": False})
+    exp = (cpu.createDataFrame(l).join(cpu.createDataFrame(r), "k",
+                                       "inner").toArrow())
+    assert out.num_rows == exp.num_rows
+
+
 def test_skewed_sub_partition_recurses_and_matches():
     """Low-cardinality keys defeat one split level; the re-split with a
     fresh seed (and, for a single hot key, the bounded-depth in-core
