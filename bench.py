@@ -1080,6 +1080,20 @@ def _sf1_query_main(name: str) -> None:
     rollup = getattr(dfq, "_last_rollup", None)
     if rollup:
         print("TPCH_SF1_ROLLUP=" + json.dumps(rollup))
+    # memory behavior per query (peak HBM watermark, spill tiers, OOM
+    # retries) so the perf trajectory captures footprint, not just time
+    try:
+        from spark_rapids_tpu.runtime import memory as M
+        mm = M.get_manager().metrics
+        print("TPCH_SF1_MEMORY=" + json.dumps({
+            "peak_hbm_bytes": mm["peakReserved"],
+            "spill_host_bytes": mm["spillToHostBytes"],
+            "spill_disk_bytes": mm["spillToDiskBytes"],
+            "restored_bytes": mm["restoredBytes"],
+            "retry_ooms": mm["retryOOMs"],
+            "split_retries": mm["splitRetries"]}))
+    except Exception as e:  # diagnostics must never fail the run
+        print(f"TPCH_SF1_MEMORY_ERR={e}")
     # the honest progress meter for operator breadth: how much of this
     # query's plan ran on device [REF: ExplainPlanImpl as a metric]
     print("TPCH_SF1_FALLBACK=" + json.dumps(dfq.fallback_summary()))
@@ -1109,14 +1123,15 @@ def _sf1_query_main(name: str) -> None:
 
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
     """Returns (seconds | "timeout" | None, fallback_summary | None,
-    op_rollup | None).  A per-query deadline means one slow query records
-    "timeout" and the run moves on — it can never null every later
-    query the way the old whole-run kill did (BENCH_r05, rc=124)."""
+    op_rollup | None, memory_stats | None).  A per-query deadline means
+    one slow query records "timeout" and the run moves on — it can never
+    null every later query the way the old whole-run kill did
+    (BENCH_r05, rc=124)."""
     import subprocess
     budget_s = min(SF1_QUERY_BUDGET_S, budget_s)
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
-        return None, None, None
+        return None, None, None, None
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
@@ -1125,8 +1140,8 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             timeout=budget_s)
     except subprocess.TimeoutExpired:
         mark(f"{name}: timed out after {budget_s:.0f}s (compile budget)")
-        return "timeout", None, None
-    secs = fb = rollup = None
+        return "timeout", None, None, None
+    secs = fb = rollup = mem = None
     for line in (out.stdout or "").splitlines():
         if line.startswith("TPCH_SF1_SECONDS="):
             secs = round(float(line.split("=", 1)[1]), 3)
@@ -1134,12 +1149,14 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             fb = json.loads(line.split("=", 1)[1])
         elif line.startswith("TPCH_SF1_ROLLUP="):
             rollup = json.loads(line.split("=", 1)[1])
+        elif line.startswith("TPCH_SF1_MEMORY="):
+            mem = json.loads(line.split("=", 1)[1])
     if secs is not None:
-        return secs, fb, rollup
+        return secs, fb, rollup, mem
     # crashed child: surface the failure, don't blur it into a timeout
     mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
          + (out.stderr or "")[-500:].replace("\n", " | "))
-    return None, None, None
+    return None, None, None, None
 
 
 def main():
@@ -1201,6 +1218,7 @@ def main():
     times = {name: None for name in TPCH_BUILDERS}
     fallbacks = {name: None for name in TPCH_BUILDERS}
     rollups = {name: None for name in TPCH_BUILDERS}
+    memories = {name: None for name in TPCH_BUILDERS}
     result = {
         "metric": "tpch_q6_throughput",
         "value": round(ROWS / t_tpu / 1e6, 2),
@@ -1221,6 +1239,7 @@ def main():
         "tpch_sf1_seconds": times,
         "tpch_sf1_fallback": fallbacks,
         "tpch_sf1_op_rollup": rollups,
+        "tpch_sf1_memory": memories,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
         "host_memcpy_gb_per_s": round(host_memcpy_gb_per_s(), 2),
@@ -1266,7 +1285,7 @@ def main():
         # and the bench still completes; the persistent XLA cache keeps
         # whatever finished compiling, so later runs get further.
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
-        times[name], fallbacks[name], rollups[name] = (
+        times[name], fallbacks[name], rollups[name], memories[name] = (
             _sf1_query_subprocess(name, mark, remaining))
         mark(f"{name} sf1: {times[name]}s")
         emit()

@@ -630,6 +630,80 @@ INJECT_TRANSIENT_COUNT = (
     .create_with_default(0)
 )
 
+TELEMETRY_ENABLED = (
+    conf("spark.rapids.tpu.telemetry.enabled")
+    .doc("Continuous process telemetry: a background sampler snapshots "
+         "the metrics registry (HBM arbiter, spill tiers, device "
+         "semaphore, kernel cache, shuffle, pump pool) into a JSONL "
+         "time series and a Prometheus text-format dump. The registry "
+         "itself always updates; this only gates the sampler/sinks.")
+    .category("telemetry")
+    .boolean()
+    .create_with_default(False)
+)
+
+TELEMETRY_PERIOD_MS = (
+    conf("spark.rapids.tpu.telemetry.samplePeriodMs")
+    .doc("Sampler period in milliseconds.")
+    .category("telemetry")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(1000)
+)
+
+TELEMETRY_SINK_PATH = (
+    conf("spark.rapids.tpu.telemetry.sinkPath")
+    .doc("JSONL time-series sink: one line per sample with every "
+         "counter/gauge value and histogram summary. Empty disables "
+         "the JSONL sink.")
+    .category("telemetry")
+    .string()
+    .create_with_default("/tmp/tpuq-telemetry/metrics.jsonl")
+)
+
+TELEMETRY_PROM_PATH = (
+    conf("spark.rapids.tpu.telemetry.promPath")
+    .doc("Prometheus text exposition dump, atomically rewritten every "
+         "sample — scrape it with node_exporter's textfile collector "
+         "or serve the file. Empty disables the dump.")
+    .category("telemetry")
+    .string()
+    .create_with_default("/tmp/tpuq-telemetry/metrics.prom")
+)
+
+HEALTH_SPILL_RATIO = (
+    conf("spark.rapids.tpu.telemetry.health.spillRatio")
+    .doc("WARN when one query's spilled bytes exceed this fraction of "
+         "the bytes it reserved (the working set does not fit the HBM "
+         "budget).")
+    .category("telemetry")
+    .double()
+    .check(lambda v: v >= 0.0, "non-negative")
+    .create_with_default(0.5)
+)
+
+HEALTH_SEM_WAIT_RATIO = (
+    conf("spark.rapids.tpu.telemetry.health.semaphoreWaitRatio")
+    .doc("WARN when one query's cumulative device-admission wait "
+         "exceeds this fraction of its wall time (semaphore "
+         "saturation: concurrentGpuTasks is the bottleneck).")
+    .category("telemetry")
+    .double()
+    .check(lambda v: v >= 0.0, "non-negative")
+    .create_with_default(0.5)
+)
+
+HEALTH_COMPILE_STORM = (
+    conf("spark.rapids.tpu.telemetry.health.compileStorm")
+    .doc("WARN when one query triggers more than this many XLA "
+         "compiles (shape buckets / kernel fingerprints are not being "
+         "reused).")
+    .category("telemetry")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(64)
+)
+
 
 class RapidsConf:
     """Immutable-ish view over a raw key->value dict, validated at init.

@@ -18,6 +18,7 @@ around this exchange and co-partitions join inputs through it.
 
 from __future__ import annotations
 
+import time
 from typing import Iterator, List, Optional, Sequence
 
 import jax
@@ -31,6 +32,14 @@ from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.expressions import Expression
 from spark_rapids_tpu.parallel import shuffle as SH
 from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.runtime import telemetry as TM
+
+_TM_COLLECTIVE_S = TM.REGISTRY.counter(
+    "tpuq_ici_collective_seconds_total",
+    "ICI all-to-all collective dispatch seconds")
+_TM_ICI_BYTES = TM.REGISTRY.counter(
+    "tpuq_ici_exchange_bytes_total",
+    "bytes moved through ICI shuffle exchanges (global batch size)")
 
 
 def owned_partitions(plan) -> List[int]:
@@ -252,11 +261,14 @@ class TpuIciShuffleExchangeExec(TpuExec):
             # per-device collective working set: the [d*cap] layout and
             # the [d*cap] received block
             with mgr.transient(2 * d * cap * row_bytes):
+                t0 = time.perf_counter()
                 with self.timer("collectiveTime"):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,
                         self._shuffle_builder(cap))
                     self._result = shuffle_fn(sharded, *aux)
+                _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
+                _TM_ICI_BYTES.inc(sharded.nbytes())
         return self._result
 
     # -- pid-program hooks (overridden by the RANGE exchange) ---------------
@@ -392,11 +404,14 @@ class TpuIciShuffleExchangeExec(TpuExec):
             cap = round_up_pow2(max(max(counts), 1), 8)
             with mgr.transient(2 * d * cap * row_bytes):
                 ctx.client.barrier(self._stage + ":enter", timeout)
+                t0 = time.perf_counter()
                 with self.timer("collectiveTime"):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,
                         self._shuffle_builder(cap))
                     self._result = shuffle_fn(sharded, *aux)
+                _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
+                _TM_ICI_BYTES.inc(sharded.nbytes())
         return self._result
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:

@@ -22,9 +22,11 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional, Sequence
 
 from spark_rapids_tpu.parallel.rendezvous import RendezvousClient
+from spark_rapids_tpu.runtime import telemetry as TM
 
 
 class ExecutorContext:
@@ -95,3 +97,61 @@ def init_executor(conf) -> Optional[ExecutorContext]:
 
 def get_executor() -> Optional[ExecutorContext]:
     return _CTX
+
+
+# ---------------------------------------------------------------------------
+# instrumented partition-pump pool (the Spark-task-slot analog's
+# process-level observability: queue depth + task latency)
+# ---------------------------------------------------------------------------
+
+_pump_lock = threading.Lock()
+_pump_inflight = 0  # tasks submitted and not yet completed
+
+_TM_PUMP_TASKS = TM.REGISTRY.counter(
+    "tpuq_pump_tasks_total", "partition pump tasks completed")
+_TM_PUMP_TASK_S = TM.REGISTRY.histogram(
+    "tpuq_pump_task_seconds",
+    "per-task pump execution time (incl. semaphore wait)")
+TM.REGISTRY.gauge(
+    "tpuq_pump_queue_depth",
+    "pump tasks submitted but not yet completed",
+    fn=lambda: _pump_inflight)
+
+
+def run_pump_tasks(fn: Callable, items: Sequence,
+                   max_workers: int = 1) -> List:
+    """Run ``fn`` over ``items`` preserving order — inline when a single
+    worker suffices, else on a transient thread pool — with queue-depth
+    and task-latency accounting either way."""
+    global _pump_inflight
+    items = list(items)
+    if not items:
+        return []
+    started = [0]
+
+    def timed(item):
+        global _pump_inflight
+        with _pump_lock:
+            started[0] += 1
+        t0 = time.perf_counter()
+        try:
+            return fn(item)
+        finally:
+            _TM_PUMP_TASK_S.observe(time.perf_counter() - t0)
+            _TM_PUMP_TASKS.inc()
+            with _pump_lock:
+                _pump_inflight -= 1
+
+    with _pump_lock:
+        _pump_inflight += len(items)
+    try:
+        if max_workers <= 1 or len(items) == 1:
+            return [timed(i) for i in items]
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+            return list(pool.map(timed, items))
+    finally:
+        # tasks cancelled before starting (an earlier task raised)
+        # never ran their own decrement — settle the gauge exactly
+        with _pump_lock:
+            _pump_inflight -= len(items) - started[0]

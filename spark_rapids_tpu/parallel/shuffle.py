@@ -34,6 +34,13 @@ from spark_rapids_tpu.columnar.column import (
     DeviceBatch, DeviceColumn, round_up_pow2)
 from spark_rapids_tpu.ops import hashing as HH
 from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.runtime import telemetry as TM
+
+# one increment per SPMD program *build* — each build is a fresh XLA
+# compilation candidate, so a growing rate flags shape-bucket churn
+_TM_ICI_PROGRAMS = TM.REGISTRY.counter(
+    "tpuq_ici_programs_built_total",
+    "SPMD count/shuffle programs constructed (pre-compile)")
 
 
 def _hash_f64_tpu_safe(data: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
@@ -199,6 +206,7 @@ def build_range_count_program(mesh: jax.sharding.Mesh, orders,
 
     spec = jax.sharding.PartitionSpec(axis)
     rep = jax.sharding.PartitionSpec()
+    _TM_ICI_PROGRAMS.inc()
     return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, rep),
                                  out_specs=spec))
 
@@ -217,6 +225,7 @@ def build_range_shuffle_program(mesh: jax.sharding.Mesh, orders,
 
     spec = jax.sharding.PartitionSpec(axis)
     rep = jax.sharding.PartitionSpec()
+    _TM_ICI_PROGRAMS.inc()
     return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, rep),
                                  out_specs=spec))
 
@@ -231,6 +240,7 @@ def build_count_program(mesh: jax.sharding.Mesh, keys, nparts: int,
         return local_partition_counts(batch, pid_fn(batch), nparts)
 
     spec = jax.sharding.PartitionSpec(axis)
+    _TM_ICI_PROGRAMS.inc()
     return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,),
                                  out_specs=spec))
 
@@ -246,6 +256,7 @@ def build_shuffle_program(mesh: jax.sharding.Mesh, keys, nparts: int,
         return exchange_collective(laid, axis, nparts, cap)
 
     spec = jax.sharding.PartitionSpec(axis)
+    _TM_ICI_PROGRAMS.inc()
     return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,),
                                  out_specs=spec))
 

@@ -13,10 +13,12 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Callable, Dict, Tuple
 
 import jax
 
+from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.runtime import trace
 from spark_rapids_tpu.runtime.faultinj import INJECTOR, retry_device_call
 
@@ -24,6 +26,21 @@ _CACHE: Dict[tuple, Callable] = {}
 # partitions pump on a thread pool: without a lock, racing threads each
 # build their own jit wrapper for the same key and XLA compiles twice
 _CACHE_LOCK = threading.Lock()
+
+_TM_HITS = TM.REGISTRY.counter(
+    "tpuq_kernel_cache_hits_total",
+    "cached_kernel lookups served by the fingerprint cache")
+_TM_MISSES = TM.REGISTRY.counter(
+    "tpuq_kernel_cache_misses_total",
+    "cached_kernel lookups that built a new jit wrapper")
+_TM_COMPILES = TM.REGISTRY.counter(
+    "tpuq_kernel_compile_total", "XLA compilations observed")
+_TM_COMPILE_S = TM.REGISTRY.counter(
+    "tpuq_kernel_compile_seconds_total",
+    "seconds spent in dispatches that triggered an XLA compile")
+TM.REGISTRY.gauge(
+    "tpuq_kernel_cache_size", "live cached kernel wrappers",
+    fn=lambda: len(_CACHE))
 
 
 def fingerprint(v) -> object:
@@ -51,36 +68,45 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
     an attribute check when disarmed, a configured raise when armed."""
     with _CACHE_LOCK:
         fn = _CACHE.get(key)
-        if fn is None:
-            jfn = jax.jit(builder())
+        if fn is not None:
+            _TM_HITS.inc()
+            return fn
+        _TM_MISSES.inc()
+        jfn = jax.jit(builder())
 
-            def _call(args, kw, __jfn=jfn):
-                if INJECTOR.armed:
-                    def call():
-                        INJECTOR.on_execute()
-                        return __jfn(*args, **kw)
-                    return retry_device_call(call)
-                return __jfn(*args, **kw)
+        def _call(args, kw, __jfn=jfn):
+            if INJECTOR.armed:
+                def call():
+                    INJECTOR.on_execute()
+                    return __jfn(*args, **kw)
+                return retry_device_call(call)
+            return __jfn(*args, **kw)
 
-            def fn(*args, __jfn=jfn, **kw):
-                tr = trace.current()
-                if tr is None:
-                    return _call(args, kw)
-                # jax.jit compiles lazily at first call per shape bucket;
-                # the cache-size delta distinguishes an XLA compile from
-                # a hot dispatch, so compiles show as their own stage
-                before = (__jfn._cache_size()
-                          if hasattr(__jfn, "_cache_size") else None)
-                sp = tr.begin("Kernel", "kernel")
-                try:
-                    return _call(args, kw)
-                finally:
-                    if (before is not None
-                            and __jfn._cache_size() > before):
+        def fn(*args, __jfn=jfn, **kw):
+            tr = trace.current()
+            # jax.jit compiles lazily at first call per shape bucket;
+            # the cache-size delta distinguishes an XLA compile from a
+            # hot dispatch — compiles get their own span stage and the
+            # registry's compile count/time
+            before = (__jfn._cache_size()
+                      if hasattr(__jfn, "_cache_size") else None)
+            if tr is None and before is None:
+                return _call(args, kw)
+            t0 = time.perf_counter()
+            sp = tr.begin("Kernel", "kernel") if tr is not None else None
+            try:
+                return _call(args, kw)
+            finally:
+                if (before is not None
+                        and __jfn._cache_size() > before):
+                    _TM_COMPILES.inc()
+                    _TM_COMPILE_S.inc(time.perf_counter() - t0)
+                    if sp is not None:
                         sp.stage = "compile"
+                if sp is not None:
                     tr.end(sp)
 
-            _CACHE[key] = fn
+        _CACHE[key] = fn
         return fn
 
 

@@ -33,6 +33,24 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as T
 from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.runtime import telemetry as TM
+
+# process-cumulative counters (per-manager views live in mgr.metrics);
+# gauges pull the CURRENT manager's state at snapshot time
+_TM_RESERVE = TM.REGISTRY.counter(
+    "tpuq_hbm_reserve_bytes_total",
+    "bytes reserved against the HBM budget (cumulative)")
+_TM_SPILL_HOST = TM.REGISTRY.counter(
+    "tpuq_spill_host_bytes_total", "device→host spill bytes")
+_TM_SPILL_DISK = TM.REGISTRY.counter(
+    "tpuq_spill_disk_bytes_total", "host→disk spill bytes")
+_TM_RESTORE = TM.REGISTRY.counter(
+    "tpuq_restore_bytes_total",
+    "bytes restored to device from the host/disk spill tiers")
+_TM_RETRY_OOM = TM.REGISTRY.counter(
+    "tpuq_retry_oom_total", "RetryOOM raises (incl. injected)")
+_TM_SPLIT_RETRY = TM.REGISTRY.counter(
+    "tpuq_split_retry_total", "SplitAndRetryOOM batch halvings")
 
 
 class RetryOOM(RuntimeError):
@@ -144,6 +162,8 @@ class SpillableBatch:
         self._batch = jax.tree.unflatten(
             treedef, [jax.numpy.asarray(x) for x in leaves])
         self._host = None
+        self._mgr.metrics["restoredBytes"] += self.nbytes
+        _TM_RESTORE.inc(self.nbytes)
         if from_host and self._host_accounted:
             self._host_accounted = False
             self._mgr._on_restore(self)
@@ -190,8 +210,8 @@ class DeviceMemoryManager:
         self._alloc_count = 0
         self._inject_at = inject_oom_at
         self.metrics = {"spillToHostBytes": 0, "spillToDiskBytes": 0,
-                        "retryOOMs": 0, "splitRetries": 0,
-                        "peakReserved": 0}
+                        "restoredBytes": 0, "retryOOMs": 0,
+                        "splitRetries": 0, "peakReserved": 0}
         self.budget = budget if budget else self._detect_budget(
             alloc_fraction)
 
@@ -215,20 +235,24 @@ class DeviceMemoryManager:
             self._alloc_count += 1
             if self._inject_at >= 0 and self._alloc_count == self._inject_at:
                 self.metrics["retryOOMs"] += 1
+                _TM_RETRY_OOM.inc()
                 raise RetryOOM(
                     f"injected OOM at allocation {self._alloc_count}")
             if nbytes > self.budget:
                 self.metrics["retryOOMs"] += 1
+                _TM_RETRY_OOM.inc()
                 raise SplitAndRetryOOM(
                     f"allocation of {nbytes} B exceeds the whole budget "
                     f"({self.budget} B) — split required")
             while self._reserved + nbytes > self.budget:
                 if not self._spill_one(exclude=_restoring):
                     self.metrics["retryOOMs"] += 1
+                    _TM_RETRY_OOM.inc()
                     raise RetryOOM(
                         f"cannot reserve {nbytes} B: {self._reserved} of "
                         f"{self.budget} B reserved, nothing left to spill")
             self._reserved += nbytes
+            _TM_RESERVE.inc(nbytes)
             self.metrics["peakReserved"] = max(
                 self.metrics["peakReserved"], self._reserved)
 
@@ -305,6 +329,7 @@ class DeviceMemoryManager:
                 self.release(nbytes)
             self._host_used += nbytes
             self.metrics["spillToHostBytes"] += nbytes
+            _TM_SPILL_HOST.inc(nbytes)
             while self._host_used > self.host_limit:
                 victim = next(
                     (v for v in self._spillables.values()
@@ -316,6 +341,7 @@ class DeviceMemoryManager:
 
     def _on_disk_spill(self, s: SpillableBatch, nbytes: int) -> None:
         self.metrics["spillToDiskBytes"] += nbytes
+        _TM_SPILL_DISK.inc(nbytes)
 
     def _on_restore(self, s: SpillableBatch) -> None:
         with self._lock:
@@ -358,6 +384,26 @@ def reset_manager() -> None:
     global _manager
     with _manager_lock:
         _manager = None
+
+
+# pull-based gauges over the CURRENT manager (0 before the first query
+# builds one); producers pay nothing, the sampler reads at snapshot time
+TM.REGISTRY.gauge(
+    "tpuq_hbm_reserved_bytes", "bytes currently reserved in HBM",
+    fn=lambda: _manager._reserved if _manager is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_hbm_watermark_bytes", "peak reserved bytes (this manager)",
+    fn=lambda: (_manager.metrics["peakReserved"]
+                if _manager is not None else 0))
+TM.REGISTRY.gauge(
+    "tpuq_hbm_budget_bytes", "HBM budget the arbiter hands out",
+    fn=lambda: _manager.budget if _manager is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_host_spill_used_bytes", "host spill tier bytes in use",
+    fn=lambda: _manager._host_used if _manager is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_spillable_batches", "live registered spillable batches",
+    fn=lambda: len(_manager._spillables) if _manager is not None else 0)
 
 
 def _build(conf) -> DeviceMemoryManager:
@@ -426,6 +472,7 @@ def with_retry(
             if not allow_split:
                 raise
             mgr.metrics["splitRetries"] += 1
+            _TM_SPLIT_RETRY.inc()
             halves = split_batch_in_half(batch)
             work = [(h, attempts + 1) for h in halves] + work
         except RetryOOM:
@@ -443,6 +490,7 @@ def with_retry(
                         break
             if attempts >= 1 and allow_split and batch.capacity > 1:
                 mgr.metrics["splitRetries"] += 1
+                _TM_SPLIT_RETRY.inc()
                 halves = split_batch_in_half(batch)
                 work = [(h, attempts + 1) for h in halves] + work
             else:

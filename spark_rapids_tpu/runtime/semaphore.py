@@ -21,16 +21,33 @@ import threading
 import time
 from typing import Optional
 
+from spark_rapids_tpu.runtime import telemetry as TM
+
+_TM_WAIT = TM.REGISTRY.counter(
+    "tpuq_semaphore_wait_seconds_total",
+    "seconds tasks spent blocked on device admission (cumulative)")
+_TM_ACQUIRE = TM.REGISTRY.histogram(
+    "tpuq_semaphore_acquire_seconds",
+    "per-acquire device-admission wait")
+
 
 class DeviceSemaphore:
-    """Counting semaphore with in-place resize + wait accounting."""
+    """Counting semaphore with in-place resize + wait accounting.
+
+    ``max_holders``/``wait_time`` are *query-window* stats — the query
+    boundary (``telemetry.begin_query``) calls ``reset_query_stats`` so
+    one query's report never bleeds into the next.  The registry's
+    ``tpuq_semaphore_*`` counters and the ``peak_holders`` attribute
+    keep the process-lifetime view.
+    """
 
     def __init__(self, permits: int):
         self._cv = threading.Condition()
         self.permits = max(1, int(permits))
         self.holders = 0          # currently admitted tasks
-        self.max_holders = 0      # high-water mark (test observability)
-        self.wait_time = 0.0      # cumulative seconds spent blocked
+        self.max_holders = 0      # high-water mark (query window)
+        self.wait_time = 0.0      # seconds blocked (query window)
+        self.peak_holders = 0     # high-water mark (process lifetime)
 
     def resize(self, permits: int) -> None:
         with self._cv:
@@ -38,16 +55,31 @@ class DeviceSemaphore:
             self._cv.notify_all()
 
     def acquire(self) -> float:
-        """Block until admitted; returns seconds spent waiting."""
-        t0 = time.perf_counter()
+        """Block until admitted; returns seconds spent waiting (0.0 on
+        the uncontended fast path — only actual blocking counts, so an
+        unconstrained run reports exactly zero wait)."""
+        waited = 0.0
         with self._cv:
-            while self.holders >= self.permits:
-                self._cv.wait()
+            if self.holders >= self.permits:
+                t0 = time.perf_counter()
+                while self.holders >= self.permits:
+                    self._cv.wait()
+                waited = time.perf_counter() - t0
             self.holders += 1
             self.max_holders = max(self.max_holders, self.holders)
-            waited = time.perf_counter() - t0
+            self.peak_holders = max(self.peak_holders, self.holders)
             self.wait_time += waited
+        if waited:
+            _TM_WAIT.inc(waited)
+        _TM_ACQUIRE.observe(waited)
         return waited
+
+    def reset_query_stats(self) -> None:
+        """New query window: the high-water mark restarts from the
+        holders still admitted, the wait clock from zero."""
+        with self._cv:
+            self.max_holders = self.holders
+            self.wait_time = 0.0
 
     def release(self) -> None:
         with self._cv:
@@ -86,7 +118,25 @@ def get_semaphore(conf=None) -> DeviceSemaphore:
         return _semaphore
 
 
+def peek_semaphore() -> Optional[DeviceSemaphore]:
+    """The process semaphore if one exists — never creates (telemetry
+    must not instantiate runtime state as a side effect)."""
+    return _semaphore
+
+
 def reset_semaphore() -> None:
     global _semaphore
     with _sem_lock:
         _semaphore = None
+
+
+TM.REGISTRY.gauge(
+    "tpuq_semaphore_holders", "tasks currently holding a permit",
+    fn=lambda: _semaphore.holders if _semaphore is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_semaphore_holders_peak",
+    "process-lifetime peak concurrent holders",
+    fn=lambda: _semaphore.peak_holders if _semaphore is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_semaphore_permits", "configured concurrent-task permits",
+    fn=lambda: _semaphore.permits if _semaphore is not None else 0)

@@ -17,6 +17,7 @@ format the multi-executor rendezvous uses for its DCN fallback.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
 from typing import Iterator, List, Optional, Sequence
 
@@ -28,9 +29,23 @@ from spark_rapids_tpu.columnar.column import (
     DeviceBatch, DeviceColumn, round_up_pow2)
 from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.shuffle.manager import (
     ShuffleEnv, ShuffleReader, ShuffleWriter)
 from spark_rapids_tpu.shuffle.serializer import HostColView
+
+_TM_EXCHANGES = TM.REGISTRY.counter(
+    "tpuq_shuffle_exchanges_total",
+    "host-shuffle exchanges materialized")
+_TM_PARTITIONS = TM.REGISTRY.counter(
+    "tpuq_shuffle_partitions_total",
+    "reduce partitions produced by materialized exchanges")
+_TM_WRITE_S = TM.REGISTRY.counter(
+    "tpuq_shuffle_write_seconds_total",
+    "host-shuffle map-side write/serialize seconds")
+_TM_READ_S = TM.REGISTRY.counter(
+    "tpuq_shuffle_read_seconds_total",
+    "host-shuffle reduce-side read/deserialize seconds")
 
 
 def _host_views(batch: DeviceBatch) -> List[HostColView]:
@@ -151,6 +166,7 @@ class TpuHostShuffleExchangeExec(TpuExec):
             sid = env.new_shuffle_id()
             child = self.children[0]
             row_base = 0
+            t0 = time.perf_counter()
             with self.timer("writeTime"):
                 for m in range(child.num_partitions()):
                     writer = ShuffleWriter(env, sid, m, self.nparts,
@@ -168,6 +184,9 @@ class TpuHostShuffleExchangeExec(TpuExec):
                         self.metric("bytesWritten").add(written)
                     writer.close()
                     self._map_parts.append(m)
+            _TM_WRITE_S.inc(time.perf_counter() - t0)
+            _TM_EXCHANGES.inc()
+            _TM_PARTITIONS.inc(self.nparts)
             self._shuffle_id = sid
             # shuffle files die with the exec (query lifetime)
             weakref.finalize(self, env.remove_shuffle, sid)
@@ -202,9 +221,11 @@ class TpuHostShuffleExchangeExec(TpuExec):
         reader = ShuffleReader(env, self._shuffle_id, self._map_parts,
                                self.schema)
         records = []
+        t0 = time.perf_counter()
         with self.timer("readTime"):
             for p in parts:
                 records.extend(reader.read_partition(p))
+        _TM_READ_S.inc(time.perf_counter() - t0)
         return _concat_views(self.schema, records)
 
     def execute_pid_range(self, lo: int, hi: int

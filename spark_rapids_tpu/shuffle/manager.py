@@ -29,10 +29,21 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.shuffle.serializer import (
     HostColView, deserialize, serialize_partitions)
 
 _FILE_MAGIC = struct.pack("<I", 0x46445554)  # "TUDF"
+
+# process-cumulative mirrors of the per-env metrics dict
+_TM_SHUFFLE = {
+    "bytesWritten": TM.REGISTRY.counter(
+        "tpuq_shuffle_bytes_written_total",
+        "host-shuffle bytes serialized to map files"),
+    "bytesRead": TM.REGISTRY.counter(
+        "tpuq_shuffle_bytes_read_total",
+        "host-shuffle bytes fetched by reduce reads"),
+}
 
 
 class ShuffleEnv:
@@ -50,6 +61,9 @@ class ShuffleEnv:
     def add_metric(self, name: str, v: int) -> None:
         with self._metrics_lock:
             self.metrics[name] += v
+        tm = _TM_SHUFFLE.get(name)
+        if tm is not None:
+            tm.inc(v)
 
     @classmethod
     def get(cls) -> "ShuffleEnv":

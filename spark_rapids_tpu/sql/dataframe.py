@@ -783,11 +783,13 @@ class DataFrame:
     def toArrow(self) -> pa.Table:
         import contextlib
         from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import telemetry
         from spark_rapids_tpu.runtime import trace
         conf = self.session.rapids_conf()
         plan = self._execute_plan()
         self._last_plan = plan
         qid = trace.next_query_id()
+        qwin = telemetry.begin_query(qid)
         tracer = None
         if conf.get(C.TRACE_ENABLED):
             tracer = trace.start_query(
@@ -821,10 +823,12 @@ class DataFrame:
             raise
         finally:
             trace.end_query(tracer)
-            self._record_query(qid, tracer, conf, profile_dir, error)
+            self._record_query(qid, tracer, conf, profile_dir, error,
+                               qwin)
         return out
 
-    def _record_query(self, qid, tracer, conf, profile_dir, error):
+    def _record_query(self, qid, tracer, conf, profile_dir, error,
+                      qwin=None):
         """One event-log entry per execution: plan tree, device/fallback
         report, all metrics at their levels, span rollup, artifact
         cross-links — the reference's driver-log plan-conversion report,
@@ -861,6 +865,17 @@ class DataFrame:
         lore = str(conf.get(C.LORE_TAG))
         if lore:
             entry["lore_tag"] = lore
+        if qwin is not None:
+            # process-counter deltas this query contributed + health
+            # verdicts over them — cross-linked by the same query_id as
+            # the trace/profile artifacts
+            from spark_rapids_tpu.runtime import telemetry
+            deltas, elapsed = qwin.finish()
+            entry["telemetry"] = deltas
+            health = telemetry.evaluate_health(deltas, elapsed, conf,
+                                               query_id=qid)
+            if health:
+                entry["health"] = health
         self._last_query_entry = entry
         self.session._record_query(entry)
         log_path = str(conf.get(C.QUERY_LOG_PATH))
@@ -963,15 +978,12 @@ class DataFrame:
             with sem.hold(waited_out=waits):
                 return pump(p)
 
-        if len(parts) <= 1:
-            # single task still holds a permit — a 1-partition query must
-            # count against the concurrency cap like any other
-            chunks = [task(p) for p in parts]
-        else:
-            from concurrent.futures import ThreadPoolExecutor
-            workers = min(len(parts), max(sem.permits * 2, 4))
-            with ThreadPoolExecutor(max_workers=workers) as pool:
-                chunks = list(pool.map(task, parts))
+        # a single task still holds a permit — a 1-partition query must
+        # count against the concurrency cap like any other; the pump
+        # pool records queue depth + per-task latency either way
+        from spark_rapids_tpu.parallel.executor import run_pump_tasks
+        workers = min(len(parts), max(sem.permits * 2, 4))
+        chunks = run_pump_tasks(task, parts, max_workers=workers)
         plan.metric("semaphoreWaitTime").add(sum(waits))
         return [t for chunk in chunks for t in chunk]
 

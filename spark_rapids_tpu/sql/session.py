@@ -152,6 +152,10 @@ class TpuSession:
         from spark_rapids_tpu.parallel.executor import init_executor
         init_executor(self.conf.snapshot())
         ensure_initialized()
+        # continuous telemetry: starts the background sampler when
+        # spark.rapids.tpu.telemetry.enabled (registry updates always)
+        from spark_rapids_tpu.runtime import telemetry
+        telemetry.configure_sampler(self.conf.snapshot())
 
     # -- observability ------------------------------------------------------
     def _record_query(self, entry: Dict[str, Any]) -> None:
@@ -165,6 +169,17 @@ class TpuSession:
         JSONL records).  ``n`` limits to the most recent n."""
         h = self._query_history
         return list(h[-n:] if n else h)
+
+    def metrics_report(self) -> Dict[str, Any]:
+        """Point-in-time process telemetry: every registry counter/gauge
+        value and histogram summary (the same values the JSONL sink and
+        Prometheus dump export) plus recent health WARN events."""
+        import time as _time
+        from spark_rapids_tpu.runtime import telemetry
+        telemetry.ensure_producers()
+        return {"ts": _time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+                "metrics": telemetry.REGISTRY.snapshot(),
+                "health": telemetry.REGISTRY.recent_health()}
 
     # -- data ingestion -----------------------------------------------------
     def createDataFrame(self, data, schema=None) -> "DataFrame":

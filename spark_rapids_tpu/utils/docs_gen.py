@@ -40,17 +40,35 @@ def collect_metric_names(pkg_dir: str = None) -> set:
     return names
 
 
-def check_metrics_documented(doc_path: str = None) -> list:
-    """Metric names created in the package but missing from the
-    docs/observability.md table — run in tier-1 tests so metric drift
-    fails fast.  Returns the sorted list of undocumented names."""
+def _documented_names(doc_path: str = None) -> set:
     if doc_path is None:
         doc_path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), "docs", "observability.md")
     with open(doc_path) as f:
-        documented = set(re.findall(r"`(\w+)`", f.read()))
-    return sorted(collect_metric_names() - documented)
+        return set(re.findall(r"`(\w+)`", f.read()))
+
+
+def check_metrics_documented(doc_path: str = None) -> list:
+    """Metric names created in the package but missing from the
+    docs/observability.md table — run in tier-1 tests so metric drift
+    fails fast.  Returns the sorted list of undocumented names."""
+    return sorted(collect_metric_names() - _documented_names(doc_path))
+
+
+def collect_telemetry_names() -> set:
+    """Every process-telemetry registry metric.  Registration is
+    import-time, so the live registry after importing all producers IS
+    the exact name set (no source scan needed)."""
+    from spark_rapids_tpu.runtime import telemetry
+    telemetry.ensure_producers()
+    return set(telemetry.REGISTRY.names())
+
+
+def check_telemetry_documented(doc_path: str = None) -> list:
+    """Registry metric names missing from docs/observability.md — the
+    tier-1 drift check's process-telemetry arm."""
+    return sorted(collect_telemetry_names() - _documented_names(doc_path))
 
 
 def generate_supported_ops_md() -> str:
@@ -193,6 +211,10 @@ def main(out_dir: str = "docs"):
         missing = check_metrics_documented(obs)
         if missing:
             print(f"UNDOCUMENTED metrics (add to {obs}): {missing}")
+        missing_tm = check_telemetry_documented(obs)
+        if missing_tm:
+            print(f"UNDOCUMENTED telemetry metrics (add to {obs}): "
+                  f"{missing_tm}")
 
 
 if __name__ == "__main__":

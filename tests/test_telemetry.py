@@ -1,0 +1,481 @@
+"""Process-telemetry tests: registry semantics, Prometheus exposition,
+sampler sinks, spill/OOM accounting under injection, query windows,
+health evaluation, and the docs drift check.
+
+[REF: SURVEY §2.2 production observability; the reference's
+GpuSemaphore/SpillFramework metric assertions] — the process registry is
+global and monotonic, so assertions against it are DELTA-based:
+snapshot ``counter_values()`` before the scenario, subtract after.
+Primitive unit tests use a private ``MetricsRegistry`` so they never
+pollute the process catalog the drift check audits.
+"""
+
+import json
+import math
+import os
+import re
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.column import host_to_device
+from spark_rapids_tpu.runtime import memory as M
+from spark_rapids_tpu.runtime import semaphore as SEM
+from spark_rapids_tpu.runtime import telemetry
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.utils.harness import (
+    assert_tpu_and_cpu_are_equal_collect, tpu_session)
+
+
+@pytest.fixture(autouse=True)
+def fresh_manager():
+    M.reset_manager()
+    yield
+    M.reset_manager()
+
+
+def counters():
+    return telemetry.REGISTRY.counter_values()
+
+
+def deltas(before):
+    after = counters()
+    return {k: after[k] - before.get(k, 0) for k in after
+            if after[k] != before.get(k, 0)}
+
+
+def small_batch(seed=0, n=100):
+    rng = np.random.default_rng(seed)
+    return host_to_device(pa.table({
+        "a": pa.array(rng.integers(0, 50, n)),
+        "b": pa.array(rng.uniform(0, 1, n)),
+    }))
+
+
+def _table(n=4000):
+    rng = np.random.default_rng(7)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 23, n).astype(np.int32)),
+        "v": pa.array(rng.integers(-100, 100, n)),
+    })
+
+
+def _agg_query(s, t):
+    return (s.createDataFrame(t).groupBy("k")
+            .agg(F.sum("v").alias("sv"), F.count("*").alias("c")))
+
+
+# ---------------------------------------------------------------------------
+# registry primitives (private registry — keeps the process catalog clean)
+# ---------------------------------------------------------------------------
+
+def test_registration_is_idempotent_and_kind_checked():
+    r = telemetry.MetricsRegistry()
+    c1 = r.counter("tpuq_test_idem_total", "doc A")
+    c2 = r.counter("tpuq_test_idem_total", "doc B")
+    assert c1 is c2
+    assert c1.doc == "doc A"  # first registration wins
+    with pytest.raises(TypeError):
+        r.gauge("tpuq_test_idem_total")
+
+
+def test_counter_inc_and_snapshot():
+    r = telemetry.MetricsRegistry()
+    c = r.counter("tpuq_test_ctr_total")
+    c.inc()
+    c.inc(41)
+    assert c.value == 42
+    assert r.snapshot()["tpuq_test_ctr_total"] == 42
+    assert r.counter_values() == {"tpuq_test_ctr_total": 42}
+
+
+def test_fn_gauge_pulls_live_state_and_swallows_errors():
+    r = telemetry.MetricsRegistry()
+    box = {"v": 7}
+    g = r.gauge("tpuq_test_gauge", fn=lambda: box["v"])
+    assert g.value == 7
+    box["v"] = 9
+    assert g.value == 9
+    bad = r.gauge("tpuq_test_gauge_bad", fn=lambda: 1 / 0)
+    assert bad.value == 0  # never raises at snapshot time
+
+
+def test_histogram_buckets_percentiles_reservoir_bound():
+    h = telemetry.Histogram("h", buckets=(0.1, 1.0), reservoir=8)
+    for v in (0.05, 0.5, 0.5, 2.0):
+        h.observe(v)
+    assert h.cumulative_buckets() == [(0.1, 1), (1.0, 3),
+                                      (math.inf, 4)]
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["min"] == 0.05 and snap["max"] == 2.0
+    assert abs(snap["sum"] - 3.05) < 1e-9
+    assert snap["p50"] == 0.5
+    for v in range(100):  # reservoir stays bounded, totals keep counting
+        h.observe(float(v))
+    assert len(h._reservoir) == 8
+    assert h.count == 104
+    assert h.snapshot()["p50"] >= 90  # reservoir holds RECENT values
+
+
+def test_histogram_thread_safety():
+    h = telemetry.Histogram("ht")
+
+    def worker():
+        for _ in range(1000):
+            h.observe(0.01)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert h.count == 4000
+    assert h.cumulative_buckets()[-1] == (math.inf, 4000)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition (satellite: output must parse, no dup families)
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? '
+    r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+_META = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
+
+
+def test_prometheus_exposition_parses():
+    telemetry.ensure_producers()
+    text = telemetry.REGISTRY.prometheus_text()
+    assert text.endswith("\n")
+    families = []
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            name, kind = line.split()[2], line.split()[3]
+            families.append(name)
+            assert kind in ("counter", "gauge", "histogram")
+        if line.startswith("#"):
+            assert _META.match(line), line
+        else:
+            assert _SAMPLE.match(line), line
+    # no duplicate metric families
+    assert len(families) == len(set(families))
+    # every registered name has exactly one TYPE line
+    assert set(families) == set(telemetry.REGISTRY.names())
+
+
+def test_prometheus_histogram_series_well_formed():
+    r = telemetry.MetricsRegistry()
+    h = r.histogram("tpuq_test_prom_hist")
+    h.observe(0.002)
+    h.observe(10.0)
+    lines = [ln for ln in r.prometheus_text().splitlines()
+             if ln.startswith("tpuq_test_prom_hist")]
+    buckets = [ln for ln in lines if "_bucket" in ln]
+    # cumulative, one bucket per bound plus +Inf, +Inf == _count
+    assert len(buckets) == len(telemetry.DEFAULT_BUCKETS) + 1
+    counts = [int(ln.rsplit(" ", 1)[1]) for ln in buckets]
+    assert counts == sorted(counts)
+    assert buckets[-1].startswith(
+        'tpuq_test_prom_hist_bucket{le="+Inf"}')
+    n = int([ln for ln in lines if ln.startswith(
+        "tpuq_test_prom_hist_count")][0].split()[1])
+    assert counts[-1] == n == h.count == 2
+
+
+# ---------------------------------------------------------------------------
+# spill / OOM accounting (satellite: counters match the actual
+# spill/restore/retry sequence, including the disk tier)
+# ---------------------------------------------------------------------------
+
+def test_counters_track_spill_restore_sequence_with_disk_tier(tmp_path):
+    before = counters()
+    mgr = M.DeviceMemoryManager(budget=1 << 30,
+                                spill_path=str(tmp_path))
+    sp = M.SpillableBatch(small_batch(), mgr)
+    nb = sp.nbytes
+    sp.spill_to_host()
+    sp.spill_to_disk()
+    assert sp.tier == "disk"
+    sp.get()  # disk → device restore
+    sp.close()
+    d = deltas(before)
+    assert d.get("tpuq_hbm_reserve_bytes_total", 0) >= nb
+    assert d.get("tpuq_spill_host_bytes_total") == nb
+    assert d.get("tpuq_spill_host_bytes_total") == (
+        mgr.metrics["spillToHostBytes"])
+    assert d.get("tpuq_spill_disk_bytes_total") == (
+        mgr.metrics["spillToDiskBytes"])
+    assert d["tpuq_spill_disk_bytes_total"] > 0
+    assert d.get("tpuq_restore_bytes_total") == nb == (
+        mgr.metrics["restoredBytes"])
+    # nothing raised: no retry counters moved
+    assert "tpuq_retry_oom_total" not in d
+    assert "tpuq_split_retry_total" not in d
+
+
+def test_counters_track_retry_oom_and_split(tmp_path):
+    before = counters()
+    mgr = M.DeviceMemoryManager(budget=1000, spill_path=str(tmp_path))
+    with pytest.raises(M.SplitAndRetryOOM):
+        mgr.reserve(2000)  # bigger than the whole budget
+    mgr.reserve(800)
+    with pytest.raises(M.RetryOOM):
+        mgr.reserve(800)  # nothing registered to spill
+    d = deltas(before)
+    assert d.get("tpuq_retry_oom_total") == 2 == mgr.metrics["retryOOMs"]
+
+    mgr2 = M.DeviceMemoryManager(budget=1 << 30,
+                                 spill_path=str(tmp_path))
+    b = small_batch()
+
+    def closure(batch):
+        if batch.capacity > b.capacity // 2:
+            raise M.SplitAndRetryOOM("too big")
+        return batch.capacity
+
+    list(M.with_retry([b], closure, manager=mgr2))
+    d = deltas(before)
+    assert d.get("tpuq_split_retry_total") == 1 == (
+        mgr2.metrics["splitRetries"])
+
+
+def test_injected_oom_end_to_end_counters_match_manager():
+    before = counters()
+    conf = {"spark.rapids.tpu.test.injectOomAtAlloc": 2}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _agg_query(s, _table()), conf=conf, ignore_order=True)
+    d = deltas(before)
+    # the injected RetryOOM reached both the manager AND the registry
+    assert d.get("tpuq_retry_oom_total", 0) >= (
+        M.get_manager().metrics["retryOOMs"]) >= 1
+    # both the TPU run and the CPU oracle run open a query window
+    assert d.get("tpuq_queries_total") == 2
+
+
+def test_tiny_budget_spill_counters_match_manager():
+    t = _table()
+    batch_bytes = host_to_device(t).nbytes()
+    M.reset_manager()
+    before = counters()
+    conf = {"spark.rapids.tpu.memory.poolSize": int(batch_bytes * 1.5),
+            "spark.rapids.tpu.batchRows": 4000}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _agg_query(s, t), conf=conf, ignore_order=True)
+    d = deltas(before)
+    assert d.get("tpuq_spill_host_bytes_total", 0) >= (
+        M.get_manager().metrics["spillToHostBytes"]) > 0
+
+
+def test_unconstrained_run_moves_no_pressure_counters():
+    before = counters()
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: _agg_query(s, _table()), ignore_order=True)
+    d = deltas(before)
+    assert "tpuq_spill_host_bytes_total" not in d
+    assert "tpuq_spill_disk_bytes_total" not in d
+    assert "tpuq_retry_oom_total" not in d
+    assert "tpuq_split_retry_total" not in d
+    # uncontended acquires never count as wait (the acceptance bound:
+    # exactly zero, not just small)
+    assert "tpuq_semaphore_wait_seconds_total" not in d
+
+
+# ---------------------------------------------------------------------------
+# semaphore query-window stats (satellite: reset per query boundary)
+# ---------------------------------------------------------------------------
+
+def test_semaphore_query_stats_reset_but_lifetime_counters_keep():
+    SEM.reset_semaphore()
+    sem = SEM.get_semaphore()
+    before = counters()
+    with sem.hold():
+        with sem.hold():
+            pass
+    assert sem.max_holders == 2 and sem.peak_holders == 2
+    # next query boundary: window stats restart, lifetime peak stays
+    telemetry.begin_query(999)
+    assert sem.max_holders == 0 and sem.wait_time == 0.0
+    assert sem.peak_holders == 2
+    with sem.hold():
+        pass
+    assert sem.max_holders == 1 and sem.peak_holders == 2
+    d = deltas(before)
+    assert d.get("tpuq_queries_total") == 1
+    SEM.reset_semaphore()
+
+
+def test_semaphore_wait_counted_only_when_blocked():
+    SEM.reset_semaphore()
+    sem = SEM.get_semaphore()
+    sem.resize(1)
+    before = counters()
+    order = []
+
+    def blocked():
+        with sem.hold():
+            order.append("second")
+
+    sem.acquire()
+    t = threading.Thread(target=blocked)
+    t.start()
+    time.sleep(0.05)  # let it block on the held permit
+    sem.release()
+    t.join(timeout=5)
+    assert order == ["second"]
+    d = deltas(before)
+    assert d.get("tpuq_semaphore_wait_seconds_total", 0) > 0
+    assert abs(sem.wait_time - d["tpuq_semaphore_wait_seconds_total"]) \
+        < 1e-6
+    SEM.reset_semaphore()
+
+
+# ---------------------------------------------------------------------------
+# query windows, event-log integration, health evaluation
+# ---------------------------------------------------------------------------
+
+def test_query_entry_carries_telemetry_deltas(tmp_path):
+    log = str(tmp_path / "qlog.jsonl")
+    s = tpu_session({"spark.rapids.sql.queryLog.path": log})
+    _agg_query(s, _table()).toArrow()
+    entry = s.query_history()[-1]
+    tm = entry["telemetry"]
+    assert tm.get("tpuq_hbm_reserve_bytes_total", 0) > 0
+    assert all(v > 0 for v in tm.values())  # only CHANGED counters
+    # the JSONL record carries the same deltas, cross-linked by id
+    with open(log) as f:
+        logged = json.loads(f.read().splitlines()[-1])
+    assert logged["query_id"] == entry["query_id"]
+    assert logged["telemetry"] == tm
+
+
+def test_spill_ratio_breach_emits_health_warn_into_query_log(tmp_path):
+    t = _table()
+    batch_bytes = host_to_device(t).nbytes()
+    log = str(tmp_path / "qlog.jsonl")
+    warns0 = counters()["tpuq_health_warn_total"]
+    s = tpu_session({
+        "spark.rapids.tpu.memory.poolSize": int(batch_bytes * 1.5),
+        "spark.rapids.tpu.batchRows": 4000,
+        "spark.rapids.tpu.telemetry.health.spillRatio": 1e-9,
+        "spark.rapids.sql.queryLog.path": log,
+    })
+    _agg_query(s, t).toArrow()
+    entry = s.query_history()[-1]
+    checks = {h["check"] for h in entry["health"]}
+    assert "spill_ratio" in checks
+    ev = [h for h in entry["health"] if h["check"] == "spill_ratio"][0]
+    assert ev["severity"] == "WARN"
+    assert ev["query_id"] == entry["query_id"]
+    assert ev["value"] > ev["threshold"]
+    # landed in the JSONL log AND the registry's recent-health ring
+    with open(log) as f:
+        logged = json.loads(f.read().splitlines()[-1])
+    assert logged["health"] == entry["health"]
+    assert ev in s.metrics_report()["health"]
+    assert counters()["tpuq_health_warn_total"] > warns0
+
+
+def test_healthy_query_emits_no_health_key():
+    s = tpu_session({})
+    _agg_query(s, _table()).toArrow()
+    assert "health" not in s.query_history()[-1]
+
+
+def test_evaluate_health_semaphore_and_compile_checks():
+    from spark_rapids_tpu.conf import RapidsConf
+    conf = RapidsConf({
+        "spark.rapids.tpu.telemetry.health.semaphoreWaitRatio": 0.5,
+        "spark.rapids.tpu.telemetry.health.compileStorm": 3,
+    })
+    events = telemetry.evaluate_health(
+        {"tpuq_semaphore_wait_seconds_total": 0.9,
+         "tpuq_kernel_compile_total": 10},
+        elapsed_s=1.0, conf=conf, query_id=42)
+    checks = {e["check"]: e for e in events}
+    assert set(checks) == {"semaphore_saturation", "compile_storm"}
+    assert checks["semaphore_saturation"]["value"] == 0.9
+    assert checks["compile_storm"]["value"] == 10
+    assert all(e["query_id"] == 42 for e in events)
+    # below threshold → silence
+    assert telemetry.evaluate_health(
+        {"tpuq_semaphore_wait_seconds_total": 0.1},
+        elapsed_s=1.0, conf=conf) == []
+
+
+def test_metrics_report_matches_registry():
+    s = tpu_session({})
+    _agg_query(s, _table()).toArrow()
+    rep = s.metrics_report()
+    snap = telemetry.REGISTRY.snapshot()
+    for name in ("tpuq_queries_total", "tpuq_hbm_reserve_bytes_total",
+                 "tpuq_kernel_cache_hits_total"):
+        assert rep["metrics"][name] == snap[name]
+    assert rep["metrics"]["tpuq_queries_total"] >= 1
+    # histograms present as summary dicts
+    assert "count" in rep["metrics"]["tpuq_semaphore_acquire_seconds"]
+    # and the prom dump exports the same families
+    line = [ln for ln in telemetry.REGISTRY.prometheus_text().splitlines()
+            if ln.startswith("tpuq_queries_total ")][0]
+    assert int(line.split()[1]) >= rep["metrics"]["tpuq_queries_total"]
+
+
+# ---------------------------------------------------------------------------
+# sampler + sinks
+# ---------------------------------------------------------------------------
+
+def test_sampler_writes_jsonl_and_prom_sinks(tmp_path):
+    sink = str(tmp_path / "ts" / "metrics.jsonl")
+    prom = str(tmp_path / "ts" / "metrics.prom")
+    s = tpu_session({
+        "spark.rapids.tpu.telemetry.enabled": True,
+        "spark.rapids.tpu.telemetry.samplePeriodMs": 20,
+        "spark.rapids.tpu.telemetry.sinkPath": sink,
+        "spark.rapids.tpu.telemetry.promPath": prom,
+    })
+    try:
+        _agg_query(s, _table()).toArrow()
+        lines = []
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if os.path.exists(sink) and os.path.exists(prom):
+                with open(sink) as f:
+                    lines = f.read().splitlines()
+                if len(lines) >= 2:
+                    break
+            time.sleep(0.02)
+        assert len(lines) >= 2
+        recs = [json.loads(ln) for ln in lines]
+        for r in recs:
+            assert {"ts", "unix_ms", "metrics"} <= set(r)
+        assert recs[-1]["unix_ms"] >= recs[0]["unix_ms"]
+        # the time series converges on the live registry value
+        assert (recs[-1]["metrics"]["tpuq_queries_total"]
+                <= telemetry.REGISTRY.snapshot()["tpuq_queries_total"])
+        with open(prom) as f:
+            text = f.read()
+        assert "# TYPE tpuq_queries_total counter" in text
+        assert not os.path.exists(prom + ".tmp")  # atomic rewrite
+    finally:
+        telemetry.stop_sampler()
+
+
+def test_configure_sampler_disabled_is_noop_and_flush_never_raises(
+        tmp_path):
+    from spark_rapids_tpu.conf import RapidsConf
+    telemetry.stop_sampler()
+    assert telemetry.configure_sampler(RapidsConf({})) is None
+    # sink IO failure is swallowed (observability never fails the query)
+    blocked = tmp_path / "dir"
+    blocked.mkdir()
+    telemetry.flush_sinks(str(blocked), str(blocked))
+
+
+# ---------------------------------------------------------------------------
+# docs drift (satellite: registry names must be documented)
+# ---------------------------------------------------------------------------
+
+def test_all_registry_metrics_documented():
+    from spark_rapids_tpu.utils.docs_gen import check_telemetry_documented
+    assert check_telemetry_documented() == []
