@@ -1085,13 +1085,22 @@ def _sf1_query_main(name: str) -> None:
     try:
         from spark_rapids_tpu.runtime import memory as M
         mm = M.get_manager().metrics
+        # resilience counters ride along: retries per failure domain,
+        # exhaustions, breaker trips, host-degraded ops (all zero on a
+        # healthy run — nonzero flags flaky hardware/IO in the record)
+        from spark_rapids_tpu.runtime import resilience as RES
+        rs = RES.counters_snapshot()
         print("TPCH_SF1_MEMORY=" + json.dumps({
             "peak_hbm_bytes": mm["peakReserved"],
             "spill_host_bytes": mm["spillToHostBytes"],
             "spill_disk_bytes": mm["spillToDiskBytes"],
             "restored_bytes": mm["restoredBytes"],
             "retry_ooms": mm["retryOOMs"],
-            "split_retries": mm["splitRetries"]}))
+            "split_retries": mm["splitRetries"],
+            "retries_by_domain": rs["retries"],
+            "retry_exhausted": rs["retry_exhausted"],
+            "breaker_trips": rs["breaker_trips"],
+            "host_degraded_ops": rs["host_degraded_ops"]}))
     except Exception as e:  # diagnostics must never fail the run
         print(f"TPCH_SF1_MEMORY_ERR={e}")
     # the honest progress meter for operator breadth: how much of this

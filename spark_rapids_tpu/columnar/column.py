@@ -435,21 +435,28 @@ def host_to_device(table: pa.Table, bucket: Optional[int] = None,
 def device_to_host(batch: DeviceBatch, already_compact: bool = False) -> pa.Table:
     """DeviceBatch -> pyarrow.Table (compacts first).
 
-    The fault injector's transfer chokepoint wraps the WHOLE transfer
-    body, so a transient injected fault retries the actual D2H — the
-    recovery the shim exists to prove [REF: faultinj analog, N15]."""
-    from spark_rapids_tpu.runtime.faultinj import (
-        INJECTOR, retry_device_call)
-    if INJECTOR.armed:
-        def call():
-            INJECTOR.on_transfer()
+    The ``transfer`` failure domain wraps the WHOLE transfer body, so a
+    transient injected fault retries the actual D2H — the recovery the
+    shim exists to prove [REF: faultinj analog, N15].  Retry exhaustion
+    degrades to the plain synchronous pull path (no overlapped async
+    prefetch)."""
+    from spark_rapids_tpu.runtime import resilience as R
+    if R.active():
+        def attempt():
+            R.INJECTOR.on("transfer")
             return _device_to_host_impl(batch, already_compact)
-        return retry_device_call(call)
+
+        def degrade():
+            return _device_to_host_impl(batch, already_compact,
+                                        prefetch=False)
+
+        return R.run_guarded("transfer", attempt, op="device_to_host",
+                             degrade=degrade)
     return _device_to_host_impl(batch, already_compact)
 
 
-def _device_to_host_impl(batch: DeviceBatch,
-                         already_compact: bool) -> pa.Table:
+def _device_to_host_impl(batch: DeviceBatch, already_compact: bool,
+                         prefetch: bool = True) -> pa.Table:
     """All device buffers are pulled with ONE overlapped transfer round
     trip: sequential ``np.asarray`` pulls cost a full device round trip
     EACH (measured ~40-90 ms per pull through the axon tunnel), so every
@@ -467,11 +474,12 @@ def _device_to_host_impl(batch: DeviceBatch,
             bufs.append(c.lengths)
         if c.evalid is not None:
             bufs.append(c.evalid)
-    from spark_rapids_tpu.shims import get_shim
-    shim = get_shim()
-    for b in bufs:
-        if not shim.async_copy_to_host(b):
-            break
+    if prefetch:
+        from spark_rapids_tpu.shims import get_shim
+        shim = get_shim()
+        for b in bufs:
+            if not shim.async_copy_to_host(b):
+                break
     n = int(np.count_nonzero(np.asarray(batch.sel)))
     arrays = []
     names = []

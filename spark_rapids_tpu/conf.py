@@ -288,11 +288,67 @@ SPILL_PATH = (
 
 RETRY_MAX = (
     conf("spark.rapids.tpu.retry.maxAttempts")
-    .doc("Max OOM retry attempts per closure before the task fails "
+    .doc("Max retry attempts per device/IO step before the engine gives "
+         "up (OOM retries in the memory arbiter and every resilience "
+         "failure domain share this one policy) "
          "[REF: RmmRapidsRetryIterator.scala :: withRetry].")
     .category("memory")
     .integer()
+    .check(lambda v: v >= 1, "at least 1")
     .create_with_default(8)
+)
+
+RETRY_BACKOFF_BASE_MS = (
+    conf("spark.rapids.tpu.retry.backoffBaseMs")
+    .doc("Base delay for the retry policy's exponential backoff: attempt "
+         "n sleeps ~base*2^(n-1) ms (capped by retry.backoffMaxMs, "
+         "scaled by deterministic seeded jitter). 0 disables sleeping.")
+    .category("memory")
+    .double()
+    .check(lambda v: v >= 0.0, "non-negative")
+    .create_with_default(5.0)
+)
+
+RETRY_BACKOFF_MAX_MS = (
+    conf("spark.rapids.tpu.retry.backoffMaxMs")
+    .doc("Upper bound on a single retry backoff sleep in milliseconds.")
+    .category("memory")
+    .double()
+    .check(lambda v: v >= 0.0, "non-negative")
+    .create_with_default(1000.0)
+)
+
+RETRY_JITTER_SEED = (
+    conf("spark.rapids.tpu.retry.jitterSeed")
+    .doc("Seed for the retry policy's backoff jitter. Jitter is a pure "
+         "function of (seed, domain, attempt), so a run is exactly "
+         "reproducible under the same seed.")
+    .category("memory")
+    .integer()
+    .create_with_default(0)
+)
+
+RETRY_BUDGET_PER_QUERY = (
+    conf("spark.rapids.tpu.retry.budgetPerQuery")
+    .doc("Total retries one query may spend across every failure domain "
+         "before further faults are treated as exhausted (degrade or "
+         "fail instead of retry-storming). 0 disables the budget.")
+    .category("memory")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(64)
+)
+
+RETRY_HOST_DEGRADE = (
+    conf("spark.rapids.tpu.retry.hostDegrade.enabled")
+    .doc("On retry exhaustion in a degradable failure domain (execute, "
+         "transfer, compile, spill_write, collective), trip the per-op "
+         "circuit breaker and re-run the step on the host path instead "
+         "of failing the query. Disable to surface a domain-tagged "
+         "terminal error instead.")
+    .category("memory")
+    .boolean()
+    .create_with_default(True)
 )
 
 SHUFFLE_MODE = (
@@ -622,13 +678,47 @@ INJECT_TRANSFER_AT = (
 
 INJECT_TRANSIENT_COUNT = (
     conf("spark.rapids.tpu.test.injectTransientCount")
-    .doc("How many injected device errors are transient (retried once "
-         "by the engine) before they turn terminal.")
+    .doc("How many injected device errors are transient (recoverable by "
+         "the retry policy) before they turn terminal. Legacy alias for "
+         "the execute/transfer domains' inject.<domain>.transientCount.")
     .category("test")
     .internal()
     .integer()
     .create_with_default(0)
 )
+
+# Engine failure domains — every device/IO boundary the resilience layer
+# guards.  Each domain gets an independently armable injection pair:
+# ``spark.rapids.tpu.test.inject.<domain>.at`` (fire from the Nth call
+# on; -1 disables) and ``.transientCount`` (transient fires before the
+# fault turns terminal / the domain disarms).
+FAILURE_DOMAINS = ("execute", "transfer", "alloc", "spill_write",
+                   "spill_read", "shuffle_ser", "shuffle_exchange",
+                   "collective", "compile")
+
+INJECT_DOMAIN_AT: Dict[str, ConfEntry] = {}
+INJECT_DOMAIN_TRANSIENT: Dict[str, ConfEntry] = {}
+for _dom in FAILURE_DOMAINS:
+    INJECT_DOMAIN_AT[_dom] = (
+        conf(f"spark.rapids.tpu.test.inject.{_dom}.at")
+        .doc(f"Arm the '{_dom}' failure domain: raise an injected fault "
+             "from its Nth call on (resilience test hook, the faultinj "
+             "analog). -1 disables.")
+        .category("test")
+        .internal()
+        .integer()
+        .create_with_default(-1)
+    )
+    INJECT_DOMAIN_TRANSIENT[_dom] = (
+        conf(f"spark.rapids.tpu.test.inject.{_dom}.transientCount")
+        .doc(f"How many '{_dom}' injected faults are transient before "
+             "they turn terminal (0 = the first fire is terminal).")
+        .category("test")
+        .internal()
+        .integer()
+        .create_with_default(0)
+    )
+del _dom
 
 TELEMETRY_ENABLED = (
     conf("spark.rapids.tpu.telemetry.enabled")

@@ -167,6 +167,8 @@ class TpuIciShuffleExchangeExec(TpuExec):
         self.min_bucket = min_bucket
         self._result: Optional[DeviceBatch] = None
         self._empty = False
+        # set when the collective degraded to the host-shuffle transport
+        self._host_fallback = None
         import threading
         self._mat_lock = threading.Lock()
         # multi-executor mode: rendezvous-coordinated collective entry.
@@ -201,7 +203,8 @@ class TpuIciShuffleExchangeExec(TpuExec):
             return self._materialize_locked()
 
     def _materialize_locked(self) -> Optional[DeviceBatch]:
-        if self._result is not None or self._empty:
+        if (self._result is not None or self._empty
+                or self._host_fallback is not None):
             return self._result
         if self._ctx is not None:
             return self._materialize_multiproc()
@@ -266,10 +269,48 @@ class TpuIciShuffleExchangeExec(TpuExec):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,
                         self._shuffle_builder(cap))
-                    self._result = shuffle_fn(sharded, *aux)
+                    self._result = self._run_collective(
+                        shuffle_fn, sharded, aux)
                 _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
                 _TM_ICI_BYTES.inc(sharded.nbytes())
         return self._result
+
+    # -- resilience: the ``collective`` failure domain ----------------------
+    def _run_collective(self, shuffle_fn, sharded, aux):
+        """Dispatch the all-to-all through the ``collective`` failure
+        domain.  Single-process retry exhaustion degrades to the
+        host-path shuffle transport over the same child (the works-
+        everywhere fallback); multi-executor collectives fail together
+        with a domain-tagged error — one process degrading alone would
+        deadlock the others at the rendezvous."""
+        from spark_rapids_tpu.runtime import resilience as R
+
+        def attempt():
+            R.INJECTOR.on("collective")
+            return shuffle_fn(sharded, *aux)
+
+        out = R.run_guarded("collective", attempt, op=self.node_string(),
+                            degrade=self._host_degrade_fn())
+        if self._host_fallback is not None:
+            return None
+        return out
+
+    def _host_degrade_fn(self):
+        """The degradation callable, or None when this exchange cannot
+        degrade (multi-executor; RANGE overrides to None too — a hash
+        host shuffle would break its total-order contract)."""
+        if self._ctx is not None:
+            return None
+
+        def degrade():
+            from spark_rapids_tpu.shuffle.exchange import (
+                TpuHostShuffleExchangeExec)
+            self._host_fallback = TpuHostShuffleExchangeExec(
+                self.children[0], self.nparts, keys=self.keys,
+                min_bucket=self.min_bucket)
+            return None
+
+        return degrade
 
     # -- pid-program hooks (overridden by the RANGE exchange) ---------------
     def _base_key(self, schema) -> tuple:
@@ -409,13 +450,19 @@ class TpuIciShuffleExchangeExec(TpuExec):
                     shuffle_fn = cached_kernel(
                         ("ici_shuffle", cap) + base_key,
                         self._shuffle_builder(cap))
-                    self._result = shuffle_fn(sharded, *aux)
+                    self._result = self._run_collective(
+                        shuffle_fn, sharded, aux)
                 _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
                 _TM_ICI_BYTES.inc(sharded.nbytes())
         return self._result
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         result = self._materialize()
+        if self._host_fallback is not None:
+            # collective degraded: serve this partition through the
+            # host-shuffle transport (same hash kernel, same row set)
+            yield from self._host_fallback.execute(partition)
+            return
         if result is None:
             return
         # partition p's received rows live on device p's shard — extract
@@ -455,6 +502,11 @@ class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
         from spark_rapids_tpu.runtime.kernel_cache import fingerprint
         return ("range", self.nparts, fingerprint(list(self.orders)),
                 fingerprint(schema))
+
+    def _host_degrade_fn(self):
+        # the host transport hash-partitions; range partitions carry a
+        # total-order contract a hash shuffle would silently break
+        return None
 
     def _sample_bounds(self, sharded) -> List[np.ndarray]:
         """Per-limb boundary arrays uint64[nparts-1]: sample local

@@ -34,6 +34,7 @@ from spark_rapids_tpu.columnar.column import (
     DeviceBatch, DeviceColumn, round_up_pow2)
 from spark_rapids_tpu.ops import hashing as HH
 from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.parallel.mesh import shard_map
 from spark_rapids_tpu.runtime import telemetry as TM
 
 # one increment per SPMD program *build* — each build is a fresh XLA
@@ -207,7 +208,7 @@ def build_range_count_program(mesh: jax.sharding.Mesh, orders,
     spec = jax.sharding.PartitionSpec(axis)
     rep = jax.sharding.PartitionSpec()
     _TM_ICI_PROGRAMS.inc()
-    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, rep),
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(spec, rep),
                                  out_specs=spec))
 
 
@@ -226,7 +227,7 @@ def build_range_shuffle_program(mesh: jax.sharding.Mesh, orders,
     spec = jax.sharding.PartitionSpec(axis)
     rep = jax.sharding.PartitionSpec()
     _TM_ICI_PROGRAMS.inc()
-    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec, rep),
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(spec, rep),
                                  out_specs=spec))
 
 
@@ -241,7 +242,7 @@ def build_count_program(mesh: jax.sharding.Mesh, keys, nparts: int,
 
     spec = jax.sharding.PartitionSpec(axis)
     _TM_ICI_PROGRAMS.inc()
-    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,),
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(spec,),
                                  out_specs=spec))
 
 
@@ -257,7 +258,7 @@ def build_shuffle_program(mesh: jax.sharding.Mesh, keys, nparts: int,
 
     spec = jax.sharding.PartitionSpec(axis)
     _TM_ICI_PROGRAMS.inc()
-    return jax.jit(jax.shard_map(step, mesh=mesh, in_specs=(spec,),
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(spec,),
                                  out_specs=spec))
 
 

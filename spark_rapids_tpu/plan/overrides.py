@@ -626,7 +626,7 @@ def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     # keys + OOM fault injection) before any device materialization
     from spark_rapids_tpu.runtime.memory import get_manager
     get_manager(conf)
-    from spark_rapids_tpu.runtime.faultinj import configure_from_conf
+    from spark_rapids_tpu.runtime.resilience import configure_from_conf
     configure_from_conf(conf)
     _register_lazy_rules()
     metas: List[ExecMeta] = []

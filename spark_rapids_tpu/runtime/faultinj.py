@@ -1,145 +1,29 @@
-"""Device-call fault injection — the resilience test shim.
+"""Back-compat shim over the unified resilience layer.
 
-[REF: spark-rapids-jni :: src/main/cpp/faultinj/ — an LD_PRELOAD CUDA
- interceptor forcing errors for resilience tests; SURVEY §2.2 N15] —
-the TPU analog intercepts the engine's two device-call chokepoints
-(kernel execution via runtime/kernel_cache.py, device→host transfer via
-columnar/column.py) and raises a configured fault at the Nth call:
+The original two-chokepoint fault injector (kernel execute + D2H
+transfer) grew into ``runtime/resilience.py``'s nine-domain registry
+with a conf-driven retry policy and circuit breakers.  This module
+keeps the historical import surface alive:
 
-* ``spark.rapids.tpu.test.injectExecuteErrorAt`` — from the Nth kernel
-  call on, raise ``InjectedDeviceError``: ``injectTransientCount``
-  transient fires (proving retry recovery, or retry exhaustion when the
-  budget exceeds the attempts), else one terminal fire.
-* ``spark.rapids.tpu.test.injectTransferErrorAt`` — same for D2H
-  transfers.
+* ``INJECTOR`` — the process injector (now the domain registry).
+* ``InjectedDeviceError`` — raised by armed chokepoints.
+* ``configure_from_conf`` — arming entry point (legacy
+  ``injectExecuteErrorAt``/``injectTransferErrorAt``/
+  ``injectTransientCount`` keys still map onto the execute/transfer
+  domains).
+* ``retry_device_call`` — retries transient injected faults with
+  attempts taken from ``spark.rapids.tpu.retry.maxAttempts`` (the old
+  hardcoded ``max_attempts=2`` ignored that conf).
 
-State is process-global (like the reference's interceptor); an armed
-chokepoint self-disarms once its fires are spent, and a conf without
-injection keys never touches another session's armed state.
+[REF: spark-rapids-jni :: src/main/cpp/faultinj/; SURVEY §2.2 N15]
 """
 
 from __future__ import annotations
 
-import threading
-from typing import Optional
+from spark_rapids_tpu.runtime.resilience import (  # noqa: F401
+    INJECTOR, FaultInjector, InjectedDeviceError, TerminalDeviceError,
+    configure_from_conf, retry_device_call)
 
-
-class InjectedDeviceError(RuntimeError):
-    """A fault-injected device error (execute or transfer)."""
-
-    def __init__(self, where: str, nth: int, transient: bool):
-        super().__init__(
-            f"injected {where} error at call #{nth} "
-            f"({'transient' if transient else 'terminal'})")
-        self.where = where
-        self.transient = transient
-
-
-class _Injector:
-    """Firing model: once a chokepoint's call count reaches its
-    configured N it starts firing.  With ``transient_count == 0`` the
-    fire is terminal and the chokepoint disarms.  With a budget K > 0,
-    K consecutive calls fire transient and then the chokepoint disarms
-    — K = 1 proves single-retry recovery; K ≥ the engine's retry
-    attempts models a persistent fault (retries exhaust and the
-    transient error propagates).  Disarming on exhaustion means an
-    armed injection never leaks into later queries."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self.reset()
-
-    def reset(self) -> None:
-        with self._lock:
-            self._config = None
-            self._exec_at = -1
-            self._transfer_at = -1
-            self._transient_budget = 0
-            self._exec_count = 0
-            self._transfer_count = 0
-            self._transients_fired = 0
-
-    def configure(self, exec_at: int, transfer_at: int,
-                  transient_count: int) -> None:
-        with self._lock:
-            self._config = (int(exec_at), int(transfer_at),
-                            int(transient_count))
-            self._exec_at = int(exec_at)
-            self._transfer_at = int(transfer_at)
-            self._transient_budget = int(transient_count)
-            self._exec_count = 0
-            self._transfer_count = 0
-            self._transients_fired = 0
-
-    @property
-    def armed(self) -> bool:
-        return self._exec_at >= 0 or self._transfer_at >= 0
-
-    def _disarm(self, where: str) -> None:
-        if where == "execute":
-            self._exec_at = -1
-        else:
-            self._transfer_at = -1
-
-    def _fire(self, where: str, n: int) -> None:
-        transient = self._transients_fired < self._transient_budget
-        if transient:
-            self._transients_fired += 1
-            if self._transients_fired >= self._transient_budget:
-                self._disarm(where)  # budget spent: later calls pass
-        else:
-            self._disarm(where)  # terminal
-        raise InjectedDeviceError(where, n, transient)
-
-    def on_execute(self) -> None:
-        if self._exec_at < 0:
-            return
-        with self._lock:
-            self._exec_count += 1
-            if 0 <= self._exec_at <= self._exec_count:
-                self._fire("execute", self._exec_count)
-
-    def on_transfer(self) -> None:
-        if self._transfer_at < 0:
-            return
-        with self._lock:
-            self._transfer_count += 1
-            if 0 <= self._transfer_at <= self._transfer_count:
-                self._fire("transfer", self._transfer_count)
-
-
-INJECTOR = _Injector()
-
-
-def configure_from_conf(conf) -> None:
-    """Arm from an injection-carrying conf; reconfigure only when the
-    requested config CHANGES.  A conf with the keys at their defaults
-    never touches the injector — concurrent clean sessions (planning,
-    explain()) must not disarm another session's armed injection.
-    Disarm happens via terminal self-disarm or ``INJECTOR.reset()``."""
-    from spark_rapids_tpu import conf as C
-    ex = int(conf.get(C.INJECT_EXECUTE_AT))
-    tr = int(conf.get(C.INJECT_TRANSFER_AT))
-    tc = int(conf.get(C.INJECT_TRANSIENT_COUNT))
-    if ex < 0 and tr < 0:
-        return
-    # reconfigure on a CHANGED config, or re-arm an identical config
-    # whose fires are fully spent (per-query determinism) — but never
-    # while any chokepoint of the current config is still armed, which
-    # would reset another in-flight query's injection pattern
-    if INJECTOR._config != (ex, tr, tc) or not INJECTOR.armed:
-        INJECTOR.configure(ex, tr, tc)
-
-
-def retry_device_call(fn, *args, max_attempts: int = 2, **kw):
-    """Run a device call, retrying transient injected faults once —
-    the engine-side policy the reference's faultinj exercises
-    [REF: SURVEY §5.3 failure-detection policy]."""
-    attempt = 0
-    while True:
-        attempt += 1
-        try:
-            return fn(*args, **kw)
-        except InjectedDeviceError as e:
-            if not e.transient or attempt >= max_attempts:
-                raise
+__all__ = ["INJECTOR", "FaultInjector", "InjectedDeviceError",
+           "TerminalDeviceError", "configure_from_conf",
+           "retry_device_call"]

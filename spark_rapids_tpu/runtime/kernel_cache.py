@@ -18,9 +18,9 @@ from typing import Callable, Dict, Tuple
 
 import jax
 
+from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.runtime import trace
-from spark_rapids_tpu.runtime.faultinj import INJECTOR, retry_device_call
 
 _CACHE: Dict[tuple, Callable] = {}
 # partitions pump on a thread pool: without a lock, racing threads each
@@ -59,28 +59,63 @@ def fingerprint(v) -> object:
     return repr(v)
 
 
+def _build_wrapper(key: tuple, builder: Callable[[], Callable]):
+    """jit the built kernel through the ``compile`` failure domain.
+
+    The chokepoint fires at jit-wrapper construction (the cache-miss
+    boundary every XLA compile passes).  Degradation returns the raw
+    un-jitted builder output — eager per-op dispatch instead of one
+    compiled executable."""
+    if not R.active():
+        return jax.jit(builder())
+
+    def attempt():
+        R.INJECTOR.on("compile")
+        return jax.jit(builder())
+
+    def degrade():
+        return builder()
+
+    return R.run_guarded("compile", attempt, op=_op_label(key),
+                         degrade=degrade)
+
+
+def _op_label(key: tuple) -> str:
+    head = key[0] if key else "kernel"
+    return head if isinstance(head, str) else repr(head)
+
+
 def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
     """Return the jitted kernel for key, building+jitting it on first use.
 
     jax.jit itself is lazy (tracing happens at first call), so holding the
     lock across build+insert is cheap.  Every call passes the fault
     injector's execute chokepoint [REF: faultinj analog, SURVEY N15] —
-    an attribute check when disarmed, a configured raise when armed."""
+    an attribute check when disarmed, a policy-guarded call when armed
+    (or when this op's breaker is already open).  Exhausted retries
+    degrade to re-running the op's builder eagerly, outside the failing
+    compiled executable."""
     with _CACHE_LOCK:
         fn = _CACHE.get(key)
         if fn is not None:
             _TM_HITS.inc()
             return fn
         _TM_MISSES.inc()
-        jfn = jax.jit(builder())
+        jfn = _build_wrapper(key, builder)
 
-        def _call(args, kw, __jfn=jfn):
-            if INJECTOR.armed:
-                def call():
-                    INJECTOR.on_execute()
-                    return __jfn(*args, **kw)
-                return retry_device_call(call)
-            return __jfn(*args, **kw)
+        def _call(args, kw, __jfn=jfn, __key=key, __builder=builder):
+            if not R.active():
+                return __jfn(*args, **kw)
+
+            def attempt():
+                R.INJECTOR.on("execute")
+                return __jfn(*args, **kw)
+
+            def degrade():
+                return __builder()(*args, **kw)
+
+            return R.run_guarded("execute", attempt,
+                                 op=_op_label(__key), degrade=degrade)
 
         def fn(*args, __jfn=jfn, **kw):
             tr = trace.current()

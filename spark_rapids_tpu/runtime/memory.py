@@ -33,6 +33,7 @@ import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as T
 from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
 
 # process-cumulative counters (per-manager views live in mgr.metrics);
@@ -83,6 +84,9 @@ class SpillableBatch:
         # from already-materialized inputs) must not release bytes they
         # never claimed
         self._device_accounted = reserve
+        # set when a disk spill degraded (stayed in the host tier); the
+        # host-limit eviction loop must skip such victims or it spins
+        self._disk_spill_failed = False
         self.schema = batch.schema
         self.compacted = batch.compacted
         self.nbytes = batch.nbytes()
@@ -123,14 +127,30 @@ class SpillableBatch:
         return self.nbytes
 
     def spill_to_disk(self) -> int:
-        """Host → disk.  Returns host bytes freed."""
+        """Host → disk through the ``spill_write`` failure domain.
+        Returns host bytes freed (0 when the write degraded — the batch
+        stays in the host tier, marked so the eviction loop skips it)."""
         if self._host is None:
             return 0
         leaves, treedef = self._host
         os.makedirs(self._mgr.spill_path, exist_ok=True)
         path = os.path.join(self._mgr.spill_path,
                             f"spill-{uuid.uuid4().hex}.npz")
-        np.savez(path, *leaves)
+
+        def attempt():
+            R.INJECTOR.on("spill_write")
+            np.savez(path, *leaves)
+            return True
+
+        def degrade():
+            return False  # keep the host copy; data is still safe
+
+        if not R.run_guarded("spill_write", attempt, op="spill_to_disk",
+                             degrade=degrade):
+            self._disk_spill_failed = True
+            if os.path.exists(path):  # drop any partial file
+                os.unlink(path)
+            return 0
         self._disk_path = path
         self._treedef = treedef
         freed = sum(x.nbytes for x in leaves)
@@ -150,9 +170,18 @@ class SpillableBatch:
         import jax
         from_host = self._host is not None
         if not from_host and self._disk_path is not None:
-            # disk staging never touches _host_used accounting
-            with np.load(self._disk_path) as z:
-                leaves = [z[k] for k in z.files]
+            # disk staging never touches _host_used accounting.  The
+            # restore passes the ``spill_read`` failure domain: IO
+            # faults (missing/corrupt .npz) retry, and exhaustion is a
+            # domain-tagged terminal error — the data is gone, there is
+            # no host path to degrade to.
+            def attempt():
+                R.INJECTOR.on("spill_read")
+                with np.load(self._disk_path) as z:
+                    return [z[k] for k in z.files]
+
+            leaves = R.run_guarded("spill_read", attempt,
+                                   op="spill_restore")
             self._host = (leaves, self._treedef)
             os.unlink(self._disk_path)
             self._disk_path = None
@@ -238,6 +267,17 @@ class DeviceMemoryManager:
                 _TM_RETRY_OOM.inc()
                 raise RetryOOM(
                     f"injected OOM at allocation {self._alloc_count}")
+            if R.INJECTOR.armed:
+                # the ``alloc`` failure domain: an injected fault here
+                # IS a forced OOM — it re-enters the existing
+                # RetryOOM/with_retry rollback machinery rather than a
+                # separate retry loop
+                try:
+                    R.INJECTOR.on("alloc")
+                except R.InjectedDeviceError as e:
+                    self.metrics["retryOOMs"] += 1
+                    _TM_RETRY_OOM.inc()
+                    raise RetryOOM(str(e)) from e
             if nbytes > self.budget:
                 self.metrics["retryOOMs"] += 1
                 _TM_RETRY_OOM.inc()
@@ -334,7 +374,7 @@ class DeviceMemoryManager:
                 victim = next(
                     (v for v in self._spillables.values()
                      if v.tier == "host" and v._host_accounted
-                     and v is not s), None)
+                     and not v._disk_spill_failed and v is not s), None)
                 if victim is None:
                     break
                 victim.spill_to_disk()  # decrements _host_used itself
@@ -439,7 +479,7 @@ def split_batch_in_half(batch: DeviceBatch) -> List[DeviceBatch]:
 def with_retry(
     inputs: Iterable[DeviceBatch],
     closure: Callable[[DeviceBatch], object],
-    max_attempts: int = 8,
+    max_attempts: Optional[int] = None,
     manager: Optional[DeviceMemoryManager] = None,
     allow_split: bool = True,
 ):
@@ -451,11 +491,19 @@ def with_retry(
     caller's closure must be merge-friendly (partial aggregates, sorted
     runs, ...).  Yields one result per processed (sub-)batch.
 
+    Attempts default to the unified ``RetryPolicy``
+    (``spark.rapids.tpu.retry.maxAttempts``), and every OOM retry is
+    accounted as an ``alloc``-domain retry in
+    ``tpuq_retry_total{domain="alloc"}`` — OOM rollback and device-call
+    retries share the one policy.
+
     ``inputs`` is consumed LAZILY — one upstream batch is live at a
     time, so spilling actually frees HBM instead of fighting a pinned
     input list.
     """
     mgr = manager or get_manager()
+    if max_attempts is None:
+        max_attempts = R.get_policy().max_attempts
     it = iter(inputs)
     work: List[Tuple[DeviceBatch, int]] = []  # pending (sub-)batches
     while True:
@@ -473,11 +521,14 @@ def with_retry(
                 raise
             mgr.metrics["splitRetries"] += 1
             _TM_SPLIT_RETRY.inc()
+            R.note_retry("alloc")
             halves = split_batch_in_half(batch)
             work = [(h, attempts + 1) for h in halves] + work
         except RetryOOM:
             if attempts + 1 >= max_attempts:
+                R.note_exhausted()
                 raise
+            R.note_retry("alloc")
             # free device pressure INCREMENTALLY: spill victims until
             # roughly this batch's working set is free, not the whole
             # pool (draining everything evicts the scan cache on the

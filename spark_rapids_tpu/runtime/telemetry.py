@@ -65,6 +65,54 @@ class Counter:
         return self._value
 
 
+class LabeledCounter:
+    """A counter *family* with one label dimension (Prometheus
+    ``name{label="value"}`` children).  ``labels(v)`` returns the child
+    ``Counter`` for that label value, creating it on first use — hot
+    paths hold the child reference and pay the same one-lock ``inc`` a
+    plain counter costs.  The family itself reports the sum of its
+    children."""
+
+    __slots__ = ("name", "doc", "label", "_lock", "_children")
+
+    def __init__(self, name: str, doc: str = "", label: str = "domain"):
+        self.name = name
+        self.doc = doc
+        self.label = label
+        self._lock = threading.Lock()
+        self._children: Dict[str, Counter] = {}
+
+    def child_name(self, value: str) -> str:
+        return f'{self.name}{{{self.label}="{value}"}}'
+
+    def labels(self, value: str) -> Counter:
+        with self._lock:
+            c = self._children.get(value)
+            if c is None:
+                c = Counter(self.child_name(value), self.doc)
+                self._children[value] = c
+            return c
+
+    def inc(self, value: str, v=1) -> None:
+        self.labels(value).inc(v)
+
+    @property
+    def value(self):
+        with self._lock:
+            return sum(c.value for c in self._children.values())
+
+    def child_values(self) -> Dict[str, float]:
+        """label value → count, only children that exist."""
+        with self._lock:
+            return {lv: c.value for lv, c in self._children.items()}
+
+    def sample_items(self) -> List[Tuple[str, float]]:
+        """(exposition sample name, value) per child, sorted."""
+        with self._lock:
+            return sorted((c.name, c.value)
+                          for c in self._children.values())
+
+
 class Gauge:
     """Point-in-time value; ``fn``-backed gauges pull live state at
     snapshot time so producers never pay a per-update cost."""
@@ -201,6 +249,11 @@ class MetricsRegistry:
         return self._get_or_create(name, Counter,
                                    lambda: Counter(name, doc))
 
+    def labeled_counter(self, name: str, doc: str = "",
+                        label: str = "domain") -> LabeledCounter:
+        return self._get_or_create(
+            name, LabeledCounter, lambda: LabeledCounter(name, doc, label))
+
     def gauge(self, name: str, doc: str = "",
               fn: Optional[Callable[[], float]] = None) -> Gauge:
         return self._get_or_create(name, Gauge,
@@ -228,14 +281,29 @@ class MetricsRegistry:
             metrics = list(self._metrics.items())
         out = {}
         for name, m in sorted(metrics):
-            out[name] = (m.snapshot() if isinstance(m, Histogram)
-                         else m.value)
+            if isinstance(m, Histogram):
+                out[name] = m.snapshot()
+            elif isinstance(m, LabeledCounter):
+                for child, v in m.sample_items():
+                    out[child] = v
+            else:
+                out[name] = m.value
         return out
 
     def counter_values(self) -> Dict[str, float]:
+        """Plain counters by name plus every labeled-family child by its
+        exposition sample name (``name{label="v"}``) — the flat space
+        query windows diff."""
         with self._lock:
-            return {n: m.value for n, m in self._metrics.items()
-                    if isinstance(m, Counter)}
+            metrics = list(self._metrics.values())
+        out: Dict[str, float] = {}
+        for m in metrics:
+            if isinstance(m, Counter):
+                out[m.name] = m.value
+            elif isinstance(m, LabeledCounter):
+                for child, v in m.sample_items():
+                    out[child] = v
+        return out
 
     def prometheus_text(self) -> str:
         """Text exposition format: one HELP/TYPE pair per family, then
@@ -247,7 +315,11 @@ class MetricsRegistry:
             doc = (m.doc or name).replace("\\", "\\\\").replace(
                 "\n", "\\n")
             lines.append(f"# HELP {name} {doc}")
-            if isinstance(m, Counter):
+            if isinstance(m, LabeledCounter):
+                lines.append(f"# TYPE {name} counter")
+                for child, v in m.sample_items():
+                    lines.append(f"{child} {_fmt(v)}")
+            elif isinstance(m, Counter):
                 lines.append(f"# TYPE {name} counter")
                 lines.append(f"{name} {_fmt(m.value)}")
             elif isinstance(m, Gauge):
@@ -289,9 +361,10 @@ def ensure_producers() -> None:
     would otherwise miss the shuffle family)."""
     import importlib
     for mod in ("runtime.memory", "runtime.semaphore",
-                "runtime.kernel_cache", "shuffle.manager",
-                "shuffle.exchange", "parallel.executor",
-                "parallel.shuffle", "exec.distributed"):
+                "runtime.kernel_cache", "runtime.resilience",
+                "shuffle.manager", "shuffle.exchange",
+                "parallel.executor", "parallel.shuffle",
+                "exec.distributed"):
         try:
             importlib.import_module(f"spark_rapids_tpu.{mod}")
         except Exception as e:  # never fail a report over one producer
@@ -473,6 +546,12 @@ def evaluate_health(deltas: Dict[str, float], elapsed_s: float, conf,
         warn("compile_storm", compiles, thr,
              f"{compiles} XLA compiles in one query — shape buckets or "
              "expression fingerprints are not being reused")
+    degraded = deltas.get("tpuq_host_degraded_ops_total", 0)
+    if degraded:
+        warn("host_degraded", degraded, 0,
+             f"{degraded} device step(s) re-ran on the host path after "
+             "retry exhaustion tripped a circuit breaker — see "
+             "docs/resilience.md")
     for e in events:
         _HEALTH_WARNS.inc()
         REGISTRY.record_health(e)

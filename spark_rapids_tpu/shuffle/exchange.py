@@ -29,6 +29,7 @@ from spark_rapids_tpu.columnar.column import (
     DeviceBatch, DeviceColumn, round_up_pow2)
 from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.shuffle.manager import (
     ShuffleEnv, ShuffleReader, ShuffleWriter)
@@ -217,14 +218,27 @@ class TpuHostShuffleExchangeExec(TpuExec):
         return "bytes", sizes
 
     def _read_concat(self, parts) -> tuple:
+        """Reduce-side fetch through the ``shuffle_exchange`` failure
+        domain.  Map files are immutable once materialized, so the whole
+        read is idempotent and retries simply re-read (bytesRead counts
+        every attempt).  Not degradable: exhaustion is a domain-tagged
+        terminal error."""
         env = ShuffleEnv.get()
         reader = ShuffleReader(env, self._shuffle_id, self._map_parts,
                                self.schema)
-        records = []
-        t0 = time.perf_counter()
-        with self.timer("readTime"):
+        parts = list(parts)
+
+        def attempt():
+            R.INJECTOR.on("shuffle_exchange")
+            records = []
             for p in parts:
                 records.extend(reader.read_partition(p))
+            return records
+
+        t0 = time.perf_counter()
+        with self.timer("readTime"):
+            records = R.run_guarded("shuffle_exchange", attempt,
+                                    op="shuffle_read")
         _TM_READ_S.inc(time.perf_counter() - t0)
         return _concat_views(self.schema, records)
 

@@ -29,6 +29,7 @@ from typing import Iterator, List, Optional, Sequence
 import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.shuffle.serializer import (
     HostColView, deserialize, serialize_partitions)
@@ -104,11 +105,23 @@ class ShuffleWriter:
 
     def write_batch(self, cols: Sequence[HostColView], pids: np.ndarray,
                     live: Optional[np.ndarray]) -> int:
-        """Serialize one batch's rows into per-partition sections."""
-        # scratch=True: sections are consumed (written to the map file)
-        # before this thread serializes its next batch
-        sections = serialize_partitions(cols, pids, live, self.nparts,
+        """Serialize one batch's rows into per-partition sections.
+
+        Serialization passes the ``shuffle_ser`` failure domain: the
+        sections are produced (retryably — nothing is written until
+        serialization succeeds) before any bytes hit the map file, so a
+        retried fault never leaves a partially-written record.  The
+        domain is not degradable; exhaustion is a domain-tagged
+        terminal error."""
+        def attempt():
+            R.INJECTOR.on("shuffle_ser")
+            # scratch=True: sections are consumed (written to the map
+            # file) before this thread serializes its next batch
+            return serialize_partitions(cols, pids, live, self.nparts,
                                         self.nthreads, scratch=True)
+
+        sections = R.run_guarded("shuffle_ser", attempt,
+                                 op="shuffle_serialize")
         sizes = np.array([len(s) for s in sections], np.int64)
         self._f.write(sizes.tobytes())
         for s in sections:

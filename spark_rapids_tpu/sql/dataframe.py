@@ -790,6 +790,8 @@ class DataFrame:
         self._last_plan = plan
         qid = trace.next_query_id()
         qwin = telemetry.begin_query(qid)
+        from spark_rapids_tpu.runtime import resilience
+        rwin = resilience.begin_query(qid)
         tracer = None
         if conf.get(C.TRACE_ENABLED):
             tracer = trace.start_query(
@@ -824,11 +826,11 @@ class DataFrame:
         finally:
             trace.end_query(tracer)
             self._record_query(qid, tracer, conf, profile_dir, error,
-                               qwin)
+                               qwin, rwin)
         return out
 
     def _record_query(self, qid, tracer, conf, profile_dir, error,
-                      qwin=None):
+                      qwin=None, rwin=None):
         """One event-log entry per execution: plan tree, device/fallback
         report, all metrics at their levels, span rollup, artifact
         cross-links — the reference's driver-log plan-conversion report,
@@ -876,6 +878,21 @@ class DataFrame:
                                                query_id=qid)
             if health:
                 entry["health"] = health
+        if rwin is not None:
+            # retry/breaker/degradation rollup for the query's failure
+            # domains (see runtime/resilience.py)
+            from spark_rapids_tpu.runtime import resilience
+            res = resilience.finish_query(rwin)
+            if res is not None:
+                entry["resilience"] = res
+                # runtime degradations join the plan-time fallback
+                # report: the same "what did NOT run on device" story,
+                # one decided at planning, one at execution
+                if res["degraded_ops"]:
+                    entry.setdefault("fallback_report", []).extend(
+                        f"!{d['op']} degraded to the host path at "
+                        f"runtime [{d['domain']}] because {d['cause']}"
+                        for d in res["degraded_ops"])
         self._last_query_entry = entry
         self.session._record_query(entry)
         log_path = str(conf.get(C.QUERY_LOG_PATH))

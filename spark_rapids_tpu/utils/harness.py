@@ -6,11 +6,20 @@
 the same query twice, once with ``spark.rapids.sql.enabled=false`` (the
 numpy oracle path) and once ``=true`` with test mode on (any unexpected
 fallback raises), and compare collected results.
+
+Also home of the **chaos harness** (``run_chaos`` /
+``assert_chaos_invariant``): run a query under a scheduled or
+seed-randomized fault-injection schedule across the engine's failure
+domains (runtime/resilience.py) and assert the engine-wide invariant —
+transient faults are ridden out bit-identically, terminal faults either
+degrade to a recorded host-path result or fail with a clean
+domain-tagged error, and a bare ``InjectedDeviceError`` NEVER escapes.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+import random
+from typing import Callable, Dict, Optional, Tuple
 
 from spark_rapids_tpu.sql.session import TpuSession
 
@@ -74,3 +83,112 @@ def assert_tpu_fallback_collect(
     t = df.toArrow()
     c = df_builder(cpu_session(conf)).toArrow()
     assert_tables_equal(c, t, ignore_order=ignore_order)
+
+
+# ---------------------------------------------------------------------------
+# chaos harness: fault-injection schedules over the failure domains
+# ---------------------------------------------------------------------------
+
+def run_chaos(df_builder: Callable[[TpuSession], "object"],
+              inject: Dict[str, Tuple[int, int]],
+              conf: Optional[Dict] = None) -> dict:
+    """Run one query with ``inject``'s failure domains armed.
+
+    ``inject``: ``{domain: (at, transient_budget)}`` — the per-domain
+    injection schedule (see runtime/resilience.py for the firing
+    model).  Backoff is zeroed so soaks run at full speed.
+
+    Returns a record::
+
+        {"status": "ok" | "failed", "result": pa.Table | None,
+         "error": TerminalDeviceError | None, "domain": str | None,
+         "entry": the query's event-log entry (telemetry deltas,
+                  health verdicts, resilience rollup)}
+
+    ``"ok"`` covers both bit-identical recovery and host-degraded
+    success (distinguish via ``entry["resilience"]["degraded_ops"]``).
+    ``"failed"`` is a clean domain-tagged terminal failure.  A bare
+    ``InjectedDeviceError`` escaping the engine violates the chaos
+    invariant and raises ``AssertionError``.
+    """
+    from spark_rapids_tpu.runtime import resilience as R
+
+    full: Dict = {"spark.rapids.tpu.retry.backoffBaseMs": 0}
+    full.update(conf or {})
+    for d, (at, budget) in inject.items():
+        full[f"spark.rapids.tpu.test.inject.{d}.at"] = at
+        full[f"spark.rapids.tpu.test.inject.{d}.transientCount"] = budget
+    R.INJECTOR.reset()
+    s = tpu_session(full)
+    df = df_builder(s)
+    rec = {"status": "ok", "result": None, "error": None,
+           "domain": None, "entry": None}
+    try:
+        rec["result"] = df.toArrow()
+    except R.InjectedDeviceError as e:  # pragma: no cover - invariant
+        raise AssertionError(
+            f"bare InjectedDeviceError escaped the engine: {e}") from e
+    except R.TerminalDeviceError as e:
+        rec["status"] = "failed"
+        rec["error"] = e
+        rec["domain"] = e.domain
+    finally:
+        R.INJECTOR.reset()
+    rec["entry"] = getattr(df, "_last_query_entry", None)
+    return rec
+
+
+def assert_chaos_invariant(df_builder: Callable[[TpuSession], "object"],
+                           inject: Dict[str, Tuple[int, int]],
+                           conf: Optional[Dict] = None,
+                           ignore_order: bool = True) -> dict:
+    """Assert THE chaos invariant for one query + injection schedule:
+
+    * transient faults (injector budget rode out by retries) → results
+      **bit-identical** to a clean run of the same query;
+    * terminal faults in a degradable domain → host-degraded result
+      matching the clean run (approx float — the host path may order
+      reductions differently), recorded in the event-log entry;
+    * terminal faults elsewhere → a clean **domain-tagged** failure.
+
+    One carve-out from bit-identity: ``alloc`` faults recover through
+    the OOM retry framework, whose split-and-retry legitimately halves
+    batches — float reductions then group differently (ULP-level
+    drift), so alloc-retried runs also compare approx-float.
+
+    The chaos run goes FIRST (fresh-compile domains like ``compile``
+    would otherwise hit kernels the golden run already cached); the
+    golden run happens after ``run_chaos`` disarmed the injector.
+    Returns the ``run_chaos`` record (with ``rec["golden"]`` added).
+    """
+    from spark_rapids_tpu.runtime.resilience import DOMAINS
+    from spark_rapids_tpu.utils.asserts import assert_tables_equal
+
+    rec = run_chaos(df_builder, inject, conf)
+    golden = df_builder(tpu_session(dict(conf or {}))).toArrow()
+    rec["golden"] = golden
+    if rec["status"] == "failed":
+        assert rec["domain"] in DOMAINS, (
+            f"terminal failure not domain-tagged: {rec['error']!r}")
+        return rec
+    entry = rec["entry"] or {}
+    res = entry.get("resilience") or {}
+    approx = (bool(res.get("degraded_ops"))
+              or bool((res.get("retries") or {}).get("alloc")))
+    assert_tables_equal(golden, rec["result"], ignore_order=ignore_order,
+                        approx_float=approx)
+    return rec
+
+
+def random_chaos_schedule(seed: int, domains=None,
+                          max_at: int = 6) -> Dict[str, Tuple[int, int]]:
+    """A seed-deterministic injection schedule for soak tests: 1-2
+    domains, each armed at a random call count with a random transient
+    budget (0 = terminal)."""
+    from spark_rapids_tpu.runtime.resilience import DOMAINS
+
+    rnd = random.Random(seed)
+    pool = list(domains if domains is not None else DOMAINS)
+    picks = rnd.sample(pool, k=min(rnd.randint(1, 2), len(pool)))
+    return {d: (rnd.randint(1, max_at), rnd.choice([0, 1, 1, 2, 3]))
+            for d in picks}

@@ -27,6 +27,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection chaos tests (deterministic smoke runs "
+        "in tier 1; seed-randomized soaks are also marked slow)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1 runs")
+
+
 @pytest.fixture
 def rng_seed():
     return 0

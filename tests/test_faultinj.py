@@ -5,10 +5,15 @@ import numpy as np
 import pyarrow as pa
 import pytest
 
-from spark_rapids_tpu.runtime.faultinj import INJECTOR, InjectedDeviceError
+from spark_rapids_tpu.runtime.faultinj import (
+    INJECTOR, InjectedDeviceError, TerminalDeviceError)
 from spark_rapids_tpu.sql import functions as F
 from spark_rapids_tpu.sql.column import col
 from spark_rapids_tpu.utils.harness import tpu_session
+
+# terminal-fault tests opt out of host degradation to observe the
+# domain-tagged failure; the degraded-success paths live in test_chaos
+_NO_DEGRADE = {"spark.rapids.tpu.retry.hostDegrade.enabled": False}
 
 
 @pytest.fixture(autouse=True)
@@ -31,9 +36,24 @@ def _query(s, t):
 
 def test_terminal_execute_error_fails_query():
     t = table()
-    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 2})
-    with pytest.raises(InjectedDeviceError, match="execute"):
+    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 2,
+                     **_NO_DEGRADE})
+    with pytest.raises(TerminalDeviceError, match="execute"):
         _query(s, t).toArrow()
+
+
+def test_terminal_execute_error_degrades_by_default():
+    # with host degradation on (the default), a terminal device fault
+    # re-runs the op eagerly on the host path and the query SUCCEEDS
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 2})
+    out = _query(s, t).toArrow()
+    expect = _query(tpu_session(), t).toArrow()
+    got = {r["k"]: r["sv"] for r in out.to_pylist()}
+    want = {r["k"]: r["sv"] for r in expect.to_pylist()}
+    assert set(got) == set(want)
+    for k in want:
+        assert abs(got[k] - want[k]) < 1e-9
 
 
 def test_transient_execute_error_recovers():
@@ -52,8 +72,9 @@ def test_transient_execute_error_recovers():
 
 def test_terminal_transfer_error_fails_query():
     t = table()
-    s = tpu_session({"spark.rapids.tpu.test.injectTransferErrorAt": 1})
-    with pytest.raises(InjectedDeviceError, match="transfer"):
+    s = tpu_session({"spark.rapids.tpu.test.injectTransferErrorAt": 1,
+                     **_NO_DEGRADE})
+    with pytest.raises(TerminalDeviceError, match="transfer"):
         _query(s, t).toArrow()
 
 
@@ -71,13 +92,30 @@ def test_disarmed_runs_clean():
 
 
 def test_persistent_transient_exhausts_retries():
-    # budget > engine retry attempts models a persistent fault
+    # budget >= engine retry attempts models a persistent fault; pin
+    # maxAttempts below the budget so the policy gives up first
     t = table()
     s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 1,
-                     "spark.rapids.tpu.test.injectTransientCount": 5})
-    with pytest.raises(InjectedDeviceError) as ei:
+                     "spark.rapids.tpu.test.injectTransientCount": 5,
+                     "spark.rapids.tpu.retry.maxAttempts": 3,
+                     "spark.rapids.tpu.retry.backoffBaseMs": 0,
+                     **_NO_DEGRADE})
+    with pytest.raises(TerminalDeviceError) as ei:
         _query(s, t).toArrow()
     assert ei.value.transient  # retries exhausted on a transient fault
+    assert ei.value.domain == "execute"
+
+
+def test_max_attempts_conf_is_honored():
+    # a transient budget of 4 needs maxAttempts >= 5 to recover — the
+    # old hardcoded 2-attempt loop could never ride this out
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.injectExecuteErrorAt": 1,
+                     "spark.rapids.tpu.test.injectTransientCount": 4,
+                     "spark.rapids.tpu.retry.maxAttempts": 6,
+                     "spark.rapids.tpu.retry.backoffBaseMs": 0,
+                     **_NO_DEGRADE})
+    assert _query(s, t).toArrow().num_rows == 5
 
 
 def test_clean_session_does_not_disarm():
@@ -94,8 +132,24 @@ def test_clean_session_does_not_disarm():
 def test_rearm_with_identical_conf():
     # after a terminal fire self-disarms, the same conf must re-arm
     t = table()
-    conf = {"spark.rapids.tpu.test.injectExecuteErrorAt": 1}
+    conf = {"spark.rapids.tpu.test.injectExecuteErrorAt": 1,
+            **_NO_DEGRADE}
     for _ in range(2):
         s = tpu_session(conf)
-        with pytest.raises(InjectedDeviceError):
+        with pytest.raises(TerminalDeviceError):
             _query(s, t).toArrow()
+
+
+def test_domain_key_arms_named_domain():
+    # the per-domain key form arms exactly its domain
+    t = table()
+    s = tpu_session({"spark.rapids.tpu.test.inject.transfer.at": 1,
+                     **_NO_DEGRADE})
+    with pytest.raises(TerminalDeviceError, match="transfer"):
+        _query(s, t).toArrow()
+    assert not INJECTOR.armed  # terminal fire self-disarms
+
+
+def test_unknown_domain_rejected():
+    with pytest.raises(ValueError, match="unknown failure domain"):
+        INJECTOR.configure({"warp_drive": (1, 0)})

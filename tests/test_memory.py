@@ -187,3 +187,83 @@ def test_tiny_budget_forces_spill_results_match():
     assert_tpu_and_cpu_are_equal_collect(
         lambda s: _agg_query(s, t), conf=conf, ignore_order=True)
     assert M.get_manager().metrics["spillToHostBytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# spill-tier failure domains: disk restore faults, degraded disk writes
+# ---------------------------------------------------------------------------
+
+import os
+
+from spark_rapids_tpu.runtime import resilience as R
+
+
+@pytest.fixture(autouse=True)
+def _fast_policy_and_disarm():
+    """Zero backoff (these tests exhaust retries on purpose) and a
+    clean injector on both sides."""
+    old = R._policy
+    R._policy = R.RetryPolicy(backoff_base_ms=0)
+    R.INJECTOR.reset()
+    yield
+    R._policy = old
+    R.INJECTOR.reset()
+
+
+def _spilled_to_disk(tmp_path, seed=5):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    b = small_batch(seed)
+    ref = np.asarray(b.columns[0].data).copy()
+    sp = M.SpillableBatch(b, mgr)
+    sp.spill_to_host()
+    sp.spill_to_disk()
+    assert sp.tier == "disk"
+    return sp, ref
+
+
+def test_disk_restore_missing_file_is_domain_tagged(tmp_path):
+    # the .npz vanished (scratch-dir reaper, operator error): retries
+    # exhaust on the real OSError and surface as a spill_read-tagged
+    # terminal error, never a bare FileNotFoundError
+    sp, _ = _spilled_to_disk(tmp_path)
+    os.unlink(sp._disk_path)
+    with pytest.raises(R.TerminalDeviceError, match="spill_read") as ei:
+        sp.get()
+    assert ei.value.domain == "spill_read"
+    sp.close()
+
+
+def test_disk_restore_corrupt_file_is_domain_tagged(tmp_path):
+    # truncated/garbage payload: np.load raises through the same domain
+    sp, _ = _spilled_to_disk(tmp_path)
+    with open(sp._disk_path, "wb") as f:
+        f.write(b"this is not an npz archive")
+    with pytest.raises(R.TerminalDeviceError, match="spill_read"):
+        sp.get()
+    sp.close()
+
+
+def test_disk_restore_transient_injection_recovers(tmp_path):
+    sp, ref = _spilled_to_disk(tmp_path)
+    R.INJECTOR.configure({"spill_read": (1, 1)})
+    out = sp.get()
+    assert np.array_equal(np.asarray(out.columns[0].data), ref)
+    sp.close()
+
+
+def test_spill_write_terminal_fault_keeps_host_copy(tmp_path):
+    # a dead spill disk degrades gracefully: the batch stays in the
+    # host tier (freed == 0), is excluded from host-limit eviction, and
+    # the data remains fully restorable
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    b = small_batch(9)
+    ref = np.asarray(b.columns[0].data).copy()
+    sp = M.SpillableBatch(b, mgr)
+    sp.spill_to_host()
+    R.INJECTOR.configure({"spill_write": (1, 0)})
+    assert sp.spill_to_disk() == 0
+    assert sp.tier == "host" and sp._disk_spill_failed
+    assert not os.listdir(tmp_path)  # no partial spill file left behind
+    out = sp.get()
+    assert np.array_equal(np.asarray(out.columns[0].data), ref)
+    sp.close()

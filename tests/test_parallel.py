@@ -77,6 +77,13 @@ def _assert_ici_in_plan(df_builder, conf):
     assert "TpuIciShuffleExchange" in tree, tree
 
 
+# Each distinct distributed plan shape jit-compiles its own shard_map
+# collective program, which costs tens of seconds on the CPU backend.
+# Tier 1 keeps a smoke set covering the mesh collectives plus the hash
+# and range exchanges; the wider shapes (joins, repartition, window,
+# budget) run under the `slow` marker.
+
+@pytest.mark.slow
 def test_distributed_groupby_string_numeric_keys():
     t, _ = _dist_tables(1)
 
@@ -104,6 +111,7 @@ def test_distributed_groupby_double_sum_approx():
         build, conf=ICI_CONF, ignore_order=True, approx_float=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("how", ["inner", "left", "full", "left_anti"])
 def test_distributed_join(how):
     t, r = _dist_tables(3)
@@ -116,6 +124,7 @@ def test_distributed_join(how):
         build, conf=ICI_CONF, ignore_order=True)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("how", ["inner", "full"])
 def test_distributed_join_double_key_zero_nan(how):
     # -0.0/0.0 and NaN/NaN must land on the SAME device (normalized
@@ -150,6 +159,7 @@ def test_distributed_groupby_double_key_zero_nan():
         build, conf=ICI_CONF, ignore_order=True)
 
 
+@pytest.mark.slow
 def test_distributed_join_then_aggregate():
     t, r = _dist_tables(4)
 
@@ -162,6 +172,7 @@ def test_distributed_join_then_aggregate():
         build, conf=ICI_CONF, ignore_order=True)
 
 
+@pytest.mark.slow
 def test_distributed_repartition():
     t, _ = _dist_tables(5)
 
@@ -176,6 +187,7 @@ def test_distributed_repartition():
         build, conf=ICI_CONF, ignore_order=True)
 
 
+@pytest.mark.slow
 def test_distributed_exchange_under_table_sized_budget():
     """VERDICT r2 #2 'done' criterion: distributed agg/join pass with a
     poolSize BELOW total-table bytes — proving the exchange accounts (and
@@ -207,7 +219,12 @@ def test_distributed_exchange_under_table_sized_budget():
     M.reset_manager()
 
 
+@pytest.mark.slow
 def test_graft_entry_contract():
+    # jax 0.4.37's CPU backend cannot run the 2-process phase
+    # ("Multiprocess computations aren't implemented on the CPU
+    # backend"); keep the contract check in the slow tier where real
+    # accelerator runs pick it up.
     import importlib.util
     spec = importlib.util.spec_from_file_location(
         "__graft_entry__", "/root/repo/__graft_entry__.py")
@@ -257,6 +274,7 @@ def test_range_exchange_total_order():
     assert "TpuIciRangeExchangeExec" in names, names
 
 
+@pytest.mark.slow
 def test_window_distributes_over_hash_exchange():
     import numpy as np
     import pyarrow as pa

@@ -140,13 +140,19 @@ def test_histogram_thread_safety():
 # ---------------------------------------------------------------------------
 
 _SAMPLE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{le="[^"]+"\})? '
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? '
     r'(-?\d+(\.\d+)?([eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
 _META = re.compile(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+$")
 
 
 def test_prometheus_exposition_parses():
     telemetry.ensure_producers()
+    # materialize a labeled-counter child so the exposition exercises
+    # label syntax even when no earlier test fired one
+    telemetry.REGISTRY.labeled_counter(
+        "tpuq_retry_total").labels("execute")
     text = telemetry.REGISTRY.prometheus_text()
     assert text.endswith("\n")
     families = []
