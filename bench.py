@@ -1090,6 +1090,9 @@ def _sf1_query_main(name: str) -> None:
         # healthy run — nonzero flags flaky hardware/IO in the record)
         from spark_rapids_tpu.runtime import resilience as RES
         rs = RES.counters_snapshot()
+        # distributed-tier counters: stage aborts by reason, epoch
+        # retries, heartbeat misses, dead peers (all zero single-proc)
+        from spark_rapids_tpu.parallel import rendezvous as RV
         print("TPCH_SF1_MEMORY=" + json.dumps({
             "peak_hbm_bytes": mm["peakReserved"],
             "spill_host_bytes": mm["spillToHostBytes"],
@@ -1100,7 +1103,8 @@ def _sf1_query_main(name: str) -> None:
             "retries_by_domain": rs["retries"],
             "retry_exhausted": rs["retry_exhausted"],
             "breaker_trips": rs["breaker_trips"],
-            "host_degraded_ops": rs["host_degraded_ops"]}))
+            "host_degraded_ops": rs["host_degraded_ops"],
+            "rendezvous": RV.counters_snapshot()}))
     except Exception as e:  # diagnostics must never fail the run
         print(f"TPCH_SF1_MEMORY_ERR={e}")
     # the honest progress meter for operator breadth: how much of this

@@ -517,13 +517,64 @@ RENDEZVOUS_ADDRESS = (
 
 RENDEZVOUS_TIMEOUT = (
     conf("spark.rapids.shuffle.rendezvous.timeoutSec")
-    .doc("Deadline for every rendezvous barrier. On expiry the "
-         "coordinator fails ALL waiters of the stage (fail-together: "
-         "nobody enters a collective that cannot complete — a hung "
-         "ICI collective would wedge the whole slice).")
+    .doc("Legacy alias for spark.rapids.tpu.rendezvous.timeoutMs "
+         "(seconds). When set explicitly it wins over the timeoutMs "
+         "key; prefer the millisecond key for new deployments.")
     .category("distributed")
     .double()
     .create_with_default(120.0)
+)
+
+RENDEZVOUS_TIMEOUT_MS = (
+    conf("spark.rapids.tpu.rendezvous.timeoutMs")
+    .doc("Deadline in milliseconds for every rendezvous barrier "
+         "(allgather/enter). On expiry the coordinator fails ALL "
+         "waiters of the stage (fail-together: nobody enters a "
+         "collective that cannot complete — a hung ICI collective "
+         "would wedge the whole slice); survivors then retry the "
+         "stage at the next epoch under the shared retry policy.")
+    .category("distributed")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(60000)
+)
+
+RENDEZVOUS_HEARTBEAT_MS = (
+    conf("spark.rapids.tpu.rendezvous.heartbeatMs")
+    .doc("Executor liveness heartbeat period. Each executor process "
+         "registers with the rendezvous coordinator and renews its "
+         "lease at this period; see rendezvous.leaseMs for the "
+         "expiry. 0 disables the heartbeat (no liveness tracking).")
+    .category("distributed")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(1500)
+)
+
+RENDEZVOUS_LEASE_MS = (
+    conf("spark.rapids.tpu.rendezvous.leaseMs")
+    .doc("Heartbeat lease: an executor that has not heartbeated for "
+         "this long is declared dead, and the coordinator immediately "
+         "poisons every in-flight and future rendezvous stage with a "
+         "peer-tagged abort so survivors unwind in ~one lease instead "
+         "of independent full stage deadlines. Must comfortably "
+         "exceed heartbeatMs (default gives 10 beats per lease).")
+    .category("distributed")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(15000)
+)
+
+RENDEZVOUS_SOCKET_TIMEOUT_MS = (
+    conf("spark.rapids.tpu.rendezvous.socketTimeoutMs")
+    .doc("Socket receive timeout for coordinator handler threads. A "
+         "half-open client connection that never sends its request is "
+         "dropped after this long instead of pinning a handler thread "
+         "forever.")
+    .category("distributed")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(10000)
 )
 
 ADAPTIVE_ENABLED = (
@@ -694,7 +745,7 @@ INJECT_TRANSIENT_COUNT = (
 # fault turns terminal / the domain disarms).
 FAILURE_DOMAINS = ("execute", "transfer", "alloc", "spill_write",
                    "spill_read", "shuffle_ser", "shuffle_exchange",
-                   "collective", "compile")
+                   "collective", "compile", "rendezvous", "peer_loss")
 
 INJECT_DOMAIN_AT: Dict[str, ConfEntry] = {}
 INJECT_DOMAIN_TRANSIENT: Dict[str, ConfEntry] = {}

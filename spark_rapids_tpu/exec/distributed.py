@@ -354,6 +354,12 @@ class TpuIciShuffleExchangeExec(TpuExec):
            collective program.  Any rendezvous deadline failure raises
            in EVERY process (fail-together) — nobody blocks alone inside
            a collective that cannot complete.
+
+        Steps 2-5 run inside ``run_stage_epochs``: a transient
+        rendezvous fault aborts the epoch for every peer and the whole
+        agreement re-runs at epoch+1 over the SAME accumulated inputs
+        (bit-identical recovery); a confirmed-dead peer raises a
+        peer-tagged ``TerminalDeviceError`` on every survivor instead.
         """
         import jax
         from spark_rapids_tpu.exec.basic import concat_device_batches
@@ -361,6 +367,7 @@ class TpuIciShuffleExchangeExec(TpuExec):
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
         from spark_rapids_tpu.runtime.memory import get_manager
+        from spark_rapids_tpu.parallel.rendezvous import run_stage_epochs
         ctx = self._ctx
         timeout = ctx.timeout
         d = self.nparts
@@ -372,7 +379,7 @@ class TpuIciShuffleExchangeExec(TpuExec):
             # only the child partitions THIS process owns: a downstream
             # exchange's partitions live on local devices only, and
             # executor-sliced scans make the rest empty anyway
-            parts, rows, widths, has_val = _accumulate_shards(
+            parts, rows, widths0, has_val0 = _accumulate_shards(
                 self.children[0], local_devices, len(local_devices),
                 partitions=owned_partitions(self.children[0]))
         base_key = self._base_key(schema)
@@ -382,78 +389,101 @@ class TpuIciShuffleExchangeExec(TpuExec):
         # the mismatch must fail loudly, not cross-match allgathers
         fp = repr(base_key)
         payload = {"rows": max(rows) if rows else 0,
-                   "total": sum(rows), "widths": widths,
-                   "has_val": has_val, "fp": fp}
-        replies = ctx.client.allgather(self._stage + ":shape", payload,
-                                       timeout)
-        if any(r["fp"] != fp for r in replies):
-            raise RuntimeError(
-                f"rendezvous stage {self._stage} mismatch across "
-                "executors (different queries or different order) — "
-                "every executor process must run the same queries in "
-                "the same order")
-        if sum(r["total"] for r in replies) == 0:
+                   "total": sum(rows), "widths": widths0,
+                   "has_val": has_val0, "fp": fp}
+        mgr = get_manager()
+
+        def attempt(epoch: int):
+            # a retried epoch re-agrees EVERYTHING that rode the
+            # rendezvous — a peer that restarted mid-stage has none of
+            # it cached (range bounds included, or the processes would
+            # derive different pid programs and desync)
+            self._epoch = epoch
+            self._bounds = None
+            replies = ctx.client.allgather(self._stage + ":shape",
+                                           payload, timeout, epoch=epoch)
+            if any(r["fp"] != fp for r in replies):
+                raise RuntimeError(
+                    f"rendezvous stage {self._stage} mismatch across "
+                    "executors (different queries or different order) — "
+                    "every executor process must run the same queries "
+                    "in the same order")
+            if sum(r["total"] for r in replies) == 0:
+                return None
+            local_b = round_up_pow2(
+                max(max(r["rows"] for r in replies), 1), self.min_bucket)
+            widths = [max(ws) for ws in
+                      zip(*[r["widths"] for r in replies])
+                      ] or list(widths0)
+            has_val = [any(hv) for hv in
+                       zip(*[r["has_val"] for r in replies])
+                       ] or list(has_val0)
+            from spark_rapids_tpu.plan.overrides import (
+                _estimated_row_bytes)
+            row_bytes = _estimated_row_bytes(
+                schema, str_width=max(widths, default=0))
+            shards: List[DeviceBatch] = []
+            # per-device working set, same accounting as the single-
+            # process path: this process hosts len(local_devices) shards
+            # of local_b rows each while building, then the [d*cap]
+            # layout + received block per local device during the
+            # collective
+            with mgr.transient(
+                    2 * len(local_devices) * local_b * row_bytes):
+                with self.timer("partitionTime"):
+                    for li, dev in enumerate(local_devices):
+                        batch_list = [b for b, _ in parts[li]]
+                        counts = [n for _, n in parts[li]]
+                        if not batch_list:
+                            batch_list = [jax.device_put(
+                                empty_batch(schema, 8), dev)]
+                            counts = [0]
+                        shard = concat_device_batches(
+                            schema, batch_list, counts=counts,
+                            bucket=local_b, min_width=widths,
+                            force_validity=has_val)
+                        shards.append(jax.device_put(shard, dev))
+                    sharded = _batch_from_shards(
+                        self.mesh, schema, shards, local_b,
+                        global_devices=d)
+                del shards[:]
+                aux = self._aux_args(sharded)
+                with self.timer("partitionTime"):
+                    # per-shard counts via a plain LOCAL jit: a
+                    # cross-process count program's output shards would
+                    # not be addressable
+                    local_max = 0
+                    for li in range(len(local_devices)):
+                        shard_b = _local_shard(sharded, local_ids[li])
+                        cnt = SH.local_partition_counts(
+                            shard_b, self._local_pid(shard_b, base_key),
+                            d)
+                        local_max = max(local_max,
+                                        int(np.asarray(cnt).max()))
+                counts = ctx.client.allgather(self._stage + ":counts",
+                                              local_max, timeout,
+                                              epoch=epoch)
+                cap = round_up_pow2(max(max(counts), 1), 8)
+                with mgr.transient(2 * d * cap * row_bytes):
+                    ctx.client.barrier(self._stage + ":enter", timeout,
+                                       epoch=epoch)
+                    t0 = time.perf_counter()
+                    with self.timer("collectiveTime"):
+                        shuffle_fn = cached_kernel(
+                            ("ici_shuffle", cap) + base_key,
+                            self._shuffle_builder(cap))
+                        result = self._run_collective(
+                            shuffle_fn, sharded, aux)
+                    _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
+                    _TM_ICI_BYTES.inc(sharded.nbytes())
+            return result
+
+        out = run_stage_epochs(ctx.client, self._stage, attempt)
+        del parts
+        if out is None:
             self._empty = True
             return None
-        local_b = round_up_pow2(
-            max(max(r["rows"] for r in replies), 1), self.min_bucket)
-        widths = [max(ws) for ws in
-                  zip(*[r["widths"] for r in replies])] or list(widths)
-        has_val = [any(hv) for hv in
-                   zip(*[r["has_val"] for r in replies])] or list(has_val)
-        from spark_rapids_tpu.plan.overrides import _estimated_row_bytes
-        row_bytes = _estimated_row_bytes(
-            schema, str_width=max(widths, default=0))
-        mgr = get_manager()
-        shards: List[DeviceBatch] = []
-        # per-device working set, same accounting as the single-process
-        # path: this process hosts len(local_devices) shards of local_b
-        # rows each while building, then the [d*cap] layout + received
-        # block per local device during the collective
-        with mgr.transient(
-                2 * len(local_devices) * local_b * row_bytes):
-            with self.timer("partitionTime"):
-                for li, dev in enumerate(local_devices):
-                    batch_list = [b for b, _ in parts[li]]
-                    counts = [n for _, n in parts[li]]
-                    if not batch_list:
-                        batch_list = [jax.device_put(
-                            empty_batch(schema, 8), dev)]
-                        counts = [0]
-                    shard = concat_device_batches(
-                        schema, batch_list, counts=counts,
-                        bucket=local_b, min_width=widths,
-                        force_validity=has_val)
-                    shards.append(jax.device_put(shard, dev))
-                sharded = _batch_from_shards(self.mesh, schema, shards,
-                                             local_b, global_devices=d)
-            del parts, shards
-            aux = self._aux_args(sharded)
-            with self.timer("partitionTime"):
-                # per-shard counts via a plain LOCAL jit: a
-                # cross-process count program's output shards would not
-                # be addressable
-                local_max = 0
-                for li in range(len(local_devices)):
-                    shard_b = _local_shard(sharded, local_ids[li])
-                    cnt = SH.local_partition_counts(
-                        shard_b, self._local_pid(shard_b, base_key), d)
-                    local_max = max(local_max,
-                                    int(np.asarray(cnt).max()))
-            counts = ctx.client.allgather(self._stage + ":counts",
-                                          local_max, timeout)
-            cap = round_up_pow2(max(max(counts), 1), 8)
-            with mgr.transient(2 * d * cap * row_bytes):
-                ctx.client.barrier(self._stage + ":enter", timeout)
-                t0 = time.perf_counter()
-                with self.timer("collectiveTime"):
-                    shuffle_fn = cached_kernel(
-                        ("ici_shuffle", cap) + base_key,
-                        self._shuffle_builder(cap))
-                    self._result = self._run_collective(
-                        shuffle_fn, sharded, aux)
-                _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
-                _TM_ICI_BYTES.inc(sharded.nbytes())
+        self._result = out
         return self._result
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
@@ -545,7 +575,8 @@ class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
         if self._ctx is not None:
             payload = [c.tolist() for c in cols]
             replies = self._ctx.client.allgather(
-                self._stage + ":range", payload, self._ctx.timeout)
+                self._stage + ":range", payload, self._ctx.timeout,
+                epoch=getattr(self, "_epoch", 0))
             cols = [np.concatenate([np.array(r[i], dtype=np.uint64)
                                     for r in replies])
                     for i in range(len(cols))]

@@ -32,7 +32,14 @@ from spark_rapids_tpu.runtime import telemetry as TM
 class ExecutorContext:
     def __init__(self, process_id: int, num_processes: int,
                  coordinator_address: str, rendezvous_address: str,
-                 timeout: float):
+                 timeout: float, heartbeat_s: float = 0.0):
+        # register under the coordinator's heartbeat lease BEFORE the
+        # jax.distributed handshake: a peer that dies mid-init is then
+        # already visible to the reaper
+        self.client = RendezvousClient(rendezvous_address, process_id,
+                                       default_timeout=timeout)
+        if heartbeat_s > 0:
+            self.client.start_heartbeat(heartbeat_s)
         import jax
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -40,7 +47,6 @@ class ExecutorContext:
         self.process_id = process_id
         self.num_processes = num_processes
         self.timeout = timeout
-        self.client = RendezvousClient(rendezvous_address, process_id)
         self._stage_counter = itertools.count()
 
     def next_stage_id(self) -> str:
@@ -57,6 +63,16 @@ class ExecutorContext:
 
 _CTX: Optional[ExecutorContext] = None
 _LOCK = threading.Lock()
+
+
+def rendezvous_timeout_s(conf) -> float:
+    """Stage deadline in seconds: ``rendezvous.timeoutMs``, unless the
+    legacy ``rendezvous.timeoutSec`` key was set explicitly (it wins)."""
+    from spark_rapids_tpu import conf as C
+    legacy = conf.get_raw(C.RENDEZVOUS_TIMEOUT.key)
+    if legacy is not None:
+        return float(legacy)
+    return float(conf.get(C.RENDEZVOUS_TIMEOUT_MS)) / 1000.0
 
 
 def init_executor(conf) -> Optional[ExecutorContext]:
@@ -81,7 +97,8 @@ def init_executor(conf) -> Optional[ExecutorContext]:
             "multi-executor mode requires spark.rapids.shuffle.mode=ICI "
             f"(got {conf.shuffle_mode})")
     pid = int(conf.get(C.EXECUTOR_ID))
-    timeout = float(conf.get(C.RENDEZVOUS_TIMEOUT))
+    timeout = rendezvous_timeout_s(conf)
+    heartbeat_s = float(conf.get(C.RENDEZVOUS_HEARTBEAT_MS)) / 1000.0
     with _LOCK:
         if _CTX is not None:
             if (_CTX.process_id, _CTX.num_processes) != (pid, count):
@@ -90,8 +107,10 @@ def init_executor(conf) -> Optional[ExecutorContext]:
                     f"({_CTX.process_id}/{_CTX.num_processes}); cannot "
                     f"re-initialize as ({pid}/{count})")
             _CTX.timeout = timeout
+            _CTX.client.default_timeout = timeout
             return _CTX
-        _CTX = ExecutorContext(pid, count, coord, rdv, timeout)
+        _CTX = ExecutorContext(pid, count, coord, rdv, timeout,
+                               heartbeat_s)
         return _CTX
 
 

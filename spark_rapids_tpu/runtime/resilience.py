@@ -5,7 +5,7 @@
  sql-plugin :: RmmRapidsRetryIterator.scala — the uniform
  rollback-and-retry contract every device step gets; SURVEY §3.5/§5.3]
 
-The engine's device/IO boundaries are nine named **failure domains**:
+The engine's device/IO boundaries are eleven named **failure domains**:
 
 ======================  ====================================  ==========
 domain                  chokepoint                            degradable
@@ -19,7 +19,17 @@ domain                  chokepoint                            degradable
 ``shuffle_exchange``    reduce-side shuffle read              no
 ``collective``          ICI all-to-all (exec.distributed)     yes: host shuffle
 ``compile``             jit wrapper build (kernel_cache)      yes: un-jitted
+``rendezvous``          coordinator barrier (parallel.        no: epoch retry
+                        rendezvous :: allgather)
+``peer_loss``           simulated executor death              no: fails slice
 ======================  ====================================  ==========
+
+The two distributed domains retry differently: ``rendezvous`` faults
+re-enter the stage at epoch+1 through ``run_stage_epochs`` (same
+policy, same budget), and ``peer_loss`` is always terminal — every
+survivor raises the same peer-tagged ``TerminalDeviceError`` within
+~one heartbeat lease (see docs/resilience.md, "Distributed failure
+domains").
 
 Three cooperating pieces, all conf-driven:
 
@@ -120,6 +130,12 @@ class TerminalDeviceError(RuntimeError):
         """True when the underlying fault was transient (retries were
         exhausted on a fault that kept firing)."""
         return bool(getattr(self.cause, "transient", False))
+
+    @property
+    def peer(self):
+        """The dead executor's pid for ``peer_loss`` failures (from the
+        underlying ``RendezvousAborted``); None elsewhere."""
+        return getattr(self.cause, "peer", None)
 
 
 class _DomainState:
@@ -322,6 +338,11 @@ class RetryPolicy:
         # a corrupt .npz payload surfaces from np.load as ValueError —
         # still a spill-tier IO fault, still domain-tagged on exhaustion
         if domain == "spill_read" and isinstance(exc, ValueError):
+            return True
+        # only the abort/timeout family of rendezvous failures retries
+        # (epoch re-entry); protocol errors and dead peers never do
+        if (domain == "rendezvous"
+                and getattr(exc, "rendezvous_retryable", False)):
             return True
         return False
 

@@ -364,7 +364,7 @@ def ensure_producers() -> None:
                 "runtime.kernel_cache", "runtime.resilience",
                 "shuffle.manager", "shuffle.exchange",
                 "parallel.executor", "parallel.shuffle",
-                "exec.distributed"):
+                "parallel.rendezvous", "exec.distributed"):
         try:
             importlib.import_module(f"spark_rapids_tpu.{mod}")
         except Exception as e:  # never fail a report over one producer

@@ -192,3 +192,96 @@ def random_chaos_schedule(seed: int, domains=None,
     picks = rnd.sample(pool, k=min(rnd.randint(1, 2), len(pool)))
     return {d: (rnd.randint(1, max_at), rnd.choice([0, 1, 1, 2, 3]))
             for d in picks}
+
+
+# ---------------------------------------------------------------------------
+# rendezvous chaos harness: the distributed failure domains
+# ---------------------------------------------------------------------------
+
+def run_rendezvous_chaos(inject: Dict[str, Tuple[int, int]],
+                         nprocs: int = 3,
+                         heartbeat_s: float = 0.05,
+                         lease_s: float = 0.3,
+                         stage_timeout: float = 5.0) -> dict:
+    """Run an N-participant two-phase rendezvous stage (allgather +
+    entry barrier through ``run_stage_epochs``) with the ``rendezvous``
+    / ``peer_loss`` domains armed, one client thread per participant.
+
+    The invariant the distributed tier owes its callers:
+
+    * a **transient** ``rendezvous`` fault → every participant retries
+      at a bumped epoch and completes with results identical to a clean
+      run (the stage's inputs never change across epochs);
+    * a ``peer_loss`` fault → the victim simulates death (heartbeat
+      silenced, lease expires) and EVERY survivor raises the same
+      peer-tagged ``TerminalDeviceError`` within ~2× the lease — no
+      full-deadline waits, no hangs;
+    * either way the coordinator's ``_stages`` table drains to empty
+      (stage GC), and a bare ``InjectedDeviceError`` never escapes.
+
+    Returns ``{"records": [per-pid record], "live_stages": {...},
+    "expected": [the clean allgather result]}``.  Each record:
+    ``{pid, status: ok|failed|bare_injected, result, error, domain,
+    peer, died, elapsed}``.
+    """
+    import threading
+    import time
+
+    from spark_rapids_tpu.parallel import rendezvous as RD
+    from spark_rapids_tpu.runtime import resilience as R
+
+    R.INJECTOR.reset()
+    R.INJECTOR.configure(inject)
+    policy = R.RetryPolicy(backoff_base_ms=0)
+    coord = RD.RendezvousCoordinator(nprocs, lease_s=lease_s)
+    payloads = {pid: {"pid": pid, "v": pid * 11} for pid in range(nprocs)}
+    records: list = [None] * nprocs
+
+    def run(pid: int) -> None:
+        client = RD.RendezvousClient(coord.address, pid,
+                                     default_timeout=stage_timeout)
+        rec = {"pid": pid, "status": "ok", "result": None, "error": None,
+               "domain": None, "peer": None, "died": False,
+               "elapsed": 0.0}
+        t0 = time.monotonic()
+        try:
+            client.start_heartbeat(heartbeat_s)
+
+            def attempt(epoch: int):
+                vals = client.allgather("chaos:gather", payloads[pid],
+                                        epoch=epoch)
+                client.barrier("chaos:enter", epoch=epoch)
+                return vals
+
+            rec["result"] = RD.run_stage_epochs(
+                client, "chaos", attempt, policy=policy)
+        except R.TerminalDeviceError as e:
+            rec["status"] = "failed"
+            rec["error"] = e
+            rec["domain"] = e.domain
+            rec["peer"] = e.peer
+            rec["died"] = isinstance(e.cause, R.InjectedDeviceError)
+        except R.InjectedDeviceError as e:  # pragma: no cover - invariant
+            rec["status"] = "bare_injected"
+            rec["error"] = e
+        finally:
+            rec["elapsed"] = time.monotonic() - t0
+            client.stop_heartbeat()
+            records[pid] = rec
+
+    threads = [threading.Thread(target=run, args=(pid,), daemon=True)
+               for pid in range(nprocs)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    hung = [i for i, r in enumerate(records) if r is None]
+    live_stages = {k: st.waiters for k, st in coord._stages.items()}
+    coord.shutdown()
+    R.INJECTOR.reset()
+    assert not hung, f"rendezvous chaos participants hung: {hung}"
+    for rec in records:
+        assert rec["status"] != "bare_injected", (
+            f"bare InjectedDeviceError escaped: {rec['error']!r}")
+    return {"records": records, "live_stages": live_stages,
+            "expected": [payloads[i] for i in range(nprocs)]}

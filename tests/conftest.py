@@ -11,6 +11,7 @@ initialized until the first computation, so doing it in conftest is safe.
 """
 
 import os
+import threading
 
 # XLA_FLAGS is read when the CPU client is created (lazily), so this works
 # even though jax is already imported.
@@ -34,6 +35,40 @@ def pytest_configure(config):
         "in tier 1; seed-randomized soaks are also marked slow)")
     config.addinivalue_line(
         "markers", "slow: long-running tests excluded from tier-1 runs")
+    config.addinivalue_line(
+        "markers",
+        "distributed(timeout=90): rendezvous/multi-process tests run "
+        "under a hard SIGALRM watchdog slightly above the rendezvous "
+        "deadline — a regression that reintroduces a wedge fails tier-1 "
+        "instead of hanging it")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Per-test watchdog for ``distributed``-marked tests."""
+    import signal
+
+    marker = item.get_closest_marker("distributed")
+    use_alarm = (marker is not None and hasattr(signal, "SIGALRM")
+                 and threading.current_thread()
+                 is threading.main_thread())
+    if not use_alarm:
+        yield
+        return
+    budget = float(marker.kwargs.get("timeout", 90.0))
+
+    def _on_alarm(signum, frame):
+        raise TimeoutError(
+            f"distributed-test watchdog: {item.nodeid} exceeded "
+            f"{budget:.0f}s — a rendezvous wedge, not a slow test")
+
+    prev = signal.signal(signal.SIGALRM, _on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, budget)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, prev)
 
 
 @pytest.fixture

@@ -22,7 +22,8 @@ from spark_rapids_tpu.runtime.resilience import INJECTOR
 from spark_rapids_tpu.sql import functions as F
 from spark_rapids_tpu.sql.column import col
 from spark_rapids_tpu.utils.harness import (
-    assert_chaos_invariant, random_chaos_schedule, run_chaos)
+    assert_chaos_invariant, random_chaos_schedule, run_chaos,
+    run_rendezvous_chaos)
 
 pytestmark = pytest.mark.chaos
 
@@ -160,6 +161,53 @@ def test_retry_budget_caps_retries_per_query():
 
 
 # ---------------------------------------------------------------------------
+# distributed domains: rendezvous / peer_loss over the thread-level
+# rendezvous harness (N client threads + a real coordinator)
+# ---------------------------------------------------------------------------
+
+_LEASE_S = 0.4
+
+
+@pytest.mark.distributed
+def test_chaos_peer_loss_survivors_fail_together_fast():
+    """peer_loss invariant: the victim goes silent, and EVERY survivor
+    raises the same peer-tagged ``TerminalDeviceError`` within ~2× the
+    lease — no full-deadline waits, no hangs, no stage leak."""
+    out = run_rendezvous_chaos({"peer_loss": (1, 0)}, nprocs=3,
+                               lease_s=_LEASE_S, stage_timeout=30.0)
+    dead = [r for r in out["records"] if r["died"]]
+    survivors = [r for r in out["records"] if not r["died"]]
+    assert len(dead) == 1 and len(survivors) == 2
+    victim = dead[0]["pid"]
+    for r in out["records"]:
+        assert r["status"] == "failed"
+        assert r["domain"] == "peer_loss"
+    for r in survivors:
+        assert r["peer"] == victim
+        # well under the 30 s stage deadline: lease detection + fan-out
+        assert r["elapsed"] < 2 * _LEASE_S + 0.5, (
+            f"survivor {r['pid']} took {r['elapsed']:.2f}s")
+    assert out["live_stages"] == {}
+
+
+@pytest.mark.distributed
+def test_chaos_transient_rendezvous_recovers_next_epoch():
+    """rendezvous invariant: one transient fault → every participant
+    re-enters at epoch+1 under the shared policy and the stage completes
+    with results identical to a clean run."""
+    from spark_rapids_tpu.parallel import rendezvous as RD
+
+    base = RD.counters_snapshot()["epoch_retries"]
+    out = run_rendezvous_chaos({"rendezvous": (1, 1)}, nprocs=3,
+                               lease_s=_LEASE_S)
+    for r in out["records"]:
+        assert r["status"] == "ok", r["error"]
+        assert r["result"] == out["expected"]
+    assert RD.counters_snapshot()["epoch_retries"] > base
+    assert out["live_stages"] == {}
+
+
+# ---------------------------------------------------------------------------
 # randomized soak (slow tier): seeds × random schedules, same invariant
 # ---------------------------------------------------------------------------
 
@@ -175,3 +223,26 @@ def test_randomized_chaos_soak(seed):
     if rec["status"] == "failed":
         # only the non-degradable IO domains may fail terminally
         assert rec["domain"] in ("shuffle_ser", "shuffle_exchange")
+
+
+@pytest.mark.slow
+@pytest.mark.distributed(timeout=120)
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_rendezvous_chaos_soak(seed):
+    """Seed-randomized soak over the distributed domains: whatever the
+    schedule, every participant either completes with the full payload
+    set or fails with a clean domain-tagged error — never a hang, never
+    a bare ``InjectedDeviceError``, never a leaked stage."""
+    sched = random_chaos_schedule(seed,
+                                  domains=["rendezvous", "peer_loss"])
+    out = run_rendezvous_chaos(sched, nprocs=3, lease_s=_LEASE_S)
+    for r in out["records"]:
+        if r["status"] == "ok":
+            assert r["result"] == out["expected"]
+        else:
+            assert r["domain"] in ("rendezvous", "peer_loss")
+    # one participant dying must fail the others; all-ok otherwise
+    st = {r["status"] for r in out["records"]}
+    if any(r["died"] for r in out["records"]):
+        assert st == {"failed"}
+    assert out["live_stages"] == {}

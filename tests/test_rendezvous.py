@@ -2,7 +2,9 @@
 
 The deterministic multi-node shuffle test the reference lacks (SURVEY
 §4.2): real OS processes, a real coordinator, jax.distributed collectives
-over the cross-process mesh.
+over the cross-process mesh.  Plus in-process coordinator edge-case
+coverage: exception taxonomy, stage GC, abort fan-out, coordinator
+restart recovery, and heartbeat-lease expiry latency.
 """
 
 import multiprocessing as mp
@@ -15,7 +17,8 @@ import numpy as np
 import pytest
 
 from spark_rapids_tpu.parallel.rendezvous import (
-    RendezvousClient, RendezvousCoordinator, RendezvousTimeout)
+    RendezvousAborted, RendezvousClient, RendezvousCoordinator,
+    RendezvousProtocolError, RendezvousTimeout, run_stage_epochs)
 
 
 def _free_port() -> int:
@@ -28,6 +31,7 @@ def _free_port() -> int:
 # coordinator unit tests (in-process)
 # ---------------------------------------------------------------------------
 
+@pytest.mark.distributed
 def test_allgather_returns_all_payloads():
     coord = RendezvousCoordinator(num_processes=3)
     out = [None] * 3
@@ -46,6 +50,7 @@ def test_allgather_returns_all_payloads():
     coord.shutdown()
 
 
+@pytest.mark.distributed
 def test_rendezvous_timeout_fails_all_waiters():
     coord = RendezvousCoordinator(num_processes=2)
     c = RendezvousClient(coord.address, 0)
@@ -56,7 +61,11 @@ def test_rendezvous_timeout_fails_all_waiters():
     coord.shutdown()
 
 
+@pytest.mark.distributed
 def test_duplicate_registration_rejected():
+    """A duplicate pid is a PROTOCOL error for the duplicate caller only
+    — the stage itself proceeds untouched (no more timeout mislabeling,
+    no dead-ended stage)."""
     coord = RendezvousCoordinator(num_processes=2)
 
     def second():
@@ -72,7 +81,7 @@ def test_duplicate_registration_rejected():
     t1 = threading.Thread(target=first)
     t1.start()
     time.sleep(0.2)
-    with pytest.raises(RendezvousTimeout):
+    with pytest.raises(RendezvousProtocolError):
         RendezvousClient(coord.address, 0).allgather("dup", 99,
                                                      timeout=2)
     t.start()
@@ -82,9 +91,157 @@ def test_duplicate_registration_rejected():
     coord.shutdown()
 
 
+@pytest.mark.distributed
+def test_straggler_abort_reaches_every_waiter():
+    """A deadline failure fails EVERY waiter, and a straggler arriving
+    after the failure hits the stage's tombstone immediately instead of
+    waiting out its own full deadline."""
+    coord = RendezvousCoordinator(num_processes=3)
+    errs = [None, None]
+
+    def run(pid):
+        try:
+            RendezvousClient(coord.address, pid).allgather(
+                "strag:x", pid, timeout=1.0)
+        except Exception as e:
+            errs[pid] = e
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert all(isinstance(e, RendezvousTimeout) for e in errs), errs
+    # the straggler (pid 2) arrives late with a LONG deadline — the
+    # tombstone must abort it fast, not let it park for 30 s
+    t0 = time.monotonic()
+    with pytest.raises(RendezvousTimeout):
+        RendezvousClient(coord.address, 2).allgather("strag:x", 2,
+                                                     timeout=30.0)
+    assert time.monotonic() - t0 < 5.0
+    coord.shutdown()
+
+
+@pytest.mark.distributed
+def test_completed_stage_gc():
+    """The last waiter out deletes the stage: ``_stages`` is empty after
+    every completed (or failed) stage — the leak and the 'registered
+    twice' dead-end are gone."""
+    coord = RendezvousCoordinator(num_processes=3)
+
+    def run_query(pid):
+        c = RendezvousClient(coord.address, pid)
+        c.allgather("q:shape", {"pid": pid})
+        c.barrier("q:enter")
+
+    threads = [threading.Thread(target=run_query, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert coord._stages == {}
+    # failed stages GC too (tombstone replaces the live entry)
+    with pytest.raises(RendezvousTimeout):
+        RendezvousClient(coord.address, 0).allgather("q2:x", 0,
+                                                     timeout=0.5)
+    assert coord._stages == {}
+    coord.shutdown()
+
+
+@pytest.mark.distributed(timeout=120)
+def test_client_retry_after_coordinator_restart():
+    """Clients running under ``run_stage_epochs`` survive a coordinator
+    restart mid-stage: the orphaned epoch is abandoned, both sides
+    converge on a later epoch (tombstone ``min_epoch`` hints), and the
+    stage completes on the new coordinator."""
+    from spark_rapids_tpu.runtime.resilience import RetryPolicy
+
+    port = _free_port()
+    coord1 = RendezvousCoordinator(num_processes=2, port=port)
+    addr = coord1.address
+    policy = RetryPolicy(backoff_base_ms=0, max_attempts=10)
+    out = [None, None]
+    errs = [None, None]
+
+    def run(pid):
+        try:
+            client = RendezvousClient(addr, pid, default_timeout=2.0)
+
+            def attempt(epoch):
+                return client.allgather("restart:x", pid, epoch=epoch)
+
+            out[pid] = run_stage_epochs(client, "restart", attempt,
+                                        policy=policy)
+        except Exception as e:  # pragma: no cover - assertion surface
+            errs[pid] = e
+
+    t0 = threading.Thread(target=run, args=(0,))
+    t0.start()
+    time.sleep(0.5)            # pid 0 is now parked at epoch 0
+    coord1.shutdown()          # coordinator dies mid-stage
+    coord2 = RendezvousCoordinator(num_processes=2, port=port)
+    t1 = threading.Thread(target=run, args=(1,))
+    t1.start()
+    t0.join(timeout=90)
+    t1.join(timeout=90)
+    assert errs == [None, None], errs
+    assert out[0] == out[1] == [0, 1]
+    assert coord2._stages == {}
+    coord2.shutdown()
+
+
+@pytest.mark.distributed
+def test_lease_expiry_abort_latency():
+    """A silent peer is detected by the lease and every survivor's
+    in-flight stage aborts peer-tagged within 2× the lease — no waiting
+    out the 30 s stage deadline."""
+    lease = 0.5
+    coord = RendezvousCoordinator(num_processes=2, lease_s=lease)
+    a = RendezvousClient(coord.address, 0, default_timeout=30.0)
+    b = RendezvousClient(coord.address, 1)
+    a.start_heartbeat(0.1)
+    b.start_heartbeat(0.1)
+    time.sleep(0.2)
+    b.simulate_death()
+    t0 = time.monotonic()
+    with pytest.raises(RendezvousAborted) as ei:
+        a.allgather("lease:x", 0)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2 * lease, f"abort took {elapsed:.2f}s"
+    assert ei.value.peer == 1
+    assert ei.value.transient is False
+    assert "executor 1" in str(ei.value)
+    a.stop_heartbeat()
+    coord.shutdown()
+
+
 # ---------------------------------------------------------------------------
 # full multi-process shuffle stage
 # ---------------------------------------------------------------------------
+
+# Some jaxlib builds (no gloo) cannot run one XLA program across
+# processes on the CPU backend.  The rendezvous protocol itself — the
+# subject of these tests up to the collective — still runs; workers
+# report "skip" instead of "err" when only the collective is missing.
+_MP_UNSUPPORTED = "Multiprocess computations aren't implemented"
+_MP_BACKEND_MISSING = [False]  # memo: skip later tests without spin-up
+
+
+def _maybe_skip_multiproc(results):
+    skips = [r for r in results if r[0] == "skip"]
+    if skips:
+        _MP_BACKEND_MISSING[0] = True
+        pytest.skip("XLA CPU backend in this jaxlib build cannot run "
+                    "cross-process computations: " +
+                    skips[0][2].splitlines()[-1])
+
+
+def _fast_skip_if_backend_missing():
+    if _MP_BACKEND_MISSING[0]:
+        pytest.skip("XLA CPU backend cannot run cross-process "
+                    "computations (established by an earlier test)")
+
 
 def _worker(pid, nprocs, jax_port, rdv_addr, q):
     try:
@@ -128,12 +285,16 @@ def _worker(pid, nprocs, jax_port, rdv_addr, q):
             gpid = pid * len(ex.local_devices) + li
             got.append((gpid, kk.tolist(), vv.tolist()))
         q.put(("ok", pid, rows, got))
-    except Exception as e:  # pragma: no cover
+    except Exception:  # pragma: no cover
         import traceback
-        q.put(("err", pid, traceback.format_exc(), None))
+        tb = traceback.format_exc()
+        q.put(("skip" if _MP_UNSUPPORTED in tb else "err",
+               pid, tb, None))
 
 
+@pytest.mark.distributed(timeout=300)
 def test_multiprocess_shuffle_stage():
+    _fast_skip_if_backend_missing()
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     nprocs = 2
@@ -153,9 +314,12 @@ def test_multiprocess_shuffle_stage():
             p.join(timeout=60)
             if p.is_alive():
                 p.terminate()
+        stages_left = dict(coord._stages)
         coord.shutdown()
     errs = [r for r in results if r[0] == "err"]
     assert not errs, errs[0][2]
+    assert stages_left == {}, f"stage leak: {stages_left}"
+    _maybe_skip_multiproc(results)
 
     all_rows = sorted(r for res in results for r in res[2])
     received = {}
@@ -175,3 +339,105 @@ def test_multiprocess_shuffle_stage():
     from spark_rapids_tpu.columnar import dtypes as T
     for k, home in key_home.items():
         assert home == HH.spark_hash_py([k], [T.LongT]) % 4
+
+
+def _chaos_worker(pid, nprocs, jax_port, rdv_addr, q):
+    """Worker for the transient-rendezvous chaos test: pid 0 arms a
+    single transient ``rendezvous`` fault, runs a faulted stage (which
+    must recover at epoch+1) and then a clean stage over the SAME
+    shards, and reports whether the two results are bit-identical."""
+    try:
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        from spark_rapids_tpu.conf import RapidsConf
+        from spark_rapids_tpu.parallel import rendezvous as RD
+        from spark_rapids_tpu.runtime import resilience as R
+        ex = RD.DistributedShuffleExecutor(
+            f"127.0.0.1:{jax_port}", rdv_addr, pid, nprocs,
+            timeout=60.0, heartbeat_s=0.2)
+
+        import pyarrow as pa
+        from spark_rapids_tpu.columnar import dtypes as T
+        from spark_rapids_tpu.columnar.column import host_to_device
+        from spark_rapids_tpu.ops.expressions import BoundReference
+
+        rng = np.random.default_rng(100 + pid)
+        per = 64
+        local_shards = []
+        for li, dev in enumerate(ex.local_devices):
+            k = rng.integers(0, 37, per)
+            v = ((pid * len(ex.local_devices) + li) * 1_000_000
+                 + np.arange(per))
+            tbl = pa.table({"k": pa.array(k), "v": pa.array(v)})
+            local_shards.append(
+                jax.device_put(host_to_device(tbl, bucket=per), dev))
+        keys = [BoundReference(0, T.LongT)]
+        R.configure_policy(RapidsConf(
+            {"spark.rapids.tpu.retry.backoffBaseMs": 0}))
+        if pid == 0:
+            R.INJECTOR.configure({"rendezvous": (1, 1)})
+        faulted = ex.shuffle_stage("stage-0", local_shards,
+                                   local_shards[0].schema, keys)
+        clean = ex.shuffle_stage("stage-1", local_shards,
+                                 local_shards[0].schema, keys)
+
+        def snap(outs):
+            return [[np.asarray(l).tolist()
+                     for l in jax.tree.flatten(ob)[0]] for ob in outs]
+
+        q.put(("ok", pid, snap(faulted) == snap(clean),
+               RD.counters_snapshot()))
+    except Exception:  # pragma: no cover
+        import traceback
+        tb = traceback.format_exc()
+        q.put(("skip" if _MP_UNSUPPORTED in tb else "err",
+               pid, tb, None))
+
+
+@pytest.mark.chaos
+@pytest.mark.distributed(timeout=300)
+def test_multiprocess_shuffle_transient_rendezvous_chaos():
+    """End-to-end chaos invariant over real processes: one transient
+    ``rendezvous`` fault → the stage retries at epoch+1 under the shared
+    policy in EVERY process, the result is bit-identical to the
+    unfaulted stage, and the coordinator's stage table drains."""
+    _fast_skip_if_backend_missing()
+    from spark_rapids_tpu.parallel import rendezvous as RD
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    nprocs = 2
+    jax_port = _free_port()
+    coord = RendezvousCoordinator(num_processes=nprocs)
+    base_aborts = RD.counters_snapshot()["aborts"].get("requested", 0)
+    procs = [ctx.Process(target=_chaos_worker,
+                         args=(i, nprocs, jax_port, coord.address, q))
+             for i in range(nprocs)]
+    for p in procs:
+        p.start()
+    results = []
+    try:
+        for _ in range(nprocs):
+            results.append(q.get(timeout=240))
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        stages_left = dict(coord._stages)
+        coord.shutdown()
+    errs = [r for r in results if r[0] == "err"]
+    assert not errs, errs[0][2]
+    assert stages_left == {}, f"stage leak: {stages_left}"
+    _maybe_skip_multiproc(results)
+    assert all(r[2] for r in results), (
+        "faulted stage result differs from clean stage result")
+    by_pid = {r[1]: r[3] for r in results}
+    # the injected process re-entered at a bumped epoch (client side)...
+    assert by_pid[0]["epoch_retries"] >= 1
+    # ...and told the coordinator to poison the abandoned epoch
+    now_aborts = RD.counters_snapshot()["aborts"].get("requested", 0)
+    assert now_aborts > base_aborts
