@@ -1063,9 +1063,25 @@ TPCH_SF1_CONF.update(json.loads(os.environ.get(
 
 
 def _sf1_query_main(name: str) -> None:
-    """Child-process entry: warm + time one SF1 query, print the time."""
+    """Child-process entry: warm + time one SF1 query, print the time.
+
+    The per-query deadline is enforced IN-PROCESS through the engine's
+    cancellation layer (``toArrow(timeout_ms=...)``): on expiry the
+    engine raises ``QueryCancelled(reason="deadline")``, reclaims its
+    resources, and the child reports a clean "timeout" outcome — the
+    parent's subprocess kill remains only as a backstop for a child
+    that stops responding entirely."""
+    from spark_rapids_tpu.runtime.cancel import QueryCancelled
     from spark_rapids_tpu.sql.session import TpuSession
     build = TPCH_BUILDERS[name]
+    deadline_s = float(os.environ.get("TPUQ_BENCH_QUERY_DEADLINE_S", "0"))
+    t_child0 = time.monotonic()
+
+    def remaining_ms():
+        if deadline_s <= 0:
+            return None
+        return max((deadline_s - (time.monotonic() - t_child0)) * 1e3, 1.0)
+
     sf1 = gen_tpch(1.0)
     # span tracing on for the measured reps: per-span cost is ~1 µs of
     # perf_counter + one object against multi-second queries, and the
@@ -1074,8 +1090,15 @@ def _sf1_query_main(name: str) -> None:
     conf = dict(TPCH_SF1_CONF)
     conf["spark.rapids.sql.trace.enabled"] = True
     dfq = build(TpuSession(conf), sf1)
-    dfq.toArrow()  # warm (compile)
-    t, _ = timed(lambda: dfq.toArrow(), reps=2)
+    try:
+        dfq.toArrow(timeout_ms=remaining_ms())  # warm (compile)
+        t, _ = timed(lambda: dfq.toArrow(timeout_ms=remaining_ms()),
+                     reps=2)
+    except QueryCancelled as e:
+        outcome = "timeout" if e.reason == "deadline" else "cancelled"
+        print(f"TPCH_SF1_OUTCOME={outcome}")
+        return
+    print("TPCH_SF1_OUTCOME=ok")
     print(f"TPCH_SF1_SECONDS={t:.3f}")
     rollup = getattr(dfq, "_last_rollup", None)
     if rollup:
@@ -1135,9 +1158,14 @@ def _sf1_query_main(name: str) -> None:
 
 
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
-    """Returns (seconds | "timeout" | None, fallback_summary | None,
-    op_rollup | None, memory_stats | None).  A per-query deadline means
-    one slow query records "timeout" and the run moves on — it can never
+    """Returns (seconds | "timeout" | "cancelled" | None,
+    fallback_summary | None, op_rollup | None, memory_stats | None).
+    The per-query deadline is enforced IN-PROCESS by the child (the
+    engine's cancellation layer raises ``QueryCancelled`` at the
+    deadline and reclaims resources); the subprocess timeout is kept
+    only as a backstop — with a grace window on top of the in-process
+    deadline — for a child too wedged to cancel itself.  Either way one
+    slow query records "timeout" and the run moves on; it can never
     null every later query the way the old whole-run kill did
     (BENCH_r05, rc=124)."""
     import subprocess
@@ -1145,18 +1173,23 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
         return None, None, None, None
+    env = dict(os.environ)
+    env["TPUQ_BENCH_QUERY_DEADLINE_S"] = f"{budget_s:.0f}"
     try:
         out = subprocess.run(
             [sys.executable, os.path.abspath(__file__),
              "--sf1-query", name],
-            capture_output=True, text=True,
-            timeout=budget_s)
+            capture_output=True, text=True, env=env,
+            timeout=budget_s + 60)  # backstop only
     except subprocess.TimeoutExpired:
-        mark(f"{name}: timed out after {budget_s:.0f}s (compile budget)")
+        mark(f"{name}: BACKSTOP kill after {budget_s + 60:.0f}s — the "
+             f"in-process deadline failed to cancel the query")
         return "timeout", None, None, None
-    secs = fb = rollup = mem = None
+    secs = fb = rollup = mem = outcome = None
     for line in (out.stdout or "").splitlines():
-        if line.startswith("TPCH_SF1_SECONDS="):
+        if line.startswith("TPCH_SF1_OUTCOME="):
+            outcome = line.split("=", 1)[1].strip()
+        elif line.startswith("TPCH_SF1_SECONDS="):
             secs = round(float(line.split("=", 1)[1]), 3)
         elif line.startswith("TPCH_SF1_FALLBACK="):
             fb = json.loads(line.split("=", 1)[1])
@@ -1164,6 +1197,10 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             rollup = json.loads(line.split("=", 1)[1])
         elif line.startswith("TPCH_SF1_MEMORY="):
             mem = json.loads(line.split("=", 1)[1])
+    if outcome in ("timeout", "cancelled"):
+        mark(f"{name}: {outcome} after {budget_s:.0f}s (in-process "
+             f"deadline, resources reclaimed)")
+        return outcome, None, None, None
     if secs is not None:
         return secs, fb, rollup, mem
     # crashed child: surface the failure, don't blur it into a timeout

@@ -697,6 +697,33 @@ QUERY_LOG_MAX_EVENTS = (
     .create_with_default(100000)
 )
 
+QUERY_TIMEOUT_MS = (
+    conf("spark.rapids.tpu.query.timeoutMs")
+    .doc("Per-query deadline in milliseconds, enforced in-process by "
+         "the cooperative cancellation layer (runtime/cancel.py): when "
+         "a query exceeds it, every blocking boundary raises "
+         "QueryCancelled(reason='deadline') and the engine reclaims "
+         "all of the query's resources (semaphore permits, HBM "
+         "reservations, spill files). An explicit "
+         "collect(timeout_ms=...) overrides this. <= 0 disables.")
+    .category("lifecycle")
+    .integer()
+    .create_with_default(0)
+)
+
+CANCEL_POLL_MS = (
+    conf("spark.rapids.tpu.query.cancelPollMs")
+    .doc("Upper bound on how long any blocking wait (semaphore, retry "
+         "backoff, spill IO, shuffle, rendezvous) may park before "
+         "re-polling the query's CancelToken. Cancels and deadline "
+         "expiries surface within ~2x this interval; registered "
+         "waiters (the device semaphore) wake immediately.")
+    .category("lifecycle")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(50)
+)
+
 FAULT_INJECT = (
     conf("spark.rapids.tpu.test.injectOomAtAlloc")
     .doc("Force an OOM at the Nth device allocation (test hook, mirrors "

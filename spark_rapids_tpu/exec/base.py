@@ -20,6 +20,7 @@ import time
 from typing import Dict, Iterator, Tuple
 
 from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import trace
 
 # Metric verbosity levels [REF: GpuMetrics.scala :: MetricsLevel] —
@@ -114,10 +115,25 @@ def _traced_pump(node: "ExecNode", partition: int, it: Iterator) -> Iterator:
         yield batch
 
 
+def _cancellable_pump(tok, it: Iterator) -> Iterator:
+    """Poll the query's CancelToken before each pumped batch — every
+    operator boundary in the plan becomes a cancellation point."""
+    while True:
+        tok.check()
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        yield batch
+
+
 def _wrap_execute(fn):
     @functools.wraps(fn)
     def execute(self, partition: int) -> Iterator:
         it = fn(self, partition)
+        tok = cancel.current()
+        if tok is not None:
+            it = _cancellable_pump(tok, it)
         if trace.current() is None:  # fast path: tracing off
             return it
         return _traced_pump(self, partition, it)

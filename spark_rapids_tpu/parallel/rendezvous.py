@@ -531,7 +531,9 @@ class RendezvousClient:
     def allgather(self, stage_id: str, payload=None,
                   timeout: Optional[float] = None,
                   epoch: int = 0) -> List[Any]:
+        from spark_rapids_tpu.runtime import cancel
         from spark_rapids_tpu.runtime import resilience as R
+        cancel.check()  # don't enter a barrier the query already left
         R.INJECTOR.on("rendezvous")
         if self.dead:
             raise RendezvousAborted(
@@ -580,7 +582,7 @@ class RendezvousClient:
 
 def run_stage_epochs(client: RendezvousClient, stage_id: str,
                      attempt_fn: Callable[[int], Any], *,
-                     policy=None) -> Any:
+                     policy=None, token=None) -> Any:
     """Run ``attempt_fn(epoch)`` under the shared ``RetryPolicy`` with
     epoch bumping — the distributed analog of ``RetryPolicy.run``.
 
@@ -591,11 +593,28 @@ def run_stage_epochs(client: RendezvousClient, stage_id: str,
     clients converge instead of leapfrogging).  A confirmed-dead peer
     surfaces as a peer-tagged ``TerminalDeviceError('peer_loss')`` on
     every survivor; a ``peer_loss`` injection on THIS client simulates
-    the death itself."""
+    the death itself.
+
+    ``token`` is this participant's CancelToken (defaults to the active
+    query's).  A cancel fast-aborts the stage for EVERYONE — the
+    cancelled participant poisons the epoch non-transiently (tagged
+    with its own pid, so survivors fail like they would on a dead peer)
+    and raises ``QueryCancelled`` instead of re-entering."""
+    from spark_rapids_tpu.runtime import cancel as _cancel
     from spark_rapids_tpu.runtime import resilience as R
 
     pol = policy if policy is not None else R.get_policy()
+    tok = token if token is not None else _cancel.current()
     state = {"epoch": 0}
+
+    def _cancel_abort() -> None:
+        # runs on the cancel thread, waking peers (and this
+        # participant) out of a parked allgather; the coordinator's
+        # tombstone is first-wins, so a repeated abort is harmless
+        client.abort(
+            stage_id, state["epoch"],
+            f"pid {client.pid} cancelled during {stage_id}",
+            transient=False, peer=client.pid)
 
     def _advance(min_epoch: int, why: str) -> None:
         nxt = max(state["epoch"] + 1, min_epoch)
@@ -607,20 +626,28 @@ def run_stage_epochs(client: RendezvousClient, stage_id: str,
 
     def attempt():
         epoch = state["epoch"]
+        if tok is not None and tok.cancelled():
+            _cancel_abort()
+            tok.check()  # raises QueryCancelled
         try:
             R.INJECTOR.on("peer_loss")
         except R.InjectedDeviceError as e:
             client.simulate_death()
             raise R.TerminalDeviceError("peer_loss", e) from e
+        unhook = tok.on_cancel(_cancel_abort) if tok is not None else None
         try:
             return attempt_fn(epoch)
         except RendezvousAborted as e:
+            if tok is not None and tok.cancelled():
+                tok.check()  # our own cancel-abort came back around
             if not e.transient:
                 dom = "peer_loss" if e.peer is not None else "rendezvous"
                 raise R.TerminalDeviceError(dom, e) from e
             _advance(e.min_epoch, str(e))
             raise
         except RendezvousTimeout as e:
+            if tok is not None and tok.cancelled():
+                tok.check()
             _advance(0, str(e))
             raise
         except R.InjectedDeviceError as e:
@@ -635,6 +662,12 @@ def run_stage_epochs(client: RendezvousClient, stage_id: str,
                         f"terminal rendezvous fault on pid "
                         f"{client.pid}: {e}", transient=False)
             raise
+        except _cancel.QueryCancelled:
+            # a nested cancellation point fired mid-stage: poison the
+            # epoch peer-tagged, like a dead peer — survivors fail
+            # together instead of waiting out their deadline
+            _cancel_abort()
+            raise
         except BaseException as e:
             # non-rendezvous failure mid-stage (compile error, local
             # crash): poison the epoch so peers fail now instead of
@@ -644,6 +677,9 @@ def run_stage_epochs(client: RendezvousClient, stage_id: str,
                          f"pid {client.pid} failed mid-stage: {e}",
                          transient=False)
             raise
+        finally:
+            if unhook is not None:
+                unhook()
 
     return pol.run("rendezvous", attempt, op=stage_id)
 

@@ -1,0 +1,341 @@
+"""Query lifecycle layer: cooperative cancellation + deadlines.
+
+[REF: Spark's task-kill/interrupt lifecycle (TaskContext.isInterrupted
+ polled by long-running tasks) + spill/SpillFramework.scala's
+ close-on-task-completion guarantees; GpuSemaphore.scala releases its
+ permit on task completion callbacks, cancelled or not.]
+
+The engine can retry (runtime/resilience.py) and detect dead peers
+(parallel/rendezvous.py) but a serving stack must also be able to
+**stop**: any query can be cancelled (``session.cancel(query_id)``) or
+deadlined (``df.collect(timeout_ms=...)`` /
+``spark.rapids.tpu.query.timeoutMs``) and the engine returns to a clean
+steady state — semaphore permits released, HBM reservations unwound,
+spill files unlinked, rendezvous peers fast-aborted.
+
+Design: one ``CancelToken`` per query, opened by the query boundary
+(``DataFrame.toArrow``) and **polled at every blocking boundary**:
+
+* exec pump loops (``exec/base.py`` wraps every ``execute``),
+* ``DeviceSemaphore.acquire`` (deadline-aware wait a cancel wakes),
+* ``RetryPolicy`` backoff sleeps and the OOM retry loop,
+* spill write/read (via the guarded retry loop) and shuffle exchange
+  materialization,
+* rendezvous stage waits (a cancel fast-aborts the epoch so peers are
+  not wedged waiting for a cancelled participant).
+
+Cancellation is COOPERATIVE: a blocking wait either registers its
+condition variable with the token (woken instantly) or bounds the wait
+by ``spark.rapids.tpu.query.cancelPollMs`` — either way a cancel
+surfaces as ``QueryCancelled`` within ~2x the poll interval.  The
+query boundary then guarantees reclamation (see
+``DataFrame._reclaim_cancelled``): ``DeviceMemoryManager.report_leaks()``
+returns 0 after every cancelled query.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from spark_rapids_tpu.runtime import telemetry as TM
+
+_TM_CANCELLED = TM.REGISTRY.labeled_counter(
+    "tpuq_query_cancelled_total",
+    "queries cancelled, by reason (user | deadline)", label="reason")
+_TM_LATENCY = TM.REGISTRY.histogram(
+    "tpuq_cancel_latency_seconds",
+    "cancel-request (or deadline-expiry) to QueryCancelled-raised "
+    "latency")
+
+DEFAULT_POLL_S = 0.05
+
+
+class QueryCancelled(RuntimeError):
+    """The query's CancelToken fired.  Non-retryable by design: the
+    retry policy, the OOM retry framework, and the rendezvous epoch
+    loop all propagate it unchanged (it is not a fault — it is an
+    order)."""
+
+    def __init__(self, reason: str, query_id: Optional[int] = None,
+                 detail: str = ""):
+        msg = f"query {query_id} cancelled ({reason})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.reason = reason          # "user" | "deadline"
+        self.query_id = query_id
+
+
+class CancelToken:
+    """Per-query cancel/deadline state, polled cooperatively.
+
+    Thread-safe; one token is shared by every pump/retry/spill thread
+    of its query.  ``check()`` is the poll: cheap when clean (one
+    attribute read + optional deadline compare), raises
+    ``QueryCancelled`` once the token fired.  The FIRST raise observes
+    ``tpuq_cancel_latency_seconds`` (time from the cancel request — or
+    the deadline instant — to the raise) and counts
+    ``tpuq_query_cancelled_total{reason}``.
+    """
+
+    def __init__(self, query_id: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 poll_ms: float = DEFAULT_POLL_S * 1000.0):
+        self.query_id = query_id
+        self.poll_s = max(float(poll_ms) / 1000.0, 0.001)
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self.reason: Optional[str] = None
+        self.detail: str = ""
+        self._deadline: Optional[float] = None
+        if timeout_ms is not None and timeout_ms > 0:
+            self._deadline = time.monotonic() + float(timeout_ms) / 1000.0
+        # monotonic instant the cancel became effective (request time
+        # for user cancels, the deadline itself for expiries)
+        self._effective_at: Optional[float] = None
+        self._observed = False
+        self.latency_s: Optional[float] = None
+        self._waiters: List[threading.Condition] = []
+        self._callbacks: List[Callable[[], None]] = []
+
+    # -- firing ---------------------------------------------------------
+
+    def cancel(self, reason: str = "user", detail: str = "") -> bool:
+        """Fire the token (first cancel wins; returns True on the
+        transition).  Wakes every registered waiter and runs every
+        registered callback — both OUTSIDE the token lock, so a
+        callback/waiter may itself call back into the token."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self.reason = reason
+            self.detail = detail
+            self._effective_at = time.monotonic()
+            self._event.set()
+            waiters = list(self._waiters)
+            callbacks = list(self._callbacks)
+        for cv in waiters:
+            with cv:
+                cv.notify_all()
+        for cb in callbacks:
+            try:
+                cb()
+            except Exception:
+                pass  # best-effort (e.g. abort to a dead coordinator)
+        return True
+
+    def _deadline_fired(self) -> bool:
+        if self._deadline is None or time.monotonic() < self._deadline:
+            return False
+        with self._lock:
+            if not self._event.is_set():
+                self.reason = "deadline"
+                self.detail = "query deadline expired"
+                self._effective_at = self._deadline
+                self._event.set()
+                waiters = list(self._waiters)
+            else:
+                waiters = []
+        for cv in waiters:
+            with cv:
+                cv.notify_all()
+        return True
+
+    def cancelled(self) -> bool:
+        return self._event.is_set() or self._deadline_fired()
+
+    def check(self) -> None:
+        """The poll: raise ``QueryCancelled`` once fired."""
+        if not self.cancelled():
+            return
+        with self._lock:
+            if not self._observed:
+                self._observed = True
+                self.latency_s = max(
+                    0.0, time.monotonic() - (self._effective_at
+                                             or time.monotonic()))
+                first = True
+            else:
+                first = False
+        if first:
+            _TM_CANCELLED.inc(self.reason or "user")
+            _TM_LATENCY.observe(self.latency_s)
+        raise QueryCancelled(self.reason or "user", self.query_id,
+                             self.detail)
+
+    # -- waiting --------------------------------------------------------
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds until the deadline (None when undeadlined)."""
+        if self._deadline is None:
+            return None
+        return max(0.0, self._deadline - time.monotonic())
+
+    def wait_interval(self, want: Optional[float] = None) -> float:
+        """How long a blocking wait may park before it must re-poll:
+        min(poll interval, remaining deadline, the caller's own
+        bound)."""
+        out = self.poll_s
+        rem = self.remaining_s()
+        if rem is not None:
+            out = min(out, max(rem, 0.001))
+        if want is not None:
+            out = min(out, max(want, 0.0))
+        return out
+
+    def sleep(self, seconds: float) -> None:
+        """Cancellable sleep: returns after ``seconds`` or raises
+        ``QueryCancelled`` within one poll interval of a cancel."""
+        deadline = time.monotonic() + max(seconds, 0.0)
+        while True:
+            self.check()
+            rem = deadline - time.monotonic()
+            if rem <= 0:
+                return
+            self._event.wait(self.wait_interval(rem))
+
+    def add_waiter(self, cv: threading.Condition) -> None:
+        """Register a condition variable to ``notify_all`` on cancel —
+        waiters wake instantly instead of at the next poll tick."""
+        with self._lock:
+            self._waiters.append(cv)
+
+    def remove_waiter(self, cv: threading.Condition) -> None:
+        with self._lock:
+            try:
+                self._waiters.remove(cv)
+            except ValueError:
+                pass
+
+    def on_cancel(self, cb: Callable[[], None]) -> Callable[[], None]:
+        """Register a callback run (once) on cancel; returns an
+        unregister function.  If the token already fired the callback
+        runs immediately.  Deadline expiries discovered lazily by a
+        poll do NOT run callbacks (there is no thread to run them at
+        the deadline instant) — pair callbacks with a poll."""
+        with self._lock:
+            fired = self._event.is_set()
+            if not fired:
+                self._callbacks.append(cb)
+
+        def remove():
+            with self._lock:
+                try:
+                    self._callbacks.remove(cb)
+                except ValueError:
+                    pass
+
+        if fired:
+            try:
+                cb()
+            except Exception:
+                pass
+        return remove
+
+
+# ---------------------------------------------------------------------------
+# process-wide query scope (mirrors resilience._QueryState: one active
+# query scope; nested executions join the outer scope)
+# ---------------------------------------------------------------------------
+
+class _Scope:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.token: Optional[CancelToken] = None
+        self.depth = 0
+
+
+_SCOPE = _Scope()
+_ACTIVE: Dict[int, CancelToken] = {}   # query_id -> token (in-flight)
+_ACTIVE_LOCK = threading.Lock()
+
+
+def begin_query(query_id: int, conf=None,
+                timeout_ms: Optional[float] = None
+                ) -> Optional[CancelToken]:
+    """Open (or join) the query's cancel scope.  Returns the token for
+    the OUTERMOST open (the handle ``finish_query`` needs); nested
+    executions join the outer token and get None.  ``timeout_ms``
+    overrides ``spark.rapids.tpu.query.timeoutMs``; <= 0 means no
+    deadline."""
+    poll_ms = DEFAULT_POLL_S * 1000.0
+    conf_timeout = None
+    if conf is not None:
+        from spark_rapids_tpu import conf as C
+        poll_ms = float(conf.get(C.CANCEL_POLL_MS))
+        conf_timeout = float(conf.get(C.QUERY_TIMEOUT_MS))
+    eff = timeout_ms if timeout_ms is not None else conf_timeout
+    if eff is not None and eff <= 0:
+        eff = None
+    with _SCOPE.lock:
+        _SCOPE.depth += 1
+        if _SCOPE.depth > 1:
+            return None  # joined the outer query's token
+        tok = CancelToken(query_id, timeout_ms=eff, poll_ms=poll_ms)
+        _SCOPE.token = tok
+    with _ACTIVE_LOCK:
+        _ACTIVE[query_id] = tok
+    return tok
+
+
+def finish_query(token: Optional[CancelToken]) -> None:
+    """Close the scope opened by ``begin_query`` (no-op for joiners)."""
+    with _SCOPE.lock:
+        _SCOPE.depth = max(0, _SCOPE.depth - 1)
+        if token is None or _SCOPE.depth > 0:
+            return
+        _SCOPE.token = None
+    if token.query_id is not None:
+        with _ACTIVE_LOCK:
+            _ACTIVE.pop(token.query_id, None)
+
+
+def current() -> Optional[CancelToken]:
+    """The active query's token (None outside any query scope)."""
+    return _SCOPE.token
+
+
+def check() -> None:
+    """Module-level poll: raise ``QueryCancelled`` if the active
+    query's token fired.  Free outside a query scope."""
+    tok = _SCOPE.token
+    if tok is not None:
+        tok.check()
+
+
+def sleep(seconds: float) -> None:
+    """Cancellable sleep under the active token; a plain sleep outside
+    any query scope."""
+    tok = _SCOPE.token
+    if tok is not None:
+        tok.sleep(seconds)
+    else:
+        time.sleep(seconds)  # cancel-exempt: no query scope to cancel
+
+
+def cancel_query(query_id: int, reason: str = "user",
+                 detail: str = "") -> bool:
+    """Cancel one in-flight query by id (``session.cancel`` backend).
+    Returns False when no such query is active."""
+    with _ACTIVE_LOCK:
+        tok = _ACTIVE.get(query_id)
+    if tok is None:
+        return False
+    return tok.cancel(reason, detail)
+
+
+def active_queries() -> List[int]:
+    """Query ids with an open cancel scope, oldest first."""
+    with _ACTIVE_LOCK:
+        return sorted(_ACTIVE)
+
+
+def reset() -> None:
+    """Test hook: drop any leaked scope state."""
+    with _SCOPE.lock:
+        _SCOPE.token = None
+        _SCOPE.depth = 0
+    with _ACTIVE_LOCK:
+        _ACTIVE.clear()

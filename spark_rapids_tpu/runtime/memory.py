@@ -23,16 +23,20 @@ test hook that makes the retry/spill path deterministically coverable
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import os
+import shutil
 import threading
 import uuid
+import zlib
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from spark_rapids_tpu.columnar import dtypes as T
 from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
+from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
 
@@ -60,6 +64,70 @@ class RetryOOM(RuntimeError):
 
 class SplitAndRetryOOM(RetryOOM):
     """Re-running whole won't fit; caller must halve the input."""
+
+
+# ---------------------------------------------------------------------------
+# spill-file integrity + per-process spill directory lifetime
+# ---------------------------------------------------------------------------
+
+def _file_crc32(path: str) -> int:
+    """CRC32 of a file's bytes, chunked (spill files can be large)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                return crc
+            crc = zlib.crc32(chunk, crc)
+
+
+def _write_crc_sidecar(path: str) -> None:
+    with open(path + ".crc32", "w") as f:
+        f.write(f"{_file_crc32(path):08x}\n")
+
+
+def _verify_crc_sidecar(path: str) -> None:
+    """Raise ``ValueError`` (spill_read-retryable, domain-tagged on
+    exhaustion) when the payload no longer matches its recorded CRC —
+    a garbled batch must never restore silently."""
+    sidecar = path + ".crc32"
+    if not os.path.exists(sidecar):
+        return  # pre-integrity spill file; np.load is the only check
+    with open(sidecar) as f:
+        want = int(f.read().strip(), 16)
+    got = _file_crc32(path)
+    if got != want:
+        raise ValueError(
+            f"spill file {path} corrupt: crc32 {got:08x} != "
+            f"recorded {want:08x}")
+
+
+def _unlink_spill(path: str) -> None:
+    for p in (path, path + ".crc32"):
+        if os.path.exists(p):
+            os.unlink(p)
+
+
+# every per-process spill subdirectory ever handed to a manager in this
+# process; one atexit hook removes them all, so a normal exit strands
+# no orphan .npz files under the shared spillPath root
+_SPILL_DIRS: set = set()
+_SPILL_DIRS_LOCK = threading.Lock()
+
+
+def _cleanup_spill_dirs() -> None:
+    with _SPILL_DIRS_LOCK:
+        dirs = list(_SPILL_DIRS)
+        _SPILL_DIRS.clear()
+    for d in dirs:
+        shutil.rmtree(d, ignore_errors=True)
+
+
+def _register_spill_dir(path: str) -> None:
+    with _SPILL_DIRS_LOCK:
+        if not _SPILL_DIRS:
+            atexit.register(_cleanup_spill_dirs)
+        _SPILL_DIRS.add(path)
 
 
 class SpillableBatch:
@@ -140,6 +208,9 @@ class SpillableBatch:
         def attempt():
             R.INJECTOR.on("spill_write")
             np.savez(path, *leaves)
+            # integrity sidecar: restore refuses a payload whose bytes
+            # no longer match what was written
+            _write_crc_sidecar(path)
             return True
 
         def degrade():
@@ -148,8 +219,7 @@ class SpillableBatch:
         if not R.run_guarded("spill_write", attempt, op="spill_to_disk",
                              degrade=degrade):
             self._disk_spill_failed = True
-            if os.path.exists(path):  # drop any partial file
-                os.unlink(path)
+            _unlink_spill(path)  # drop any partial file
             return 0
         self._disk_path = path
         self._treedef = treedef
@@ -177,13 +247,14 @@ class SpillableBatch:
             # no host path to degrade to.
             def attempt():
                 R.INJECTOR.on("spill_read")
+                _verify_crc_sidecar(self._disk_path)
                 with np.load(self._disk_path) as z:
                     return [z[k] for k in z.files]
 
             leaves = R.run_guarded("spill_read", attempt,
                                    op="spill_restore")
             self._host = (leaves, self._treedef)
-            os.unlink(self._disk_path)
+            _unlink_spill(self._disk_path)
             self._disk_path = None
         leaves, treedef = self._host
         self._mgr.reserve(self.nbytes, _restoring=self)
@@ -200,8 +271,9 @@ class SpillableBatch:
 
     def close(self):
         self._mgr._unregister(self)
-        if self._disk_path is not None and os.path.exists(self._disk_path):
-            os.unlink(self._disk_path)
+        if self._disk_path is not None:
+            _unlink_spill(self._disk_path)
+            self._disk_path = None
         self._batch = None
         self._host = None
 
@@ -235,7 +307,14 @@ class DeviceMemoryManager:
         self._reserved = 0
         self._host_used = 0
         self.host_limit = host_limit
-        self.spill_path = spill_path
+        # each manager spills into its own per-process subdirectory of
+        # the configured root — concurrent/killed processes sharing one
+        # spillPath can no longer collide, and the atexit hook removes
+        # the whole subtree on normal exit
+        self.spill_root = spill_path
+        self.spill_path = os.path.join(
+            spill_path, f"proc-{os.getpid()}-{uuid.uuid4().hex[:8]}")
+        _register_spill_dir(self.spill_path)
         self._alloc_count = 0
         self._inject_at = inject_oom_at
         self.metrics = {"spillToHostBytes": 0, "spillToDiskBytes": 0,
@@ -348,6 +427,18 @@ class DeviceMemoryManager:
                   f"(tier={s.tier}) never closed; created at:\n{origin}")
         return len(leaks)
 
+    def reclaim_all(self) -> int:
+        """Close every non-pinned registered spillable — the cancelled
+        query's reclamation sweep.  Closing releases device/host
+        accounting and unlinks disk spill files (+ CRC sidecars), so
+        ``report_leaks()`` returns 0 afterwards.  Returns the number of
+        batches reclaimed."""
+        n = 0
+        for s, _origin in self.leaked():
+            s.close()
+            n += 1
+        return n
+
     def _unregister(self, s: SpillableBatch) -> None:
         with self._lock:
             self._spillables.pop(id(s), None)
@@ -406,11 +497,11 @@ def get_manager(conf=None) -> DeviceMemoryManager:
         elif conf is not None:
             cfg = _build(conf)
             if (cfg.budget, cfg.host_limit, cfg._inject_at,
-                    cfg.retry_max_attempts, cfg.spill_path,
+                    cfg.retry_max_attempts, cfg.spill_root,
                     cfg.debug) != (
                     _manager.budget, _manager.host_limit,
                     _manager._inject_at, _manager.retry_max_attempts,
-                    _manager.spill_path, _manager.debug):
+                    _manager.spill_root, _manager.debug):
                 # a new manager orphans batches registered with the old
                 # one — evict the device-resident scan cache so nothing
                 # keeps accounting against the dead arbiter
@@ -418,6 +509,12 @@ def get_manager(conf=None) -> DeviceMemoryManager:
                 clear_scan_cache()
                 _manager = cfg
         return _manager
+
+
+def peek_manager() -> Optional[DeviceMemoryManager]:
+    """The process arbiter if one exists — never creates (the cancel
+    reclamation path must not instantiate state as a side effect)."""
+    return _manager
 
 
 def reset_manager() -> None:
@@ -507,6 +604,7 @@ def with_retry(
     it = iter(inputs)
     work: List[Tuple[DeviceBatch, int]] = []  # pending (sub-)batches
     while True:
+        cancel.check()
         if work:
             batch, attempts = work.pop(0)
         else:

@@ -62,6 +62,7 @@ import zipfile
 from typing import Callable, Dict, List, Optional, Tuple
 
 from spark_rapids_tpu import conf as C
+from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import telemetry as TM
 
 DOMAINS: Tuple[str, ...] = C.FAILURE_DOMAINS
@@ -370,6 +371,7 @@ class RetryPolicy:
         attempt = 0
         while True:
             attempt += 1
+            cancel.check()
             try:
                 return fn()
             except BaseException as e:
@@ -381,7 +383,7 @@ class RetryPolicy:
                     note_retry(domain)
                     delay = self.backoff_s(domain, attempt)
                     if delay > 0:
-                        time.sleep(delay)
+                        cancel.sleep(delay)
                     continue
                 note_exhausted()
                 if degrade is not None and self.host_degrade:

@@ -57,21 +57,47 @@ class DeviceSemaphore:
     def acquire(self) -> float:
         """Block until admitted; returns seconds spent waiting (0.0 on
         the uncontended fast path — only actual blocking counts, so an
-        unconstrained run reports exactly zero wait)."""
+        unconstrained run reports exactly zero wait).
+
+        The wait is deadline-aware and cancellable: it parks at most
+        the active CancelToken's poll interval per ``wait()`` (and
+        registers with the token so a cancel wakes it immediately),
+        raising ``QueryCancelled`` without admitting.  Wait accounting
+        uses the monotonic clock and sums only time actually spent
+        blocked in the condition wait — time awake between a spurious
+        wakeup and re-blocking is not wait (the old single start/stop
+        stamp inflated ``semaphoreWaitTime`` under contention)."""
+        from spark_rapids_tpu.runtime import cancel
         waited = 0.0
-        with self._cv:
-            if self.holders >= self.permits:
-                t0 = time.perf_counter()
+        tok = cancel.current()
+        registered = False
+        try:
+            with self._cv:
                 while self.holders >= self.permits:
-                    self._cv.wait()
-                waited = time.perf_counter() - t0
-            self.holders += 1
-            self.max_holders = max(self.max_holders, self.holders)
-            self.peak_holders = max(self.peak_holders, self.holders)
-            self.wait_time += waited
-        if waited:
-            _TM_WAIT.inc(waited)
-        _TM_ACQUIRE.observe(waited)
+                    if tok is not None:
+                        tok.check()
+                        if not registered:
+                            tok.add_waiter(self._cv)
+                            registered = True
+                        timeout = tok.wait_interval()
+                    else:
+                        # bounded even without a token: a token opened
+                        # by a later query must never find this thread
+                        # parked in an unbounded wait
+                        timeout = 0.1
+                    t0 = time.monotonic()
+                    self._cv.wait(timeout=timeout)
+                    waited += time.monotonic() - t0
+                self.holders += 1
+                self.max_holders = max(self.max_holders, self.holders)
+                self.peak_holders = max(self.peak_holders, self.holders)
+                self.wait_time += waited
+        finally:
+            if registered:
+                tok.remove_waiter(self._cv)
+            if waited:
+                _TM_WAIT.inc(waited)
+            _TM_ACQUIRE.observe(waited)
         return waited
 
     def reset_query_stats(self) -> None:

@@ -360,7 +360,7 @@ def ensure_producers() -> None:
     (registration is import-time; a cold process that never shuffled
     would otherwise miss the shuffle family)."""
     import importlib
-    for mod in ("runtime.memory", "runtime.semaphore",
+    for mod in ("runtime.cancel", "runtime.memory", "runtime.semaphore",
                 "runtime.kernel_cache", "runtime.resilience",
                 "shuffle.manager", "shuffle.exchange",
                 "parallel.executor", "parallel.shuffle",

@@ -29,6 +29,7 @@ from spark_rapids_tpu.columnar.column import (
     DeviceBatch, DeviceColumn, round_up_pow2)
 from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.shuffle.manager import (
@@ -170,6 +171,7 @@ class TpuHostShuffleExchangeExec(TpuExec):
             t0 = time.perf_counter()
             with self.timer("writeTime"):
                 for m in range(child.num_partitions()):
+                    cancel.check()
                     writer = ShuffleWriter(env, sid, m, self.nparts,
                                            self.nthreads)
                     for b in child.execute(m):
@@ -232,6 +234,7 @@ class TpuHostShuffleExchangeExec(TpuExec):
             R.INJECTOR.on("shuffle_exchange")
             records = []
             for p in parts:
+                cancel.check()
                 records.extend(reader.read_partition(p))
             return records
 

@@ -780,9 +780,18 @@ class DataFrame:
             res = self._last_override
         return res.fallback_summary()
 
-    def toArrow(self) -> pa.Table:
+    def toArrow(self, timeout_ms: Optional[float] = None) -> pa.Table:
+        """Execute and return the result as an Arrow table.
+
+        ``timeout_ms`` puts an in-process deadline on THIS execution
+        (overriding ``spark.rapids.tpu.query.timeoutMs``): when it
+        expires, every blocking boundary raises
+        ``QueryCancelled(reason="deadline")`` and the engine reclaims
+        the query's resources before the exception reaches the
+        caller."""
         import contextlib
         from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import cancel as cancel_mod
         from spark_rapids_tpu.runtime import telemetry
         from spark_rapids_tpu.runtime import trace
         conf = self.session.rapids_conf()
@@ -792,6 +801,7 @@ class DataFrame:
         qwin = telemetry.begin_query(qid)
         from spark_rapids_tpu.runtime import resilience
         rwin = resilience.begin_query(qid)
+        cwin = cancel_mod.begin_query(qid, conf, timeout_ms=timeout_ms)
         tracer = None
         if conf.get(C.TRACE_ENABLED):
             tracer = trace.start_query(
@@ -811,6 +821,7 @@ class DataFrame:
         root = (tracer.span("Query", "execute")
                 if tracer is not None else contextlib.nullcontext())
         error = None
+        cancelled = None
         try:
             with profile, root:
                 tables = self._pump_partitions(plan, conf)
@@ -820,17 +831,31 @@ class DataFrame:
                          for f in self.schema.fields}))
                 else:
                     out = self._reassemble_structs(pa.concat_tables(tables))
+        except cancel_mod.QueryCancelled as e:
+            cancelled = e
+            error = f"{type(e).__name__}: {e}"
+            # guaranteed reclamation: the cancelled pump abandoned its
+            # registered spillables mid-flight — close them all so HBM
+            # accounting unwinds and disk spill files are unlinked
+            # (report_leaks() returns 0 after every cancelled query)
+            from spark_rapids_tpu.runtime import memory
+            mgr = memory.peek_manager()
+            if mgr is not None:
+                mgr.reclaim_all()
+            raise
         except BaseException as e:
             error = f"{type(e).__name__}: {e}"
             raise
         finally:
             trace.end_query(tracer)
+            cancel_mod.finish_query(cwin)
             self._record_query(qid, tracer, conf, profile_dir, error,
-                               qwin, rwin)
+                               qwin, rwin, cancelled=cancelled,
+                               ctoken=cwin)
         return out
 
     def _record_query(self, qid, tracer, conf, profile_dir, error,
-                      qwin=None, rwin=None):
+                      qwin=None, rwin=None, cancelled=None, ctoken=None):
         """One event-log entry per execution: plan tree, device/fallback
         report, all metrics at their levels, span rollup, artifact
         cross-links — the reference's driver-log plan-conversion report,
@@ -843,12 +868,21 @@ class DataFrame:
         entry = {
             "query_id": qid,
             "ts": _time.strftime("%Y-%m-%dT%H:%M:%S%z"),
-            "status": "error" if error else "ok",
+            "status": ("cancelled" if cancelled is not None
+                       else "error" if error else "ok"),
             "plan": plan.tree_string(),
             "metrics": trace.plan_metrics(plan),
         }
         if error:
             entry["error"] = error
+        if cancelled is not None:
+            cinfo = {"reason": cancelled.reason}
+            if ctoken is not None:
+                if ctoken.latency_s is not None:
+                    cinfo["latency_s"] = round(ctoken.latency_s, 6)
+                if ctoken.detail:
+                    cinfo["detail"] = ctoken.detail
+            entry["cancel"] = cinfo
         if override is not None:
             entry["fallback"] = override.fallback_summary()
             entry["fallback_report"] = override.fallback_report()
@@ -1015,8 +1049,10 @@ class DataFrame:
             level = self.session.rapids_conf().get(C.METRICS_LEVEL)
         return plan.collect_metrics(level=str(level))
 
-    def collect(self) -> List[Row]:
-        tbl = self.toArrow()
+    def collect(self, timeout_ms: Optional[float] = None) -> List[Row]:
+        """Collect rows; ``timeout_ms`` deadlines the execution
+        in-process (``QueryCancelled(reason="deadline")`` on expiry)."""
+        tbl = self.toArrow(timeout_ms=timeout_ms)
         names = tuple(tbl.column_names)
         cols = [tbl.column(i).to_pylist() for i in range(tbl.num_columns)]
         return [Row(vals, names) for vals in zip(*cols)] if cols else []

@@ -170,6 +170,27 @@ class TpuSession:
         h = self._query_history
         return list(h[-n:] if n else h)
 
+    # -- query lifecycle ----------------------------------------------------
+    def active_queries(self) -> List[int]:
+        """Ids of queries currently executing (cancellable)."""
+        from spark_rapids_tpu.runtime import cancel
+        return cancel.active_queries()
+
+    def cancel(self, query_id: Optional[int] = None,
+               reason: str = "user") -> bool:
+        """Cancel an in-flight query: every blocking boundary of its
+        execution raises ``QueryCancelled`` within ~2x
+        ``spark.rapids.tpu.query.cancelPollMs`` and the engine reclaims
+        the query's resources.  With no ``query_id``, cancels the
+        oldest active query.  Returns False when nothing matched."""
+        from spark_rapids_tpu.runtime import cancel
+        if query_id is None:
+            active = cancel.active_queries()
+            if not active:
+                return False
+            query_id = active[0]
+        return cancel.cancel_query(query_id, reason=reason)
+
     def metrics_report(self) -> Dict[str, Any]:
         """Point-in-time process telemetry: every registry counter/gauge
         value and histogram summary (the same values the JSONL sink and

@@ -71,6 +71,45 @@ def check_telemetry_documented(doc_path: str = None) -> list:
     return sorted(collect_telemetry_names() - _documented_names(doc_path))
 
 
+def check_blocking_waits_cancellable(pkg_dir: str = None) -> list:
+    """Blocking waits in runtime/ and parallel/ that the cancellation
+    layer cannot interrupt — enforced in tier-1 so no new unbounded
+    wait can sneak in.
+
+    Flags two shapes: a bare ``<cv>.wait()`` (no timeout — a cancel can
+    never wake it unless the CV is registered with the token, and even
+    then an unbounded wait defeats the poll-interval guarantee) and a
+    plain ``time.sleep(...)`` (should be ``cancel.sleep`` / a
+    token-bounded wait).  A deliberate exemption carries a
+    ``# cancel-exempt`` annotation on the same or the preceding line
+    stating why.  Returns ``["path:lineno: snippet", ...]``."""
+    if pkg_dir is None:
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+    bad = []
+    bare_wait = re.compile(r"\.wait\(\s*\)")
+    plain_sleep = re.compile(r"\btime\.sleep\s*\(")
+    for sub in ("runtime", "parallel"):
+        subdir = os.path.join(pkg_dir, sub)
+        for root, _dirs, files in os.walk(subdir):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(root, fname)
+                with open(path) as f:
+                    lines = f.read().splitlines()
+                for i, line in enumerate(lines):
+                    if not (bare_wait.search(line)
+                            or plain_sleep.search(line)):
+                        continue
+                    prev = lines[i - 1] if i else ""
+                    if "cancel-exempt" in line or "cancel-exempt" in prev:
+                        continue
+                    rel = os.path.relpath(path, pkg_dir)
+                    bad.append(f"{rel}:{i + 1}: {line.strip()}")
+    return bad
+
+
 def generate_supported_ops_md() -> str:
     """Exec + expression + aggregate support tables from the live
     registries (same coupling the reference keeps: the rule table IS the

@@ -14,6 +14,14 @@ domains (runtime/resilience.py) and assert the engine-wide invariant —
 transient faults are ridden out bit-identically, terminal faults either
 degrade to a recorded host-path result or fail with a clean
 domain-tagged error, and a bare ``InjectedDeviceError`` NEVER escapes.
+
+The cancel chaos harness (``run_cancel_chaos`` /
+``assert_cancel_invariant`` / ``run_rendezvous_cancel_chaos``) is the
+same idea for the cancellation layer: fire a cancel at a randomized
+point while the query is provably inside an armed failure domain and
+assert ``QueryCancelled`` surfaces within 2x ``cancelPollMs`` with
+every resource reclaimed (zero leaked spillables, zero semaphore
+holders, an empty spill directory).
 """
 
 from __future__ import annotations
@@ -285,3 +293,234 @@ def run_rendezvous_chaos(inject: Dict[str, Tuple[int, int]],
             f"bare InjectedDeviceError escaped: {rec['error']!r}")
     return {"records": records, "live_stages": live_stages,
             "expected": [payloads[i] for i in range(nprocs)]}
+
+
+# ---------------------------------------------------------------------------
+# cancel chaos harness: cancellation fired mid-domain, reclamation checked
+# ---------------------------------------------------------------------------
+
+def run_cancel_chaos(df_builder: Callable[[TpuSession], "object"],
+                     inject: Dict[str, Tuple[int, int]],
+                     conf: Optional[Dict] = None,
+                     poll_ms: float = 50.0,
+                     seed: int = 0,
+                     timeout_s: float = 60.0) -> dict:
+    """Run one query with ``inject``'s domains armed and fire a cancel
+    at a randomized point while the query is INSIDE an armed domain
+    (detected by the domain's injection counter moving — the query is
+    then in that domain's retry/backoff loop).
+
+    The schedule should keep the query spinning long enough to be
+    cancelled mid-flight: a large transient budget makes every attempt
+    re-fire, and backoff is pinned to ~2x the poll interval so the
+    worker thread lives inside cancellable waits.
+
+    Returns a record::
+
+        {"status": "cancelled" | "completed" | "error",
+         "error":      the raised exception (cancelled/error),
+         "fired":      the domain whose counter moved (None if raced),
+         "cancel_sent": True if cancel_query found the query in flight,
+         "latency_s":  token-recorded request→raise latency (from the
+                       event-log entry's ``cancel`` record),
+         "leaks":      DeviceMemoryManager.report_leaks() afterwards,
+         "sem_holders": semaphore holders afterwards,
+         "spill_files": leftover files under the manager's spill dir,
+         "entry":      the query's event-log entry}
+    """
+    import os
+    import threading
+    import time
+
+    from spark_rapids_tpu.runtime import cancel as CN
+    from spark_rapids_tpu.runtime import memory as M
+    from spark_rapids_tpu.runtime import resilience as R
+    from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+
+    backoff_ms = max(int(2 * poll_ms), 1)
+    full: Dict = {
+        "spark.rapids.tpu.query.cancelPollMs": int(poll_ms),
+        "spark.rapids.tpu.retry.backoffBaseMs": backoff_ms,
+        "spark.rapids.tpu.retry.backoffMaxMs": backoff_ms,
+        "spark.rapids.tpu.retry.maxAttempts": 1_000_000,
+        "spark.rapids.tpu.retry.budgetPerQuery": 0,  # unlimited
+    }
+    full.update(conf or {})
+    for d, (at, budget) in inject.items():
+        full[f"spark.rapids.tpu.test.inject.{d}.at"] = at
+        full[f"spark.rapids.tpu.test.inject.{d}.transientCount"] = budget
+    R.INJECTOR.reset()
+    CN.reset()
+    s = tpu_session(full)
+    df = df_builder(s)
+    base = dict(R._TM_INJECTED.child_values())
+    box: Dict = {}
+
+    def run():
+        try:
+            box["result"] = df.toArrow()
+        except BaseException as e:
+            box["error"] = e
+
+    worker = threading.Thread(target=run, daemon=True,
+                              name="tpuq-cancel-chaos-query")
+    worker.start()
+    # wait until the query is demonstrably inside an armed domain
+    fired = None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and worker.is_alive():
+        cur = R._TM_INJECTED.child_values()
+        fired = next((d for d in inject
+                      if cur.get(d, 0) > base.get(d, 0)), None)
+        if fired is not None:
+            break
+        time.sleep(0.002)
+    # randomized cancel point inside the domain's retry window
+    rnd = random.Random(seed)
+    time.sleep(rnd.uniform(0.0, backoff_ms / 1000.0))
+    active = CN.active_queries()
+    cancel_sent = bool(active) and CN.cancel_query(
+        active[0], reason="user",
+        detail=f"cancel-chaos mid-{fired or 'unknown'}")
+    worker.join(timeout=timeout_s)
+    R.INJECTOR.reset()
+    assert not worker.is_alive(), (
+        f"query failed to observe the cancel within {timeout_s}s "
+        f"(mid-{fired}; cancel_sent={cancel_sent})")
+    err = box.get("error")
+    if isinstance(err, CN.QueryCancelled):
+        status = "cancelled"
+    elif err is not None:
+        status = "error"
+    else:
+        status = "completed"
+    entry = getattr(df, "_last_query_entry", None) or {}
+    mgr = M.peek_manager()
+    sem = peek_semaphore()
+    spill_files = []
+    if mgr is not None and os.path.isdir(mgr.spill_path):
+        spill_files = sorted(os.listdir(mgr.spill_path))
+    return {
+        "status": status,
+        "error": err,
+        "fired": fired,
+        "cancel_sent": cancel_sent,
+        "latency_s": (entry.get("cancel") or {}).get("latency_s"),
+        "leaks": mgr.report_leaks() if mgr is not None else 0,
+        "sem_holders": sem.holders if sem is not None else 0,
+        "spill_files": spill_files,
+        "entry": entry,
+    }
+
+
+def assert_cancel_invariant(df_builder: Callable[[TpuSession], "object"],
+                            inject: Dict[str, Tuple[int, int]],
+                            conf: Optional[Dict] = None,
+                            poll_ms: float = 50.0,
+                            seed: int = 0) -> dict:
+    """Assert THE cancel invariant for one query + injection schedule:
+    a cancel fired mid-domain surfaces as ``QueryCancelled`` with a
+    request→raise latency under 2x ``cancelPollMs``, and the engine is
+    back at a clean steady state — zero leaked spillables, zero
+    semaphore holders, an empty spill directory."""
+    rec = run_cancel_chaos(df_builder, inject, conf=conf,
+                           poll_ms=poll_ms, seed=seed)
+    assert rec["cancel_sent"], (
+        f"query finished before the cancel could fire (mid-"
+        f"{rec['fired']}): {rec['status']}")
+    assert rec["status"] == "cancelled", (
+        f"expected QueryCancelled, got {rec['status']}: "
+        f"{rec['error']!r}")
+    assert rec["latency_s"] is not None, "no cancel latency recorded"
+    bound = 2.0 * poll_ms / 1000.0
+    assert rec["latency_s"] < bound, (
+        f"cancel latency {rec['latency_s']:.3f}s >= 2x cancelPollMs "
+        f"({bound:.3f}s) mid-{rec['fired']}")
+    assert rec["leaks"] == 0, (
+        f"{rec['leaks']} spillables leaked after cancel "
+        f"mid-{rec['fired']}")
+    assert rec["sem_holders"] == 0, (
+        f"{rec['sem_holders']} semaphore holders after cancel")
+    assert not rec["spill_files"], (
+        f"spill files stranded after cancel: {rec['spill_files']}")
+    entry = rec["entry"]
+    assert entry.get("status") == "cancelled", entry.get("status")
+    assert (entry.get("cancel") or {}).get("reason") == "user"
+    return rec
+
+
+def run_rendezvous_cancel_chaos(nprocs: int = 3,
+                                cancel_pid: int = 0,
+                                cancel_after_s: float = 0.2,
+                                poll_ms: float = 50.0,
+                                stage_timeout: float = 20.0) -> dict:
+    """Cancel one participant of an in-flight rendezvous stage and
+    verify the fast-abort contract: the stage is sized for nprocs+1
+    entrants, so all ``nprocs`` clients park in the barrier (nobody can
+    complete); cancelling one must (a) raise ``QueryCancelled`` on the
+    cancelled participant and (b) fail every OTHER participant with a
+    peer-tagged ``TerminalDeviceError`` promptly — nobody waits out the
+    full stage deadline wedged on a cancelled peer.
+
+    Returns ``{"records": [per-pid record], "cancel_elapsed": seconds
+    from the cancel to the last participant unblocking}``.
+    """
+    import threading
+    import time
+
+    from spark_rapids_tpu.parallel import rendezvous as RD
+    from spark_rapids_tpu.runtime import cancel as CN
+    from spark_rapids_tpu.runtime import resilience as R
+
+    R.INJECTOR.reset()
+    policy = R.RetryPolicy(backoff_base_ms=0)
+    # one seat never fills: every client parks until aborted
+    coord = RD.RendezvousCoordinator(nprocs + 1)
+    tokens = {pid: CN.CancelToken(query_id=1000 + pid, poll_ms=poll_ms)
+              for pid in range(nprocs)}
+    records: list = [None] * nprocs
+    done = threading.Event()
+
+    def run(pid: int) -> None:
+        client = RD.RendezvousClient(coord.address, pid,
+                                     default_timeout=stage_timeout)
+        rec = {"pid": pid, "status": "ok", "error": None, "domain": None,
+               "peer": None, "elapsed": 0.0}
+        t0 = time.monotonic()
+        try:
+            RD.run_stage_epochs(
+                client, "cancel-chaos",
+                lambda epoch: client.allgather("cancel-chaos:gather",
+                                               pid, epoch=epoch),
+                policy=policy, token=tokens[pid])
+        except CN.QueryCancelled as e:
+            rec["status"] = "cancelled"
+            rec["error"] = e
+        except R.TerminalDeviceError as e:
+            rec["status"] = "failed"
+            rec["error"] = e
+            rec["domain"] = e.domain
+            rec["peer"] = e.peer
+        except BaseException as e:
+            rec["status"] = "error"
+            rec["error"] = e
+        finally:
+            rec["elapsed"] = time.monotonic() - t0
+            records[pid] = rec
+            if all(r is not None for r in records):
+                done.set()
+
+    threads = [threading.Thread(target=run, args=(pid,), daemon=True)
+               for pid in range(nprocs)]
+    for t in threads:
+        t.start()
+    time.sleep(cancel_after_s)  # let everyone park in the barrier
+    t_cancel = time.monotonic()
+    tokens[cancel_pid].cancel(
+        "user", f"rendezvous cancel chaos on pid {cancel_pid}")
+    done.wait(timeout=stage_timeout + 10)
+    cancel_elapsed = time.monotonic() - t_cancel
+    coord.shutdown()
+    hung = [i for i, r in enumerate(records) if r is None]
+    assert not hung, f"rendezvous cancel participants hung: {hung}"
+    return {"records": records, "cancel_elapsed": cancel_elapsed}

@@ -1,0 +1,349 @@
+"""Cooperative cancellation / deadline / reclamation tests.
+
+[REF: Spark task-kill semantics (TaskContext.isInterrupted polling) +
+SpillFramework close-on-task-completion; SURVEY §4.2 resilience.]
+
+Coverage map — a cancel must land INSIDE each of the 11 failure
+domains and still leave the engine clean:
+
+* ``execute``, ``transfer``, ``compile``, ``shuffle_ser``,
+  ``shuffle_exchange``, ``collective``, ``spill_write`` — in-query
+  chaos via ``assert_cancel_invariant`` (the armed domain's injection
+  counter must move before the cancel fires, so the query is
+  provably spinning in that domain's retry/backoff loop).
+* ``alloc`` — direct ``with_retry`` OOM loop (no backoff sleep to
+  land in; the loop's own poll must catch the cancel).
+* ``spill_read`` — direct ``SpillableBatch.get`` restore-retry loop.
+* ``rendezvous`` + ``peer_loss`` — ``run_rendezvous_cancel_chaos``:
+  the cancelled participant unblocks from the barrier wait, the
+  survivors fail fast with a peer-tagged terminal error.
+
+Plus the blocking-boundary specials the tentpole names: cancel while
+blocked on the device semaphore, deadline expiry through
+``df.collect(timeout_ms=...)``, and the tier-1 lint that no new
+uncancellable blocking wait can enter runtime/ or parallel/.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.column import host_to_device
+from spark_rapids_tpu.runtime import cancel as CN
+from spark_rapids_tpu.runtime import kernel_cache as KC
+from spark_rapids_tpu.runtime import memory as M
+from spark_rapids_tpu.runtime import resilience as R
+from spark_rapids_tpu.runtime.semaphore import DeviceSemaphore
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils import harness as H
+from spark_rapids_tpu.utils.docs_gen import check_blocking_waits_cancellable
+
+pytestmark = pytest.mark.chaos
+
+POLL_MS = 50.0
+BOUND_S = 2.0 * POLL_MS / 1000.0  # THE latency invariant
+
+
+@pytest.fixture(autouse=True)
+def _clean_cancel_state():
+    """Fresh injector, cancel scope, policy, and breaker set on both
+    sides — the direct-call tests here run outside any query scope, so
+    a breaker tripped in one test would otherwise short-circuit the
+    next one's guarded path (same hazard test_memory documents)."""
+    old = R._policy
+    R.INJECTOR.reset()
+    CN.reset()
+    R._STATE.breakers = set()
+    yield
+    R._policy = old
+    R.INJECTOR.reset()
+    CN.reset()
+    R._STATE.breakers = set()
+
+
+def table(n=800, seed=5):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array(rng.integers(0, 17, n).astype(np.int32)),
+        "v": pa.array(rng.normal(size=n)),
+    })
+
+
+_T = table()
+
+_HOST_SHUFFLE = {"spark.rapids.shuffle.mode": "MULTITHREADED"}
+_ICI = {"spark.rapids.shuffle.mode": "ICI"}
+
+
+def q_agg(s):
+    return (s.createDataFrame(_T).filter(col("v") > -2.5)
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.count("k").alias("c")))
+
+
+def q_shuffle(s):
+    return (s.createDataFrame(_T).repartition(6, "k")
+            .groupBy("k").agg(F.sum("v").alias("sv")))
+
+
+def _spill_pressure_conf():
+    """Pool ~1/3 of the table + a 1-byte host tier: materialization
+    must evict device→host→disk, entering the spill_write domain."""
+    big = table(n=20000, seed=6)
+    bb = host_to_device(big).nbytes()
+    return big, {
+        "spark.rapids.tpu.memory.poolSize": int(bb // 3),
+        "spark.rapids.memory.host.spillStorageSize": 1,
+        "spark.rapids.tpu.batchRows": 4000,
+    }
+
+
+# ---------------------------------------------------------------------------
+# in-query cancel chaos, one armed domain at a time
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("domain,builder,conf", [
+    ("execute", q_agg, None),
+    ("transfer", q_agg, None),
+    ("compile", q_agg, None),
+    ("shuffle_ser", q_shuffle, _HOST_SHUFFLE),
+    ("shuffle_exchange", q_shuffle, _HOST_SHUFFLE),
+    ("collective", q_agg, _ICI),
+])
+def test_cancel_mid_domain(domain, builder, conf):
+    if domain == "compile":
+        KC.clear()  # guarantee the jit-build chokepoint actually runs
+    rec = H.assert_cancel_invariant(
+        builder, {domain: (1, 10**6)}, conf=conf,
+        poll_ms=POLL_MS, seed=hash(domain) % 1000)
+    assert rec["fired"] == domain
+
+
+def test_cancel_mid_spill_write():
+    big, conf = _spill_pressure_conf()
+
+    def builder(s):
+        return (s.createDataFrame(big).filter(col("v") > -3.0)
+                .groupBy("k").agg(F.sum("v").alias("sv")))
+
+    rec = H.assert_cancel_invariant(
+        builder, {"spill_write": (1, 10**6)}, conf=conf,
+        poll_ms=POLL_MS, seed=11)
+    assert rec["fired"] == "spill_write"
+
+
+# ---------------------------------------------------------------------------
+# direct-layer domains (alloc's OOM loop, spill_read's restore loop)
+# ---------------------------------------------------------------------------
+
+def _small_batch(seed=0, n=100):
+    rng = np.random.default_rng(seed)
+    return host_to_device(pa.table({
+        "a": pa.array(rng.integers(0, 50, n)),
+        "b": pa.array(rng.uniform(0, 1, n)),
+    }))
+
+
+def _cancel_once_inside(domain, qid, work):
+    """Run ``work`` on a thread with query ``qid``'s scope open, wait
+    until ``domain``'s injection counter moves (the thread is inside
+    the domain's retry loop), cancel, and return (exception,
+    request→raise seconds)."""
+    tok = CN.begin_query(qid)
+    box = {}
+
+    def run():
+        try:
+            work()
+        except BaseException as e:
+            box["err"] = e
+            box["at"] = time.monotonic()
+
+    base = dict(R._TM_INJECTED.child_values())
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 30.0
+    while (time.monotonic() < deadline and th.is_alive()
+           and R._TM_INJECTED.child_values().get(domain, 0)
+           <= base.get(domain, 0)):
+        time.sleep(0.002)
+    t0 = time.monotonic()
+    assert CN.cancel_query(qid, detail=f"test mid-{domain}")
+    th.join(timeout=10.0)
+    assert not th.is_alive(), f"worker ignored the cancel mid-{domain}"
+    CN.finish_query(tok)
+    return box.get("err"), box.get("at", time.monotonic()) - t0
+
+
+def test_cancel_mid_alloc_retry(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    b = _small_batch()
+    R.INJECTOR.configure({"alloc": (1, 10**6)})
+
+    def work():
+        # every reserve fires RetryOOM; allow_split=False keeps the
+        # SAME batch spinning so the loop's poll is the only way out
+        list(M.with_retry([b], lambda batch: mgr.reserve(batch.nbytes()),
+                          manager=mgr, max_attempts=10**6,
+                          allow_split=False))
+
+    err, latency = _cancel_once_inside("alloc", 4301, work)
+    assert isinstance(err, CN.QueryCancelled)
+    assert latency < BOUND_S
+    assert mgr.report_leaks() == 0
+
+
+def test_cancel_mid_spill_read_retry(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    sp = M.SpillableBatch(_small_batch(1), mgr)
+    sp.spill_to_host()
+    sp.spill_to_disk()
+    assert sp.tier == "disk"
+    R.INJECTOR.configure({"spill_read": (1, 10**6)})
+    # real backoff so the cancel lands inside a retry sleep
+    R._policy = R.RetryPolicy(backoff_base_ms=2 * POLL_MS,
+                              backoff_max_ms=2 * POLL_MS,
+                              max_attempts=10**6, budget_per_query=0)
+
+    err, latency = _cancel_once_inside("spill_read", 4302, sp.get)
+    assert isinstance(err, CN.QueryCancelled)
+    assert latency < BOUND_S
+    sp.close()
+    assert mgr.report_leaks() == 0
+    import os
+    assert not os.listdir(mgr.spill_path)  # payload + sidecar unlinked
+
+
+# ---------------------------------------------------------------------------
+# distributed domains: cancel inside a rendezvous wait
+# ---------------------------------------------------------------------------
+
+@pytest.mark.distributed
+def test_cancel_fast_aborts_rendezvous_peers():
+    out = H.run_rendezvous_cancel_chaos(nprocs=3, cancel_pid=0,
+                                        cancel_after_s=0.2,
+                                        poll_ms=POLL_MS,
+                                        stage_timeout=20.0)
+    recs = {r["pid"]: r for r in out["records"]}
+    assert recs[0]["status"] == "cancelled", recs[0]
+    for pid in (1, 2):
+        assert recs[pid]["status"] == "failed", recs[pid]
+        assert recs[pid]["domain"] == "peer_loss", recs[pid]
+        assert recs[pid]["peer"] == 0, recs[pid]
+    # nobody waits out the 20s stage deadline wedged on a dead peer
+    assert out["cancel_elapsed"] < 5.0, out["cancel_elapsed"]
+
+
+# ---------------------------------------------------------------------------
+# blocked on the device semaphore
+# ---------------------------------------------------------------------------
+
+def test_cancel_wakes_blocked_semaphore_waiter():
+    sem = DeviceSemaphore(1)
+    tok = CN.begin_query(4303)
+    try:
+        sem.acquire()  # pin the only permit
+        started = threading.Event()
+        box = {}
+
+        def waiter():
+            started.set()
+            try:
+                sem.acquire()
+                box["admitted"] = True
+            except CN.QueryCancelled as e:
+                box["err"] = e
+                box["at"] = time.monotonic()
+
+        th = threading.Thread(target=waiter, daemon=True)
+        th.start()
+        assert started.wait(5.0)
+        time.sleep(0.15)  # the waiter is parked in the CV wait
+        t0 = time.monotonic()
+        assert CN.cancel_query(4303)
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        assert isinstance(box.get("err"), CN.QueryCancelled)
+        # registered waiter: woken by the cancel, not the next poll tick
+        assert box["at"] - t0 < BOUND_S
+        assert sem.holders == 1  # the cancelled waiter was never admitted
+    finally:
+        sem.release()
+        CN.finish_query(tok)
+
+
+def test_semaphore_wait_accounting_counts_only_blocked_time():
+    sem = DeviceSemaphore(1)
+    assert sem.acquire() == 0.0  # uncontended fast path: exactly zero
+    out = {}
+
+    def waiter():
+        out["waited"] = sem.acquire()
+
+    th = threading.Thread(target=waiter, daemon=True)
+    t0 = time.monotonic()
+    th.start()
+    hold_s = 0.3
+    # spurious wakeups while the permit is still held must not inflate
+    # (or reset) the accounting — only time parked in the wait counts
+    for _ in range(5):
+        time.sleep(hold_s / 6)
+        with sem._cv:
+            sem._cv.notify_all()
+    time.sleep(hold_s / 6)
+    sem.release()
+    th.join(timeout=5.0)
+    elapsed = time.monotonic() - t0
+    assert not th.is_alive()
+    assert 0.5 * hold_s <= out["waited"] <= elapsed + 0.01
+    sem.release()
+
+
+# ---------------------------------------------------------------------------
+# deadlines + the session API + telemetry
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_through_collect():
+    before = dict(CN._TM_CANCELLED.child_values())
+    conf = {
+        "spark.rapids.tpu.query.cancelPollMs": int(POLL_MS),
+        "spark.rapids.tpu.retry.backoffBaseMs": int(2 * POLL_MS),
+        "spark.rapids.tpu.retry.backoffMaxMs": int(2 * POLL_MS),
+        "spark.rapids.tpu.retry.maxAttempts": 1_000_000,
+        "spark.rapids.tpu.retry.budgetPerQuery": 0,
+        # keep the query spinning in execute retries past the deadline
+        "spark.rapids.tpu.test.inject.execute.at": 1,
+        "spark.rapids.tpu.test.inject.execute.transientCount": 10**6,
+    }
+    s = H.tpu_session(conf)
+    df = q_agg(s)
+    with pytest.raises(CN.QueryCancelled) as ei:
+        df.collect(timeout_ms=250)
+    assert ei.value.reason == "deadline"
+    entry = df._last_query_entry
+    assert entry["status"] == "cancelled"
+    assert entry["cancel"]["reason"] == "deadline"
+    assert entry["cancel"]["latency_s"] < BOUND_S
+    after = CN._TM_CANCELLED.child_values()
+    assert after.get("deadline", 0) == before.get("deadline", 0) + 1
+    assert not s.active_queries()
+
+
+def test_session_cancel_without_active_query_is_false():
+    s = H.tpu_session({})
+    assert s.active_queries() == []
+    assert s.cancel() is False
+    assert s.cancel(12345) is False
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 lint: no uncancellable blocking waits may enter
+# runtime/ or parallel/
+# ---------------------------------------------------------------------------
+
+def test_no_uncancellable_blocking_waits():
+    assert check_blocking_waits_cancellable() == []

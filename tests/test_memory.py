@@ -201,13 +201,18 @@ from spark_rapids_tpu.runtime import resilience as R
 @pytest.fixture(autouse=True)
 def _fast_policy_and_disarm():
     """Zero backoff (these tests exhaust retries on purpose) and a
-    clean injector on both sides."""
+    clean injector + breaker set on both sides — these direct-call
+    tests run outside any query scope, so a breaker tripped by one
+    test (spill_write exhaustion) would otherwise short-circuit the
+    next test's spill straight to the degrade path."""
     old = R._policy
     R._policy = R.RetryPolicy(backoff_base_ms=0)
     R.INJECTOR.reset()
+    R._STATE.breakers = set()
     yield
     R._policy = old
     R.INJECTOR.reset()
+    R._STATE.breakers = set()
 
 
 def _spilled_to_disk(tmp_path, seed=5):
@@ -263,7 +268,90 @@ def test_spill_write_terminal_fault_keeps_host_copy(tmp_path):
     R.INJECTOR.configure({"spill_write": (1, 0)})
     assert sp.spill_to_disk() == 0
     assert sp.tier == "host" and sp._disk_spill_failed
-    assert not os.listdir(tmp_path)  # no partial spill file left behind
+    # no partial spill file (or CRC sidecar) left behind in this
+    # manager's per-process spill subdirectory
+    assert not os.listdir(mgr.spill_path)
     out = sp.get()
     assert np.array_equal(np.asarray(out.columns[0].data), ref)
     sp.close()
+
+
+# ---------------------------------------------------------------------------
+# spill-file integrity (CRC32 sidecar) + per-process spill directories
+# ---------------------------------------------------------------------------
+
+def test_spill_writes_crc_sidecar(tmp_path):
+    sp, ref = _spilled_to_disk(tmp_path)
+    sidecar = sp._disk_path + ".crc32"
+    assert os.path.exists(sidecar)
+    with open(sidecar) as f:
+        assert int(f.read().strip(), 16) == M._file_crc32(sp._disk_path)
+    out = sp.get()  # clean restore removes payload AND sidecar
+    assert np.array_equal(np.asarray(out.columns[0].data), ref)
+    assert not os.path.exists(sidecar)
+    sp.close()
+
+
+def test_spill_bitflip_detected_by_crc(tmp_path):
+    # a single flipped bit in the .npz can survive np.load (zlib only
+    # checksums per-member payloads, and headers/padding aren't
+    # covered) — the CRC sidecar must catch it and raise through the
+    # spill_read domain instead of restoring garbage
+    sp, _ = _spilled_to_disk(tmp_path)
+    with open(sp._disk_path, "r+b") as f:
+        f.seek(os.path.getsize(sp._disk_path) // 2)
+        byte = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([byte[0] ^ 0x01]))
+    with pytest.raises(R.TerminalDeviceError, match="spill_read") as ei:
+        sp.get()
+    assert ei.value.domain == "spill_read"
+    assert "crc32" in str(ei.value.cause)
+    sp.close()
+
+
+def test_close_removes_spill_file_and_sidecar(tmp_path):
+    sp, _ = _spilled_to_disk(tmp_path)
+    path = sp._disk_path
+    sp.close()
+    assert not os.path.exists(path)
+    assert not os.path.exists(path + ".crc32")
+
+
+def test_per_process_spill_subdirectory(tmp_path):
+    # each manager spills under its own proc-<pid>-<uid> subdir of the
+    # configured root (no cross-run collisions), registered for atexit
+    # removal
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    assert mgr.spill_root == str(tmp_path)
+    assert os.path.dirname(mgr.spill_path) == str(tmp_path)
+    base = os.path.basename(mgr.spill_path)
+    assert base.startswith(f"proc-{os.getpid()}-")
+    assert mgr.spill_path in M._SPILL_DIRS
+    other = M.DeviceMemoryManager(budget=1 << 30,
+                                  spill_path=str(tmp_path))
+    assert other.spill_path != mgr.spill_path
+
+
+def test_spill_dir_cleanup_hook(tmp_path):
+    mgr = M.DeviceMemoryManager(budget=1 << 30, spill_path=str(tmp_path))
+    sp = M.SpillableBatch(small_batch(3), mgr)
+    sp.spill_to_host()
+    sp.spill_to_disk()
+    assert os.listdir(mgr.spill_path)
+    M._cleanup_spill_dirs()  # what atexit runs
+    assert not os.path.exists(mgr.spill_path)
+    assert not M._SPILL_DIRS
+    mgr._spillables.clear()  # the batch's file is gone with the dir
+
+
+def test_get_manager_stable_across_same_conf(tmp_path):
+    # the per-process subdir is unique per manager instance — the
+    # replace-on-conf-change check must compare the configured ROOT,
+    # not the instance subdir, or every get_manager(conf) call would
+    # rebuild the arbiter and orphan registered batches
+    from spark_rapids_tpu.utils.harness import tpu_session
+    conf = {"spark.rapids.tpu.spillPath": str(tmp_path)}
+    a = M.get_manager(tpu_session(conf).rapids_conf())
+    b = M.get_manager(tpu_session(conf).rapids_conf())
+    assert a is b
