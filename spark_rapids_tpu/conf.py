@@ -371,6 +371,16 @@ SHUFFLE_THREADS = (
     .create_with_default(4)
 )
 
+MULTITHREADED_READ_THREADS = (
+    conf("spark.rapids.sql.multiThreadedRead.numThreads")
+    .doc("Thread pool size for the MULTITHREADED parquet reader "
+         "(concurrent host decode + H2D per scan partition).")
+    .category("io")
+    .integer()
+    .check(lambda v: v >= 1, ">= 1")
+    .create_with_default(4)
+)
+
 SHUFFLE_PARTITIONS = (
     conf("spark.sql.shuffle.partitions")
     .doc("Default shuffle partition count (Spark core key, honored here).")
@@ -805,6 +815,29 @@ TELEMETRY_ENABLED = (
          "semaphore, kernel cache, shuffle, pump pool) into a JSONL "
          "time series and a Prometheus text-format dump. The registry "
          "itself always updates; this only gates the sampler/sinks.")
+    .category("telemetry")
+    .boolean()
+    .create_with_default(False)
+)
+
+LOCKDEP_ENABLED = (
+    conf("spark.rapids.tpu.lockdep.enabled")
+    .doc("Lockdep-style runtime watchdog: wraps the engine's "
+         "threading.Lock/RLock/Condition instances, records the "
+         "process-wide lock acquisition-order graph, and reports any "
+         "edge that closes a cycle (a latent deadlock) from a single "
+         "observation of both orders. Diagnostic; adds per-acquisition "
+         "bookkeeping overhead.")
+    .category("telemetry")
+    .boolean()
+    .create_with_default(False)
+)
+
+LOCKDEP_RAISE_ON_CYCLE = (
+    conf("spark.rapids.tpu.lockdep.raiseOnCycle")
+    .doc("With lockdep enabled, raise LockOrderViolation at the "
+         "acquisition that closes a cycle instead of only recording it "
+         "for the violations report.")
     .category("telemetry")
     .boolean()
     .create_with_default(False)

@@ -608,7 +608,29 @@ def _concat_compacted_fast(schema: T.StructType,
     out_bucket = round_up_pow2(max(total, 1))
     warn_big_bucket("concat", out_bucket)
     nfields = len(schema.fields)
+    # Structural uniformity gate: every batch must carry one column per
+    # schema field and agree on string-ness.  Without it a mismatched
+    # batch (an upstream op emitting against the wrong schema — the q7
+    # streamed-join side-override bug's signature) surfaces as a bare
+    # `IndexError: tuple index out of range` from `.data.shape[1]` deep
+    # in kernel build, with no hint of which operator produced it.
+    for bi, b in enumerate(batches):
+        if len(b.columns) != nfields:
+            raise ValueError(
+                f"concat: batch {bi} carries {len(b.columns)} columns "
+                f"for a {nfields}-field schema — an upstream operator "
+                "emitted a batch that does not match its declared "
+                "schema")
     is_str = [batches[0].columns[ci].is_string for ci in range(nfields)]
+    for bi, b in enumerate(batches):
+        for ci in range(nfields):
+            if (b.columns[ci].is_string != is_str[ci]
+                    or (is_str[ci] and b.columns[ci].data.ndim < 2)):
+                raise ValueError(
+                    f"concat: column {ci} ({schema.fields[ci].name!r}) "
+                    f"is {'string' if is_str[ci] else 'non-string'} in "
+                    f"batch 0 but not in batch {bi} — mixed layouts "
+                    "cannot be concatenated")
     widths = tuple(
         max(b.columns[ci].data.shape[1] for b in batches)
         if is_str[ci] else 0 for ci in range(nfields))

@@ -259,8 +259,8 @@ class TpuParquetScanExec(TpuExec):
         self._cpu = cpu
         self.paths = cpu.paths
         self._num_partitions = cpu._num_partitions
-        self.num_threads = int(cpu.conf.get_raw(
-            "spark.rapids.sql.multiThreadedRead.numThreads", 4) or 4)
+        from spark_rapids_tpu import conf as C
+        self.num_threads = int(cpu.conf.get(C.MULTITHREADED_READ_THREADS))
 
     def node_string(self):
         return "Tpu" + self._cpu.node_string()

@@ -1,0 +1,460 @@
+"""Lockdep-style runtime lock-order watchdog.
+
+The static ``lock-order`` lint rule (utils/lint/lock_order.py) sees
+only what resolves statically — nested ``with`` scopes and calls it
+can trace through names.  Locks handed through locals, dynamic
+dispatch, and cross-module object graphs (the semaphore CV registering
+with a cancel token, a spill callback re-entering the memory manager)
+are out of its reach.  This module covers that gap at runtime, the way
+the kernel's lockdep does: observe every acquisition, maintain one
+process-wide acquisition-order graph, and flag the FIRST edge that
+closes a cycle — turning a deadlock that needs a precise interleaving
+into a deterministic report from ANY interleaving that exercises both
+orders.
+
+Mechanism
+---------
+``enable()`` replaces the ``threading.Lock`` / ``RLock`` /
+``Condition`` factories with site-filtered shims: a lock whose
+creation site is inside ``spark_rapids_tpu/`` gets a tracked wrapper,
+anything else (jax, stdlib pools) gets the real primitive untouched.
+Lock identity is the creation site (``runtime.memory.L448``) — one
+identity covers every instance born there, because acquisition order
+is a property of the code path, not the object.  Each thread keeps its
+held list; acquiring B while holding A inserts edge A→B into the
+process-wide graph (guarded by a real, untracked lock, with a
+thread-local reentrancy latch so the watchdog's own bookkeeping and
+telemetry can't recurse into itself).  ``Condition.wait`` releases the
+held entry for its duration and re-records edges on reacquire.
+
+A cycle is recorded as a :class:`Violation` (and raised as
+:class:`LockOrderViolation` when ``raise_on_cycle``); tier-1 runs the
+whole suite in record mode via tests/conftest.py and fails the session
+on any unexempted violation.  A deliberate edge carries the uniform
+annotation ``# lint: exempt(lockdep): <why>`` at the acquisition site.
+
+Conf (read by ``TpuSession.__init__`` → :func:`configure`):
+
+* ``spark.rapids.tpu.lockdep.enabled`` — install the shims
+* ``spark.rapids.tpu.lockdep.raiseOnCycle`` — raise at the closing
+  acquisition instead of only recording
+
+Telemetry: ``tpuq_lockdep_locks_tracked``, ``tpuq_lockdep_edges_observed``,
+``tpuq_lockdep_violations_total``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+# real primitives, captured before any patching
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_CONDITION = threading.Condition
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_THREADING_FILE = threading.__file__
+
+
+class LockOrderViolation(Exception):
+    """Acquisition closed a cycle in the lock-order graph."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    edge: Tuple[str, str]          # the edge that closed the cycle
+    cycle: Tuple[str, ...]         # key path b -> ... -> a
+    site: Tuple[str, int]          # (rel path, line) of the acquisition
+    thread: str
+
+    def __str__(self) -> str:
+        a, b = self.edge
+        rel, line = self.site
+        loop = " -> ".join(self.cycle + (self.cycle[0],))
+        return (f"{rel}:{line}: lock-order cycle closed by {a} -> {b} "
+                f"in thread {self.thread}: {loop}")
+
+
+class _State:
+    def __init__(self):
+        self.enabled = False
+        self.raise_on_cycle = False
+        self.meta = _REAL_LOCK()
+        self.graph: Dict[str, Set[str]] = {}
+        self.edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        self.violations: List[Violation] = []
+        self.sites: Set[str] = set()   # distinct tracked lock keys
+
+
+_S = _State()
+_tls = threading.local()
+
+
+def _held() -> list:
+    h = getattr(_tls, "held", None)
+    if h is None:
+        h = _tls.held = []
+    return h
+
+
+# -- telemetry (leaf tier; registered at import like every producer) -----
+from spark_rapids_tpu.runtime import telemetry as TM  # noqa: E402
+
+_TM_LOCKS = TM.REGISTRY.gauge(
+    "tpuq_lockdep_locks_tracked",
+    "distinct lock creation sites under lockdep tracking",
+    fn=lambda: float(len(_S.sites)))
+_TM_EDGES = TM.REGISTRY.gauge(
+    "tpuq_lockdep_edges_observed",
+    "distinct held->acquired edges in the runtime lock-order graph",
+    fn=lambda: float(len(_S.edge_sites)))
+_TM_VIOLATIONS = TM.REGISTRY.counter(
+    "tpuq_lockdep_violations_total",
+    "lock-order cycles observed by the lockdep watchdog")
+
+
+# -- creation-site attribution -------------------------------------------
+
+def _creation_site() -> Optional[Tuple[str, int]]:
+    """(relpath, line) of the first caller frame outside this module
+    and threading.py, if it lies inside the package; else None."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if fn != __file__ and fn != _THREADING_FILE:
+            if fn.startswith(_PKG_DIR + os.sep):
+                return os.path.relpath(fn, os.path.dirname(_PKG_DIR)), \
+                    f.f_lineno
+            return None
+        f = f.f_back
+    return None
+
+
+def _site_key(rel: str, line: int) -> str:
+    s = rel.replace("\\", "/")
+    if s.startswith("spark_rapids_tpu/"):
+        s = s[len("spark_rapids_tpu/"):]
+    if s.endswith(".py"):
+        s = s[:-3]
+    return f"{s.replace('/', '.')}.L{line}"
+
+
+def _acquire_site() -> Tuple[str, int]:
+    """(relpath, line) of the repo frame performing the acquisition —
+    only walked when a violation actually fires."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if (fn != __file__ and fn != _THREADING_FILE
+                and fn.startswith(os.path.dirname(_PKG_DIR) + os.sep)):
+            return os.path.relpath(fn, os.path.dirname(_PKG_DIR)), \
+                f.f_lineno
+        f = f.f_back
+    return "<unknown>", 0
+
+
+# -- graph bookkeeping ----------------------------------------------------
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS path src -> dst in the current graph, or None."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _S.graph.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquire(obj) -> None:
+    if getattr(_tls, "in_hook", False):
+        return
+    _tls.in_hook = True
+    try:
+        held = _held()
+        first = all(e is not obj for e in held)
+        raised: Optional[Violation] = None
+        if first and held:
+            for h in held:
+                if h._key == obj._key:
+                    continue
+                v = _add_edge(h._key, obj._key)
+                if v is not None:
+                    raised = v
+        held.append(obj)
+        if raised is not None and _S.raise_on_cycle:
+            raise LockOrderViolation(str(raised))
+    finally:
+        _tls.in_hook = False
+
+
+def _note_release(obj) -> None:
+    if getattr(_tls, "in_hook", False):
+        return
+    held = getattr(_tls, "held", None)
+    if not held:
+        return
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is obj:
+            del held[i]
+            return
+    # released by a thread that never recorded the acquire — ignore
+
+
+def _add_edge(a: str, b: str) -> Optional[Violation]:
+    """Insert a→b; returns a Violation when it closes a cycle."""
+    with _S.meta:
+        succ = _S.graph.setdefault(a, set())
+        if b in succ:
+            return None
+        back = _find_path(b, a)
+        succ.add(b)
+        _S.graph.setdefault(b, set())
+        site = _acquire_site()
+        _S.edge_sites[(a, b)] = site
+        if back is None:
+            return None
+        v = Violation(edge=(a, b), cycle=tuple(back), site=site,
+                      thread=threading.current_thread().name)
+        _S.violations.append(v)
+    _TM_VIOLATIONS.inc()
+    return v
+
+
+# -- tracked wrappers -----------------------------------------------------
+
+class _TrackedLock:
+    """Transparent Lock/RLock shim recording acquisition order."""
+
+    def __init__(self, real, key: str, kind: str):
+        self._real = real
+        self._key = key
+        self._kind = kind
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self):
+        _note_release(self)
+        self._real.release()
+
+    def locked(self):
+        return self._real.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockdep {self._kind} {self._key} of {self._real!r}>"
+
+
+class _TrackedCondition:
+    """Condition shim; ``wait`` drops the held entry for its duration
+    so edges observed after wakeup reflect the reacquisition."""
+
+    def __init__(self, real, key: str):
+        self._real = real
+        self._key = key
+        self._kind = "Condition"
+
+    def acquire(self, *a, **k):
+        ok = self._real.acquire(*a, **k)
+        if ok:
+            _note_acquire(self)
+        return ok
+
+    def release(self):
+        _note_release(self)
+        self._real.release()
+
+    def __enter__(self):
+        self._real.__enter__()
+        _note_acquire(self)
+        return self
+
+    def __exit__(self, *exc):
+        _note_release(self)
+        return self._real.__exit__(*exc)
+
+    def wait(self, timeout: Optional[float] = None):
+        _note_release(self)
+        try:
+            return self._real.wait(timeout)
+        finally:
+            _note_acquire(self)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        _note_release(self)
+        try:
+            return self._real.wait_for(predicate, timeout)
+        finally:
+            _note_acquire(self)
+
+    def notify(self, n: int = 1):
+        self._real.notify(n)
+
+    def notify_all(self):
+        self._real.notify_all()
+
+    def __repr__(self):
+        return f"<lockdep Condition {self._key} of {self._real!r}>"
+
+
+def _register(key: str) -> None:
+    with _S.meta:
+        _S.sites.add(key)
+
+
+def _make_lock():
+    real = _REAL_LOCK()
+    if not _S.enabled:
+        return real
+    site = _creation_site()
+    if site is None:
+        return real
+    key = _site_key(*site)
+    _register(key)
+    return _TrackedLock(real, key, "Lock")
+
+
+def _make_rlock():
+    real = _REAL_RLOCK()
+    if not _S.enabled:
+        return real
+    site = _creation_site()
+    if site is None:
+        return real
+    key = _site_key(*site)
+    _register(key)
+    return _TrackedLock(real, key, "RLock")
+
+
+def _make_condition(lock=None):
+    if not _S.enabled:
+        return _REAL_CONDITION(
+            lock._real if isinstance(lock, _TrackedLock) else lock)
+    if isinstance(lock, _TrackedLock):
+        # the condition shares the lock's mutex — and its identity, so
+        # `with self._lock:` and `with self._cv:` edges agree
+        _register(lock._key)
+        return _TrackedCondition(_REAL_CONDITION(lock._real), lock._key)
+    site = _creation_site()
+    if site is None:
+        return _REAL_CONDITION(
+            lock._real if isinstance(lock, _TrackedLock) else lock)
+    key = _site_key(*site)
+    _register(key)
+    return _TrackedCondition(
+        _REAL_CONDITION(lock if lock is not None else _REAL_RLOCK()),
+        key)
+
+
+def tracked_lock(key: str, reentrant: bool = False):
+    """Explicitly-keyed tracked lock — lets tests (outside the package
+    tree, hence invisible to the site filter) participate in the graph."""
+    real = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+    _register(key)
+    return _TrackedLock(real, key, "RLock" if reentrant else "Lock")
+
+
+def tracked_condition(key: str):
+    """Explicitly-keyed tracked condition, for tests."""
+    _register(key)
+    return _TrackedCondition(_REAL_CONDITION(_REAL_RLOCK()), key)
+
+
+# -- lifecycle ------------------------------------------------------------
+
+def enable(raise_on_cycle: bool = False) -> None:
+    """Install the factory shims.  Locks created BEFORE this call stay
+    untracked (module-level locks of already-imported modules)."""
+    _S.raise_on_cycle = raise_on_cycle
+    if _S.enabled:
+        return
+    _S.enabled = True
+    threading.Lock = _make_lock
+    threading.RLock = _make_rlock
+    threading.Condition = _make_condition
+
+
+def disable() -> None:
+    if not _S.enabled:
+        return
+    _S.enabled = False
+    threading.Lock = _REAL_LOCK
+    threading.RLock = _REAL_RLOCK
+    threading.Condition = _REAL_CONDITION
+
+
+def is_enabled() -> bool:
+    return _S.enabled
+
+
+def reset() -> None:
+    """Clear the graph, edge sites, and violation log (tracked locks
+    keep working; their next acquisitions rebuild the graph)."""
+    with _S.meta:
+        _S.graph.clear()
+        _S.edge_sites.clear()
+        _S.violations.clear()
+        _S.sites.clear()
+
+
+def violations() -> List[Violation]:
+    with _S.meta:
+        return list(_S.violations)
+
+
+def edges() -> Dict[Tuple[str, str], Tuple[str, int]]:
+    """(a, b) -> (rel path, line) of the first observation."""
+    with _S.meta:
+        return dict(_S.edge_sites)
+
+
+@contextlib.contextmanager
+def scoped(raise_on_cycle: bool = True):
+    """Isolated graph for deliberate-inversion tests: swaps in fresh
+    graph/violation state (and enables if needed) for the duration, so
+    a seeded cycle can't fail the session-wide record-mode check."""
+    with _S.meta:
+        saved = (_S.graph, _S.edge_sites, _S.violations, _S.sites,
+                 _S.raise_on_cycle, _S.enabled)
+        _S.graph, _S.edge_sites = {}, {}
+        _S.violations, _S.sites = [], set()
+    was_enabled = saved[5]
+    enable(raise_on_cycle=raise_on_cycle)
+    _S.raise_on_cycle = raise_on_cycle
+    try:
+        yield _S
+    finally:
+        if not was_enabled:
+            disable()   # while _S.enabled is still True, so it unpatches
+        with _S.meta:
+            (_S.graph, _S.edge_sites, _S.violations, _S.sites,
+             _S.raise_on_cycle, _S.enabled) = saved
+
+
+def configure(conf) -> None:
+    """Session-init hook: conf-gated enablement
+    (``spark.rapids.tpu.lockdep.enabled`` /
+    ``spark.rapids.tpu.lockdep.raiseOnCycle``)."""
+    from spark_rapids_tpu import conf as C
+    if conf.get(C.LOCKDEP_ENABLED):
+        enable(raise_on_cycle=bool(conf.get(C.LOCKDEP_RAISE_ON_CYCLE)))

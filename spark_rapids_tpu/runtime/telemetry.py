@@ -362,6 +362,7 @@ def ensure_producers() -> None:
     import importlib
     for mod in ("runtime.cancel", "runtime.memory", "runtime.semaphore",
                 "runtime.kernel_cache", "runtime.resilience",
+                "runtime.lockdep",
                 "shuffle.manager", "shuffle.exchange",
                 "parallel.executor", "parallel.shuffle",
                 "parallel.rendezvous", "exec.distributed"):

@@ -156,6 +156,9 @@ class TpuSession:
         # spark.rapids.tpu.telemetry.enabled (registry updates always)
         from spark_rapids_tpu.runtime import telemetry
         telemetry.configure_sampler(self.conf.snapshot())
+        # conf-gated lock-order watchdog (spark.rapids.tpu.lockdep.*)
+        from spark_rapids_tpu.runtime import lockdep
+        lockdep.configure(self.conf.snapshot())
 
     # -- observability ------------------------------------------------------
     def _record_query(self, entry: Dict[str, Any]) -> None:

@@ -41,12 +41,20 @@ def collect_metric_names(pkg_dir: str = None) -> set:
 
 
 def _documented_names(doc_path: str = None) -> set:
+    """Backticked names in the doc's TABLE ROWS only.  Scanning the
+    whole file over-matched: any backticked word in prose ("see
+    `collect`") silently satisfied the drift check for a metric of the
+    same name that no table ever documented."""
     if doc_path is None:
         doc_path = os.path.join(
             os.path.dirname(os.path.dirname(os.path.dirname(
                 os.path.abspath(__file__)))), "docs", "observability.md")
+    names = set()
     with open(doc_path) as f:
-        return set(re.findall(r"`(\w+)`", f.read()))
+        for line in f:
+            if line.lstrip().startswith("|"):
+                names.update(re.findall(r"`(\w+)`", line))
+    return names
 
 
 def check_metrics_documented(doc_path: str = None) -> list:
@@ -81,32 +89,29 @@ def check_blocking_waits_cancellable(pkg_dir: str = None) -> list:
     then an unbounded wait defeats the poll-interval guarantee) and a
     plain ``time.sleep(...)`` (should be ``cancel.sleep`` / a
     token-bounded wait).  A deliberate exemption carries a
-    ``# cancel-exempt`` annotation on the same or the preceding line
-    stating why.  Returns ``["path:lineno: snippet", ...]``."""
+    ``# cancel-exempt: <why>`` (or ``# lint: exempt(blocking-wait):
+    <why>``) annotation on the same or the preceding line.  Returns
+    ``["path:lineno: snippet", ...]``.
+
+    Thin wrapper over the AST ``blocking-wait`` lint rule
+    (utils/lint/blocking_wait.py) — the former regex body counted
+    matches inside strings/comments and missed ``wait(timeout=None)``;
+    the AST rule is exact and this gate can no longer disagree with
+    ``python -m spark_rapids_tpu.utils.lint``."""
+    from spark_rapids_tpu.utils.lint import iter_modules, run_lint
+    from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
     if pkg_dir is None:
         pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(
             __file__)))
+    mods = iter_modules(pkg_dir)
+    by_rel = {m.rel: m for m in mods}
     bad = []
-    bare_wait = re.compile(r"\.wait\(\s*\)")
-    plain_sleep = re.compile(r"\btime\.sleep\s*\(")
-    for sub in ("runtime", "parallel"):
-        subdir = os.path.join(pkg_dir, sub)
-        for root, _dirs, files in os.walk(subdir):
-            for fname in sorted(files):
-                if not fname.endswith(".py"):
-                    continue
-                path = os.path.join(root, fname)
-                with open(path) as f:
-                    lines = f.read().splitlines()
-                for i, line in enumerate(lines):
-                    if not (bare_wait.search(line)
-                            or plain_sleep.search(line)):
-                        continue
-                    prev = lines[i - 1] if i else ""
-                    if "cancel-exempt" in line or "cancel-exempt" in prev:
-                        continue
-                    rel = os.path.relpath(path, pkg_dir)
-                    bad.append(f"{rel}:{i + 1}: {line.strip()}")
+    for f in run_lint(pkg_dir, rules=[BlockingWaitRule()], modules=mods):
+        if f.rule != "blocking-wait":
+            continue
+        m = by_rel[f.path]
+        rel = os.path.relpath(m.path, pkg_dir)
+        bad.append(f"{rel}:{f.line}: {m.snippet(f.line)}")
     return bad
 
 
@@ -254,6 +259,12 @@ def main(out_dir: str = "docs"):
         if missing_tm:
             print(f"UNDOCUMENTED telemetry metrics (add to {obs}): "
                   f"{missing_tm}")
+    from spark_rapids_tpu.utils.lint import run_lint
+    findings = run_lint()
+    for f in findings:
+        print(f)
+    print(f"lint: {len(findings)} finding(s)" if findings
+          else "lint: clean")
 
 
 if __name__ == "__main__":

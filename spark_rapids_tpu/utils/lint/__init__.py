@@ -1,0 +1,221 @@
+"""Engine invariant analyzer — AST lint framework.
+
+[REF: the reference enforces these invariants with Scala's type system
+ plus a scalastyle/IWYU lint wall run in premerge CI; this engine is
+ Python, so the equivalent is an AST pass over the package run as a
+ tier-1 gate.]
+
+Run:  ``python -m spark_rapids_tpu.utils.lint``  — nonzero exit on any
+finding.  The same entry is asserted clean by tier-1
+(``tests/test_lint.py``) and reported by ``docs_gen.main``.
+
+Rules (catalog in docs/static_analysis.md):
+
+``lock-order``        static lock-acquisition graph from nested
+                      ``with <lock>`` / ``.acquire()`` scopes; flags
+                      cycles, non-reentrant self-acquisition, and
+                      edges inverting the canonical order
+``conf-drift``        string-literal ``conf.get("spark.rapids...")``
+                      keys must exist in the conf.py registry, and
+                      every registered key must have a read site
+``failure-domain``    ``raise`` sites of device/retryable error types
+                      in runtime/ | shuffle/ | parallel/ must carry a
+                      failure domain (no bare RuntimeError bypasses
+                      the RetryPolicy's domain routing)
+``host-sync-in-jit``  ``np.asarray`` / ``float()`` / ``.item()`` /
+                      ``.block_until_ready()`` on traced values inside
+                      jit-wrapped kernel builders (TPU hot-path purity)
+``blocking-wait``     bare ``.wait()`` / ``time.sleep`` in runtime/ |
+                      parallel/ that the cancellation layer cannot
+                      interrupt (the former regex gate, now AST-exact)
+
+A deliberate violation carries a same-line or preceding-line
+annotation::
+
+    # lint: exempt(<rule>): <why>
+
+The reason is mandatory — an empty reason is itself a finding.  The
+legacy ``# cancel-exempt: <why>`` annotation is honored as an alias
+for ``exempt(blocking-wait)``.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import os
+import re
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence
+
+EXEMPT_RE = re.compile(
+    r"#\s*lint:\s*exempt\(\s*([\w*-]+(?:\s*,\s*[\w*-]+)*)\s*\)"
+    r"\s*(?::\s*(.*))?")
+# legacy PR-5 annotation, kept working so the two gates can't disagree
+CANCEL_EXEMPT_RE = re.compile(r"#\s*cancel-exempt\s*(?::\s*(.*))?")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One invariant violation at one source site."""
+
+    rule: str
+    path: str        # relative to the package root's parent
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceModule:
+    """One parsed source file: AST + raw lines + exemption table."""
+
+    def __init__(self, path: str, rel: str, text: Optional[str] = None):
+        self.path = path
+        self.rel = rel
+        if text is None:
+            with open(path) as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        # line -> (set of exempted rule names, reason); "*" = any rule
+        self.exemptions: Dict[int, tuple] = {}
+        self._bad_exemptions: List[Finding] = []
+        for i, ln in self._comments():
+            m = EXEMPT_RE.search(ln)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                reason = (m.group(2) or "").strip()
+                if not reason:
+                    self._bad_exemptions.append(Finding(
+                        "exemption", rel, i,
+                        "exemption without a reason — write "
+                        "'# lint: exempt(<rule>): <why>'"))
+                self.exemptions[i] = (rules, reason)
+                continue
+            m = CANCEL_EXEMPT_RE.search(ln)
+            if m:
+                reason = (m.group(1) or "").strip()
+                if not reason:
+                    self._bad_exemptions.append(Finding(
+                        "exemption", rel, i,
+                        "cancel-exempt without a reason — write "
+                        "'# cancel-exempt: <why>'"))
+                self.exemptions[i] = ({"blocking-wait"}, reason)
+
+    def _comments(self):
+        """(line, comment_text) for real COMMENT tokens only — an
+        annotation quoted inside a docstring must not count."""
+        try:
+            toks = tokenize.generate_tokens(
+                io.StringIO(self.text).readline)
+            return [(t.start[0], t.string) for t in toks
+                    if t.type == tokenize.COMMENT]
+        except tokenize.TokenizeError:
+            return []
+
+    def exempt_at(self, line: int, rule: str) -> bool:
+        """Same-line or preceding-line exemption for ``rule``."""
+        for ln in (line, line - 1):
+            ex = self.exemptions.get(ln)
+            if ex is not None and (rule in ex[0] or "*" in ex[0]):
+                return True
+        return False
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+class Rule:
+    """One invariant.  ``check`` runs per module; ``finalize`` runs once
+    after every module, for cross-module analyses (lock graph, conf
+    registry)."""
+
+    name = "rule"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_modules(pkg_dir: Optional[str] = None) -> List[SourceModule]:
+    """Every .py file of the package, parsed once and shared by all
+    rules."""
+    if pkg_dir is None:
+        pkg_dir = _package_root()
+    base = os.path.dirname(pkg_dir)
+    mods = []
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            mods.append(SourceModule(path, os.path.relpath(path, base)))
+    return mods
+
+
+def all_rules() -> List[Rule]:
+    from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
+    from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
+    from spark_rapids_tpu.utils.lint.failure_domains import (
+        FailureDomainRule)
+    from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
+    from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
+    return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
+            HostSyncInJitRule(), BlockingWaitRule()]
+
+
+def run_lint(pkg_dir: Optional[str] = None,
+             rules: Optional[Sequence[Rule]] = None,
+             modules: Optional[Sequence[SourceModule]] = None
+             ) -> List[Finding]:
+    """Run every rule over every package module; returns the surviving
+    (un-exempted) findings, sorted by site."""
+    if modules is None:
+        modules = iter_modules(pkg_dir)
+    if rules is None:
+        rules = all_rules()
+    by_rel = {m.rel: m for m in modules}
+    findings: List[Finding] = []
+    for m in modules:
+        findings.extend(m._bad_exemptions)
+    for rule in rules:
+        for m in modules:
+            findings.extend(rule.check(m))
+        findings.extend(rule.finalize())
+    out = []
+    for f in findings:
+        m = by_rel.get(f.path)
+        if m is not None and m.exempt_at(f.line, f.rule):
+            continue
+        out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """CLI: print findings, exit nonzero on any."""
+    pkg_dir = None
+    if argv:
+        pkg_dir = argv[0]
+    findings = run_lint(pkg_dir)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"lint: {len(findings)} finding(s)")
+        return 1
+    print("lint: clean")
+    return 0

@@ -1,0 +1,7 @@
+"""``python -m spark_rapids_tpu.utils.lint`` — tier-1 invariant gate."""
+
+import sys
+
+from spark_rapids_tpu.utils.lint import main
+
+sys.exit(main(sys.argv[1:]))

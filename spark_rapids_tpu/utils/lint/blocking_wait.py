@@ -1,0 +1,67 @@
+"""``blocking-wait`` — uncancellable waits in runtime/ and parallel/.
+
+AST migration of the PR-5 regex gate
+(``docs_gen.check_blocking_waits_cancellable``): a bare ``<cv>.wait()``
+(no timeout — a cancel can never wake it unless the CV is registered
+with the token, and even then an unbounded wait defeats the
+poll-interval guarantee) or a plain ``time.sleep(...)`` (should be
+``cancel.sleep`` / a token-bounded wait).  AST-exact: a ``.wait()``
+inside a string or comment no longer counts, and ``wait(timeout=None)``
+— which the regex missed — now does.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+SCOPES = ("runtime", "parallel")
+
+
+def _in_scope(rel: str) -> bool:
+    parts = rel.replace("\\", "/").split("/")
+    return any(p in SCOPES for p in parts[:-1])
+
+
+def _is_unbounded_wait(call: ast.Call) -> bool:
+    """``x.wait()`` or ``x.wait(None)`` / ``x.wait(timeout=None)``."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "wait"):
+        return False
+    args = call.args + [kw.value for kw in call.keywords
+                        if kw.arg in (None, "timeout")]
+    if not args:
+        return True
+    return all(isinstance(a, ast.Constant) and a.value is None
+               for a in args)
+
+
+def _is_plain_sleep(call: ast.Call) -> bool:
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "sleep"
+            and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+class BlockingWaitRule(Rule):
+    name = "blocking-wait"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        if not _in_scope(mod.rel):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_unbounded_wait(node):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno,
+                    "unbounded .wait() — pass a token-bounded timeout "
+                    f"(`{mod.snippet(node.lineno)}`)"))
+            elif _is_plain_sleep(node):
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno,
+                    "plain time.sleep — use cancel.sleep / a "
+                    f"token-bounded wait (`{mod.snippet(node.lineno)}`)"))
+        return out

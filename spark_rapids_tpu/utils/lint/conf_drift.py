@@ -1,0 +1,135 @@
+"""``conf-drift`` — the conf registry and its read sites can't diverge.
+
+Two directions:
+
+* **phantom key** — a string-literal ``conf.get("spark.rapids...")`` /
+  ``get_raw("spark.rapids...")`` whose key is not in the conf.py
+  registry reads a default forever and silently ignores the user's
+  setting.  (Per-op kill-switch prefixes
+  ``spark.rapids.sql.{exec,expression}.`` are registered dynamically
+  and excluded.)
+* **dead conf** — a registered key with NO read site anywhere in the
+  package documents a knob that does nothing.  A read site is a Load
+  reference to the key's conf.py constant (``C.RETRY_MAX``, a
+  ``RapidsConf`` property using it, the family dict for loop-registered
+  keys) or a string-literal ``get``/``get_raw`` of the key itself.
+
+The registry is imported live (same registry-is-the-truth coupling the
+docs generators use), so a key added to conf.py without a consumer
+fails tier-1 the moment it lands.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+DYNAMIC_PREFIXES = ("spark.rapids.sql.exec.",
+                    "spark.rapids.sql.expression.")
+READ_CALLS = {"get", "get_raw"}
+
+
+class ConfDriftRule(Rule):
+    name = "conf-drift"
+
+    def __init__(self):
+        # (mod.rel, line, key) of every string-literal conf read
+        self.literal_reads: List[Tuple[str, int, str]] = []
+        # identifier -> Load-reference seen outside conf.py
+        self.loads_elsewhere: Set[str] = set()
+        # Load references inside conf.py (property bodies count as
+        # reads; the declaration itself is a Store and never counts)
+        self.loads_in_conf: Set[str] = set()
+        self.conf_rel = None
+        self.conf_mod = None
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        is_conf = mod.rel.replace("\\", "/").endswith(
+            "spark_rapids_tpu/conf.py")
+        if is_conf:
+            self.conf_rel = mod.rel
+            self.conf_mod = mod
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in READ_CALLS and node.args):
+                a0 = node.args[0]
+                if (isinstance(a0, ast.Constant)
+                        and isinstance(a0.value, str)
+                        and a0.value.startswith("spark.rapids.")):
+                    self.literal_reads.append(
+                        (mod.rel, node.lineno, a0.value))
+            if isinstance(node, ast.Name) and isinstance(
+                    node.ctx, ast.Load):
+                (self.loads_in_conf if is_conf
+                 else self.loads_elsewhere).add(node.id)
+            elif isinstance(node, ast.Attribute):
+                (self.loads_in_conf if is_conf
+                 else self.loads_elsewhere).add(node.attr)
+        return ()
+
+    # -- registry introspection -----------------------------------------
+
+    def _registry_maps(self):
+        """key -> constant name(s), from the LIVE registry + conf module
+        namespace; and key -> conf.py declaration line."""
+        from spark_rapids_tpu import conf as C
+        key_to_names: Dict[str, Set[str]] = {
+            k: set() for k in C.REGISTRY.entries}
+        family_names: Dict[str, Set[str]] = {}
+        for attr, val in vars(C).items():
+            if isinstance(val, C.ConfEntry):
+                key_to_names.setdefault(val.key, set()).add(attr)
+            elif isinstance(val, dict) and val and all(
+                    isinstance(v, C.ConfEntry) for v in val.values()):
+                for v in val.values():
+                    family_names.setdefault(v.key, set()).add(attr)
+        decl_lines: Dict[str, int] = {}
+        for node in ast.walk(self.conf_mod.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id == "conf":
+                if (node.args and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    decl_lines[node.args[0].value] = node.lineno
+        return key_to_names, family_names, decl_lines
+
+    def finalize(self) -> Iterable[Finding]:
+        from spark_rapids_tpu import conf as C
+        out: List[Finding] = []
+        registered = set(C.REGISTRY.entries)
+        for rel, line, key in self.literal_reads:
+            if key in registered:
+                continue
+            if any(key.startswith(p) for p in DYNAMIC_PREFIXES):
+                continue
+            out.append(Finding(
+                self.name, rel, line,
+                f"conf key {key!r} is not in the conf.py registry — "
+                "a read of it returns the fallback default forever"))
+        if self.conf_mod is None:
+            # partial run (rule fixture tests): without conf.py scanned
+            # the dead-conf direction has no declaration sites to anchor
+            return out
+        key_to_names, family_names, decl_lines = self._registry_maps()
+        literal_keys = {k for _, _, k in self.literal_reads}
+        loads_any = self.loads_elsewhere | self.loads_in_conf
+        for key in sorted(registered):
+            names = key_to_names.get(key) or set()
+            fams = family_names.get(key) or set()
+            if key in literal_keys:
+                continue
+            if any(n in loads_any for n in names):
+                continue
+            # family dicts: conf.py's own subscript-store also Loads the
+            # dict name, so only references OUTSIDE conf.py count
+            if any(f in self.loads_elsewhere for f in fams):
+                continue
+            line = decl_lines.get(key, 1)
+            out.append(Finding(
+                self.name, self.conf_rel or "spark_rapids_tpu/conf.py",
+                line,
+                f"registered conf key {key!r} has no read site in the "
+                "package (dead conf)"))
+        return out

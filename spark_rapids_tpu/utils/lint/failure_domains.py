@@ -1,0 +1,89 @@
+"""``failure-domain`` — every device/retryable raise carries a domain.
+
+PR 3 routes faults through one conf-driven ``RetryPolicy`` keyed by
+failure domain; PR 4 extended the domain set to the distributed tier.
+That routing only works if error objects ARE domain-tagged.  This rule
+keeps runtime/, shuffle/, and parallel/ honest:
+
+* ``raise RuntimeError(...)`` / ``raise Exception(...)`` is flagged —
+  a generic error crossing a retry boundary routes through no domain
+  and reaches the user as an anonymous failure.  Use a domain-tagged
+  engine type (``TerminalDeviceError``, ``InjectedDeviceError``, the
+  ``RetryOOM`` / ``Rendezvous*`` families whose domain is implicit in
+  the type) or a plain programming-error type (ValueError, TypeError,
+  ...), which the retry layer never swallows.
+* ``raise TerminalDeviceError(...)`` / ``InjectedDeviceError(...)``
+  without the domain argument is flagged statically (the constructor
+  would fail at runtime, but the lint wall catches it pre-merge).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+SCOPES = ("runtime", "shuffle", "parallel")
+
+# generic types whose raise in failure-domain code bypasses routing
+GENERIC = {"RuntimeError", "Exception", "BaseException"}
+
+# engine error types that REQUIRE an explicit domain constructor arg:
+# name -> (positional index, keyword name)
+NEEDS_DOMAIN_ARG = {
+    "TerminalDeviceError": (0, "domain"),
+    "InjectedDeviceError": (0, "where"),
+}
+
+
+def _in_scope(rel: str) -> bool:
+    parts = rel.replace("\\", "/").split("/")
+    return any(p in SCOPES for p in parts[:-1])
+
+
+def _callee_name(func) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+class FailureDomainRule(Rule):
+    name = "failure-domain"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        if not _in_scope(mod.rel):
+            return ()
+        out: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Raise) or node.exc is None:
+                continue
+            exc = node.exc
+            # `raise RuntimeError` without a call — same class hazard
+            if isinstance(exc, ast.Name) and exc.id in GENERIC:
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno,
+                    f"bare {exc.id} in failure-domain code — raise a "
+                    "domain-tagged engine error type"))
+                continue
+            if not isinstance(exc, ast.Call):
+                continue  # `raise e` re-raises keep their tag
+            cname = _callee_name(exc.func)
+            if cname in GENERIC:
+                out.append(Finding(
+                    self.name, mod.rel, node.lineno,
+                    f"generic {cname} in failure-domain code — raise a "
+                    "domain-tagged engine error type "
+                    f"(`{mod.snippet(node.lineno)}`)"))
+            elif cname in NEEDS_DOMAIN_ARG:
+                pos, kw = NEEDS_DOMAIN_ARG[cname]
+                has = (len(exc.args) > pos
+                       or any(k.arg == kw for k in exc.keywords))
+                if not has:
+                    out.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        f"{cname} raised without its '{kw}' domain "
+                        "argument"))
+        return out

@@ -1,0 +1,156 @@
+"""``host-sync-in-jit`` — traced kernel bodies must stay on device.
+
+Every operator kernel in this engine compiles through ONE of two
+funnels: ``jax.jit`` directly (decorator or call) or
+``kernel_cache.cached_kernel(key, builder)``, whose ``_build_wrapper``
+jits the function the builder returns.  Inside those traced bodies a
+host synchronization — ``np.asarray``/``np.array`` on a traced value,
+``float()``/``int()``/``bool()`` coercion, ``.item()``,
+``.block_until_ready()`` — either fails at trace time
+(``TracerArrayConversionError``) or, worse, silently constant-folds a
+traced value and bakes one batch's data into the compiled executable.
+On TPU it also stalls the pipeline: each sync is a device→host round
+trip in the middle of the hot path (feeds ROADMAP item 4's
+zero-compile-storm / flat-p99 goal).
+
+Detection is the funnel inversion: a function body is "traced" when it
+is (a) decorated with ``jax.jit`` / ``functools.partial(jax.jit,..)``,
+(b) the argument of a ``jax.jit(...)`` call, or (c) returned by a
+builder passed to ``cached_kernel`` (including through the
+``lambda: build(...)`` trampoline idiom every call site uses).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+SYNC_ATTRS = {"item", "block_until_ready"}
+NP_SYNC_FUNCS = {"asarray", "array"}
+COERCIONS = {"float", "int", "bool"}
+
+
+def _is_jit_expr(node) -> bool:
+    """``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)``."""
+    if isinstance(node, ast.Attribute) and node.attr == "jit":
+        return True
+    if isinstance(node, ast.Name) and node.id == "jit":
+        return True
+    if (isinstance(node, ast.Call)
+            and isinstance(node.func, (ast.Name, ast.Attribute))):
+        fname = (node.func.id if isinstance(node.func, ast.Name)
+                 else node.func.attr)
+        if fname == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+class _ModuleIndex(ast.NodeVisitor):
+    """Collects function defs, jit marks, and cached_kernel builders."""
+
+    def __init__(self):
+        self.defs = {}          # name -> [FunctionDef] (any nesting)
+        self.traced: Set[ast.AST] = set()
+        self.builder_names: Set[str] = set()
+        self.jit_target_names: Set[str] = set()
+
+    def visit_FunctionDef(self, node):
+        self.defs.setdefault(node.name, []).append(node)
+        if any(_is_jit_expr(d) for d in node.decorator_list):
+            self.traced.add(node)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _mark_builder_expr(self, b) -> None:
+        """The 2nd arg of ``cached_kernel``: a Name, a lambda
+        trampoline around a call, or a lambda returning a lambda."""
+        if isinstance(b, ast.Name):
+            self.builder_names.add(b.id)
+        elif isinstance(b, ast.Lambda):
+            body = b.body
+            if isinstance(body, ast.Call) and isinstance(
+                    body.func, ast.Name):
+                self.builder_names.add(body.func.id)
+            elif isinstance(body, ast.Lambda):
+                # builder returns the kernel directly
+                self.traced.add(body)
+
+    def visit_Call(self, node):
+        fname = ""
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname == "cached_kernel" and len(node.args) >= 2:
+            self._mark_builder_expr(node.args[1])
+        elif _is_jit_expr(node.func) and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Lambda):
+                self.traced.add(a0)
+            elif isinstance(a0, ast.Name):
+                # resolved after the full pass — the def may follow
+                self.jit_target_names.add(a0.id)
+        self.generic_visit(node)
+
+
+def _returned_kernels(fn: ast.AST):
+    """Functions/lambdas a builder returns — those bodies get traced."""
+    out = []
+    local_defs = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node is not fn:
+                local_defs[node.name] = node
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            v = node.value
+            if isinstance(v, ast.Lambda):
+                out.append(v)
+            elif isinstance(v, ast.Name) and v.id in local_defs:
+                out.append(local_defs[v.id])
+    return out
+
+
+class HostSyncInJitRule(Rule):
+    name = "host-sync-in-jit"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        idx = _ModuleIndex()
+        idx.visit(mod.tree)
+        traced = set(idx.traced)
+        for tname in idx.jit_target_names:
+            traced.update(idx.defs.get(tname, ()))
+        for bname in idx.builder_names - idx.jit_target_names:
+            for fn in idx.defs.get(bname, ()):
+                traced.update(_returned_kernels(fn))
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for fn in traced:
+            for node in ast.walk(fn):
+                msg = self._flag(node)
+                if msg and node.lineno not in seen:
+                    seen.add(node.lineno)
+                    out.append(Finding(
+                        self.name, mod.rel, node.lineno,
+                        f"{msg} inside a jit-traced kernel body "
+                        f"(`{mod.snippet(node.lineno)}`)"))
+        return out
+
+    def _flag(self, node) -> Optional[str]:
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in SYNC_ATTRS:
+                return f".{f.attr}() host sync"
+            if (f.attr in NP_SYNC_FUNCS and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy", "onp")):
+                return f"np.{f.attr} host materialization"
+        elif isinstance(f, ast.Name) and f.id in COERCIONS:
+            # float(1e-6) etc. on literals is shape-static and fine
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                return f"{f.id}() scalar coercion"
+        return None

@@ -1,0 +1,433 @@
+"""``lock-order`` — static lock-acquisition graph + canonical order.
+
+PRs 1–5 grew ~30 ``threading.Lock``/``RLock``/``Condition`` instances
+across runtime/, exec/, parallel/, and shuffle/.  Before the
+multi-tenant serving layer multiplies concurrent queries over this
+substrate, the acquisition ORDER becomes a correctness surface: two
+subsystems nesting each other's locks in opposite orders deadlock only
+under exactly the interleaving 64 in-flight queries will find.
+
+The rule builds a static lock-acquisition graph:
+
+* **lock identities** — every ``threading.Lock()``/``RLock()``/
+  ``Condition()`` creation site, named ``<module>.<Class>.<attr>`` (or
+  ``<module>.<name>`` for module-level locks).  One identity covers
+  every instance created at that site — order is a property of the
+  code path, not the object.
+* **direct edges** — inside every function, a nested ``with <lock>``
+  scope or an ``.acquire()`` under a held ``with`` adds
+  ``held → acquired``.
+* **call edges** — a call made under a held lock contributes the
+  callee's transitively-computed acquisitions.  Callees resolve
+  through bare names, ``self.method``, module-global instances
+  (``_SCOPE.lock``, ``INJECTOR.on``), and package import aliases
+  (``R.run_guarded`` → ``runtime.resilience::run_guarded``), with one
+  global fixpoint over the whole package.  Dynamic dispatch through
+  locals stays out of static reach — ``runtime/lockdep.py`` covers it
+  at runtime against the same canonical order.
+
+Findings: (1) a non-reentrant lock acquired while already held
+(self-deadlock), (2) any cycle in the accumulated graph, and (3) an
+edge that inverts ``CANONICAL_ORDER`` below (outermost tier first —
+the order docs/static_analysis.md publishes).  Leaf tiers (telemetry,
+trace) must never call out into engine tiers while holding their own
+locks.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+PKG = "spark_rapids_tpu"
+
+# Canonical acquisition order, outermost tier first.  An edge from a
+# later tier into an earlier one is an inversion.  Unmatched locks are
+# order-unranked (still cycle-checked).
+CANONICAL_ORDER: List[Tuple[str, str]] = [
+    (r"^(sql|exec|plan|io)\.", "query/exec layer (materialization, "
+                               "AQE, join state)"),
+    (r"^shuffle\.", "shuffle manager + exchange"),
+    (r"^parallel\.", "multi-executor tier (executor pool, rendezvous)"),
+    (r"^runtime\.semaphore\.", "device admission (semaphore CV)"),
+    (r"^runtime\.memory\.", "HBM arbiter + spill store"),
+    (r"^runtime\.kernel_cache\.", "kernel cache"),
+    (r"^runtime\.resilience\.", "retry/breaker state"),
+    (r"^runtime\.cancel\.", "cancel tokens + query scope"),
+    (r"^runtime\.(device|lockdep)\.|^native\.|^ops\.",
+     "device init + op-local state"),
+    (r"^runtime\.telemetry\.", "telemetry registry (leaf)"),
+    (r"^runtime\.trace\.", "tracer + event log (leaf)"),
+]
+
+
+def lock_rank(lock_id: str) -> Optional[int]:
+    for i, (pat, _) in enumerate(CANONICAL_ORDER):
+        if re.search(pat, lock_id):
+            return i
+    return None
+
+
+def _ctor_kind(node) -> Optional[str]:
+    """'Lock' | 'RLock' | 'Condition' for a lock-factory call."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if (isinstance(f, ast.Attribute) and f.attr in LOCK_FACTORIES
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "threading"):
+        return f.attr
+    if isinstance(f, ast.Name) and f.id in LOCK_FACTORIES:
+        return f.id
+    return None
+
+
+def _short_mod(rel: str) -> str:
+    s = rel.replace("\\", "/")
+    if s.startswith(PKG + "/"):
+        s = s[len(PKG) + 1:]
+    if s.endswith(".py"):
+        s = s[:-3]
+    return s.replace("/", ".")
+
+
+class _FnFacts:
+    """Per-function lock facts from one traversal."""
+
+    def __init__(self):
+        # (held_id, acquired_id, line)
+        self.edges: List[Tuple[str, str, int]] = []
+        self.acquires: Set[str] = set()
+        # (held_ids_tuple, callee_key "mod::qual", line)
+        self.calls: List[Tuple[Tuple[str, ...], str, int]] = []
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+
+    def __init__(self):
+        # (a, b) -> list of (mod_rel, line, note)
+        self.graph: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        self.kinds: Dict[str, str] = {}      # lock id -> ctor kind
+        # "mod::qual" -> merged facts entries
+        self.all_facts: List[Tuple[str, str, _FnFacts]] = []
+
+    # -- per-module ------------------------------------------------------
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        short = _short_mod(mod.rel)
+        module_locks: Dict[str, str] = {}           # name -> id
+        class_locks: Dict[Tuple[str, str], str] = {}  # (cls, attr) -> id
+        class_names: Set[str] = set()
+        module_instances: Dict[str, str] = {}       # name -> class name
+        import_alias: Dict[str, str] = {}           # name -> short mod
+        import_func: Dict[str, Tuple[str, str]] = {}  # name -> (mod, fn)
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                m = node.module
+                if m == PKG or m.startswith(PKG + "."):
+                    base = m[len(PKG) + 1:] if m != PKG else ""
+                    for alias in node.names:
+                        name = alias.asname or alias.name
+                        sub = (f"{base}.{alias.name}" if base
+                               else alias.name)
+                        # imported module vs imported symbol: decide at
+                        # resolution time — record both candidates
+                        import_alias[name] = sub
+                        if base:
+                            import_func[name] = (base, alias.name)
+
+        for node in mod.tree.body:
+            for tgt, val in _assignments(node):
+                if not isinstance(tgt, ast.Name):
+                    continue
+                kind = _ctor_kind(val)
+                if kind:
+                    lid = f"{short}.{tgt.id}"
+                    module_locks[tgt.id] = lid
+                    self.kinds[lid] = kind
+                elif (isinstance(val, ast.Call)
+                        and isinstance(val.func, ast.Name)):
+                    module_instances[tgt.id] = val.func.id
+
+        for cnode in ast.walk(mod.tree):
+            if not isinstance(cnode, ast.ClassDef):
+                continue
+            class_names.add(cnode.name)
+            for stmt in cnode.body:
+                for tgt, val in _assignments(stmt):
+                    kind = _ctor_kind(val)
+                    if kind and isinstance(tgt, ast.Name):
+                        lid = f"{short}.{cnode.name}.{tgt.id}"
+                        class_locks[(cnode.name, tgt.id)] = lid
+                        self.kinds[lid] = kind
+            for fnode in ast.walk(cnode):
+                if not isinstance(fnode, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                    continue
+                for sub in ast.walk(fnode):
+                    for tgt, val in _assignments(sub):
+                        kind = _ctor_kind(val)
+                        if (kind and isinstance(tgt, ast.Attribute)
+                                and isinstance(tgt.value, ast.Name)
+                                and tgt.value.id == "self"):
+                            lid = f"{short}.{cnode.name}.{tgt.attr}"
+                            class_locks[(cnode.name, tgt.attr)] = lid
+                            self.kinds[lid] = kind
+
+        ctx = dict(short=short, module_locks=module_locks,
+                   class_locks=class_locks, class_names=class_names,
+                   module_instances=module_instances,
+                   import_alias=import_alias, import_func=import_func)
+        for fn, cls in _functions(mod.tree):
+            qual = f"{cls}.{fn.name}" if cls else fn.name
+            facts = self._analyze_fn(fn, cls, ctx)
+            self.all_facts.append((mod.rel, f"{short}::{qual}", facts))
+        return ()
+
+    def _analyze_fn(self, fn, cls, ctx) -> _FnFacts:
+        facts = _FnFacts()
+        short = ctx["short"]
+        module_locks = ctx["module_locks"]
+        class_locks = ctx["class_locks"]
+        class_names = ctx["class_names"]
+        module_instances = ctx["module_instances"]
+        import_alias = ctx["import_alias"]
+        import_func = ctx["import_func"]
+
+        def resolve(expr) -> Optional[str]:
+            if isinstance(expr, ast.Name):
+                return module_locks.get(expr.id)
+            if isinstance(expr, ast.Attribute) and isinstance(
+                    expr.value, ast.Name):
+                base = expr.value.id
+                if base in ("self", "cls") and cls:
+                    return class_locks.get((cls, expr.attr))
+                if base in class_names:
+                    return class_locks.get((base, expr.attr))
+                inst_cls = module_instances.get(base)
+                if inst_cls:
+                    return class_locks.get((inst_cls, expr.attr))
+            return None
+
+        def callee_key(func) -> Optional[str]:
+            if isinstance(func, ast.Name):
+                name = func.id
+                if name in import_func:
+                    m, f = import_func[name]
+                    return f"{m}::{f}"
+                return f"{short}::{name}"
+            if (isinstance(func, ast.Attribute)
+                    and isinstance(func.value, ast.Name)):
+                base = func.value.id
+                if base == "self" and cls:
+                    return f"{short}::{cls}.{func.attr}"
+                if base in class_names:
+                    return f"{short}::{base}.{func.attr}"
+                inst_cls = module_instances.get(base)
+                if inst_cls:
+                    return f"{short}::{inst_cls}.{func.attr}"
+                if base in import_alias:
+                    return f"{import_alias[base]}::{func.attr}"
+            return None
+
+        held: List[str] = []
+
+        def walk(node):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return  # not executed at definition point
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                pushed = 0
+                for item in node.items:
+                    lid = resolve(item.context_expr)
+                    if lid is not None:
+                        for h in held:
+                            facts.edges.append((h, lid, node.lineno))
+                        held.append(lid)
+                        facts.acquires.add(lid)
+                        pushed += 1
+                    else:
+                        walk(item.context_expr)
+                for b in node.body:
+                    walk(b)
+                for _ in range(pushed):
+                    held.pop()
+                return
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "acquire":
+                    lid = resolve(f.value)
+                    if lid is not None:
+                        for h in held:
+                            facts.edges.append((h, lid, node.lineno))
+                        facts.acquires.add(lid)
+                else:
+                    ck = callee_key(f)
+                    if ck is not None:
+                        facts.calls.append((tuple(held), ck,
+                                            node.lineno))
+            for child in ast.iter_child_nodes(node):
+                walk(child)
+
+        for stmt in fn.body:
+            walk(stmt)
+        return facts
+
+    def _add_edge(self, a: str, b: str, rel: str, line: int, note: str):
+        self.graph.setdefault((a, b), []).append((rel, line, note))
+
+    # -- cross-module ----------------------------------------------------
+
+    def finalize(self) -> Iterable[Finding]:
+        # global fixpoint: what can each function (transitively) acquire
+        total: Dict[str, Set[str]] = {}
+        for _, key, f in self.all_facts:
+            total.setdefault(key, set()).update(f.acquires)
+        changed = True
+        while changed:
+            changed = False
+            for _, key, f in self.all_facts:
+                mine = total[key]
+                for _, callee, _ in f.calls:
+                    sub = total.get(callee)
+                    if sub and not sub <= mine:
+                        mine |= sub
+                        changed = True
+
+        for rel, key, f in self.all_facts:
+            for a, b, line in f.edges:
+                self._add_edge(a, b, rel, line, "")
+            for held, callee, line in f.calls:
+                if not held:
+                    continue
+                for b in total.get(callee, ()):
+                    for a in held:
+                        self._add_edge(
+                            a, b, rel, line,
+                            f"via {callee.split('::')[-1]}()")
+
+        out: List[Finding] = []
+        # 1) non-reentrant self-acquisition
+        for (a, b), sites in sorted(self.graph.items()):
+            if a == b and self.kinds.get(a) == "Lock":
+                rel, line, note = sites[0]
+                out.append(Finding(
+                    self.name, rel, line,
+                    f"non-reentrant lock {a} acquired while already "
+                    f"held — self-deadlock"
+                    f"{' (' + note + ')' if note else ''}"))
+        # 2) canonical-order inversions
+        for (a, b), sites in sorted(self.graph.items()):
+            if a == b:
+                continue
+            ra, rb = lock_rank(a), lock_rank(b)
+            if ra is not None and rb is not None and ra > rb:
+                rel, line, note = sites[0]
+                out.append(Finding(
+                    self.name, rel, line,
+                    f"acquires {b} (tier {rb}) while holding {a} "
+                    f"(tier {ra}) — inverts the canonical lock order"
+                    f"{' (' + note + ')' if note else ''}"))
+        # 3) cycles in the accumulated graph
+        adj: Dict[str, Set[str]] = {}
+        for (a, b) in self.graph:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+                adj.setdefault(b, set())
+        for scc in _sccs(adj):
+            if len(scc) < 2:
+                continue
+            cyc = sorted(scc)
+            edges_in = sorted((a, b) for (a, b) in self.graph
+                              if a in scc and b in scc and a != b)
+            rel, line, note = self.graph[edges_in[0]][0]
+            out.append(Finding(
+                self.name, rel, line,
+                "lock-order cycle: " + " -> ".join(cyc + [cyc[0]])))
+        return out
+
+
+def _functions(tree):
+    """(function_node, enclosing_class_name | None) for every def —
+    module-level, methods, and nested defs (which keep the enclosing
+    class so ``self.X`` still resolves)."""
+    out = []
+
+    def scan(node, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                scan(child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out.append((child, cls))
+                scan(child, cls)
+            else:
+                scan(child, cls)
+
+    scan(tree, None)
+    return out
+
+
+def _assignments(node):
+    """(target, value) pairs for Assign/AnnAssign statements."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            yield t, node.value
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        yield node.target, node.value
+
+
+def _sccs(adj: Dict[str, Set[str]]) -> List[Set[str]]:
+    """Tarjan's strongly connected components, iterative."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[Set[str]] = []
+    counter = [0]
+
+    for root in adj:
+        if root in index:
+            continue
+        work = [(root, iter(sorted(adj.get(root, ()))))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack.add(root)
+        while work:
+            v, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    on_stack.add(w)
+                    work.append((w, iter(sorted(adj.get(w, ())))))
+                    advanced = True
+                    break
+                elif w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+            if low[v] == index[v]:
+                comp = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.add(w)
+                    if w == v:
+                        break
+                sccs.append(comp)
+    return sccs

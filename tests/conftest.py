@@ -27,6 +27,44 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# Lock-order watchdog: the whole tier-1 suite runs with lockdep in
+# record mode (raise only in the deliberate-inversion tests that opt
+# in via lockdep.scoped).  Enabled HERE — before any test module
+# imports the engine — so module-level locks are created tracked.
+# TPUQ_LOCKDEP=0 opts out.
+_LOCKDEP_ON = os.environ.get("TPUQ_LOCKDEP", "1") != "0"
+if _LOCKDEP_ON:
+    from spark_rapids_tpu.runtime import lockdep as _lockdep
+
+    _lockdep.enable(raise_on_cycle=False)
+
+
+def _lockdep_exempted(v) -> bool:
+    """An observed violation whose acquisition site carries
+    ``# lint: exempt(lockdep): <why>`` is deliberate."""
+    rel, line = v.site
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), rel)
+    try:
+        from spark_rapids_tpu.utils.lint import SourceModule
+        return SourceModule(path, rel).exempt_at(line, "lockdep")
+    except OSError:
+        return False
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _lockdep_session_check():
+    """Fail the run if the suite observed any unexempted lock-order
+    cycle anywhere in the engine (an error in this finalizer fails the
+    session even though no single test raised)."""
+    yield
+    if not _LOCKDEP_ON:
+        return
+    bad = [v for v in _lockdep.violations() if not _lockdep_exempted(v)]
+    assert not bad, (
+        "lockdep observed lock-order cycles during the suite:\n  "
+        + "\n  ".join(str(v) for v in bad))
+
 
 def pytest_configure(config):
     config.addinivalue_line(

@@ -278,3 +278,60 @@ def test_binary_function_string_args_are_columns():
     df = s.createDataFrame([(d1, d2)], ["end", "start"])
     assert df.select(
         F.datediff("end", "start").alias("dd")).collect()[0].dd == 29
+
+
+# ---------------------------------------------------------------------------
+# fast-path concat hardening (q7 SF1 regression class)
+# ---------------------------------------------------------------------------
+
+def _device(table):
+    from spark_rapids_tpu.columnar.column import host_to_device
+    return host_to_device(table)
+
+
+def test_concat_fast_path_strings_correct():
+    """≥3 compacted batches with strings of differing widths route
+    through _concat_compacted_fast; result must match a host concat."""
+    from spark_rapids_tpu.columnar.column import device_to_host
+    from spark_rapids_tpu.exec.basic import concat_device_batches
+    tables = [
+        pa.table({"i": pa.array([1, 2], pa.int64()),
+                  "s": pa.array(["a", "bb"])}),
+        pa.table({"i": pa.array([3], pa.int64()),
+                  "s": pa.array(["ccc"])}),
+        pa.table({"i": pa.array([4, 5, 6], pa.int64()),
+                  "s": pa.array(["dddd", "e", "ff"])}),
+    ]
+    batches = [_device(t) for t in tables]
+    cat = concat_device_batches(batches[0].schema, batches,
+                                counts=[2, 1, 3])
+    got = device_to_host(cat)
+    want = pa.concat_tables(tables)
+    assert got.column("i").to_pylist() == want.column("i").to_pylist()
+    assert got.column("s").to_pylist() == want.column("s").to_pylist()
+
+
+def test_concat_fast_mismatched_arity_is_diagnosed():
+    """A batch whose column tuple is shorter than the schema (the q7
+    streamed-join side-override bug's signature) used to die with a
+    bare `IndexError: tuple index out of range` deep in kernel build;
+    it must be a ValueError naming the offending batch."""
+    from spark_rapids_tpu.exec.basic import _concat_compacted_fast
+    full = _device(pa.table({"i": pa.array([1, 2], pa.int64()),
+                             "s": pa.array(["a", "b"])}))
+    short = _device(pa.table({"i": pa.array([3], pa.int64())}))
+    with pytest.raises(ValueError, match="batch 1 carries 1 columns"):
+        _concat_compacted_fast(full.schema, [full, short],
+                               counts=[2, 1])
+
+
+def test_concat_fast_mixed_string_layout_is_diagnosed():
+    """A non-string column where batch 0 carries a string (1-D data hit
+    with `.shape[1]`) was the literal `tuple index out of range` site;
+    must now be a ValueError naming the column."""
+    from spark_rapids_tpu.exec.basic import _concat_compacted_fast
+    str_batch = _device(pa.table({"s": pa.array(["a", "b"])}))
+    int_batch = _device(pa.table({"s": pa.array([1, 2], pa.int64())}))
+    with pytest.raises(ValueError, match="column 0 .* mixed layouts"):
+        _concat_compacted_fast(str_batch.schema, [str_batch, int_batch],
+                               counts=[2, 2])

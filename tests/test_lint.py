@@ -1,0 +1,633 @@
+"""Engine invariant analyzer + lockdep watchdog tests.
+
+Two halves:
+
+* fixture tests — every lint rule fires on a synthetic violating
+  module and stays silent on the conforming variant (the rules guard
+  the tree; these guard the rules);
+* the tier-1 gate — the real tree is lint-clean, and the runtime
+  lockdep watchdog detects a deliberately seeded two-thread lock
+  inversion while an isolated scope keeps it out of the suite-wide
+  record-mode graph.
+"""
+
+import textwrap
+import threading
+
+import pytest
+
+from spark_rapids_tpu.utils.lint import (
+    Finding, SourceModule, iter_modules, run_lint)
+from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
+from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
+from spark_rapids_tpu.utils.lint.failure_domains import FailureDomainRule
+from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
+from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
+
+
+def _mod(rel, src):
+    return SourceModule("/" + rel, rel, textwrap.dedent(src))
+
+
+def _run(rules, *mods):
+    return run_lint(rules=rules, modules=list(mods))
+
+
+# ---------------------------------------------------------------------------
+# framework: exemptions
+# ---------------------------------------------------------------------------
+
+def test_exemption_needs_reason():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        import time
+        time.sleep(1)  # lint: exempt(blocking-wait)
+        """)
+    out = _run([BlockingWaitRule()], m)
+    assert [f.rule for f in out] == ["exemption"]
+
+
+def test_exemption_with_reason_suppresses():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        import time
+        time.sleep(1)  # lint: exempt(blocking-wait): startup probe
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_exemption_preceding_line_and_star():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        import time
+        # lint: exempt(*): fixture
+        time.sleep(1)
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_exemption_for_other_rule_does_not_suppress():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        import time
+        time.sleep(1)  # lint: exempt(lock-order): wrong rule
+        """)
+    assert any(f.rule == "blocking-wait"
+               for f in _run([BlockingWaitRule()], m))
+
+
+def test_annotation_in_docstring_is_inert():
+    """Quoting the annotation in a docstring neither exempts nor
+    produces a missing-reason finding — only real comments count."""
+    m = _mod("spark_rapids_tpu/runtime/x.py", '''
+        def f():
+            """Docs quoting ``# cancel-exempt`` and
+            ``# lint: exempt(blocking-wait)`` verbatim."""
+            import time
+            time.sleep(1)
+        ''')
+    out = _run([BlockingWaitRule()], m)
+    assert [f.rule for f in out] == ["blocking-wait"]
+
+
+def test_cancel_exempt_alias():
+    m = _mod("spark_rapids_tpu/parallel/x.py", """
+        import time
+        time.sleep(1)  # cancel-exempt: no query scope here
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_finding_str_format():
+    f = Finding("demo", "pkg/a.py", 7, "msg")
+    assert str(f) == "pkg/a.py:7: [demo] msg"
+
+
+# ---------------------------------------------------------------------------
+# blocking-wait
+# ---------------------------------------------------------------------------
+
+def test_blocking_wait_flags_bare_and_none_timeout():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        def f(cv):
+            cv.wait()
+            cv.wait(timeout=None)
+            cv.wait(0.1)
+            cv.wait(timeout=2.0)
+        """)
+    lines = [f.line for f in _run([BlockingWaitRule()], m)]
+    assert lines == [3, 4]
+
+
+def test_blocking_wait_out_of_scope_dir_ignored():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        import time
+        time.sleep(1)
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_blocking_wait_string_literal_not_flagged():
+    # the regex predecessor counted matches inside strings
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        DOC = "call cv.wait() and time.sleep(1) at your peril"
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
+# failure-domain
+# ---------------------------------------------------------------------------
+
+def test_failure_domain_flags_generic_raises():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        def f():
+            raise RuntimeError("boom")
+        def g():
+            raise RuntimeError
+        """)
+    assert len(_run([FailureDomainRule()], m)) == 2
+
+
+def test_failure_domain_missing_domain_arg():
+    m = _mod("spark_rapids_tpu/shuffle/x.py", """
+        def f(cause):
+            raise TerminalDeviceError(cause=cause)
+        def ok(cause):
+            raise TerminalDeviceError("alloc", cause=cause)
+        def kw(cause):
+            raise InjectedDeviceError(where="execute")
+        """)
+    out = _run([FailureDomainRule()], m)
+    assert [f.line for f in out] == [3]
+
+
+def test_failure_domain_allows_tagged_and_plain_types():
+    m = _mod("spark_rapids_tpu/parallel/x.py", """
+        def f(e):
+            raise ValueError("bad arg")
+        def g(e):
+            raise e
+        """)
+    assert _run([FailureDomainRule()], m) == []
+
+
+def test_failure_domain_out_of_scope():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        def f():
+            raise RuntimeError("exec layer may raise what it wants")
+        """)
+    assert _run([FailureDomainRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
+# host-sync-in-jit
+# ---------------------------------------------------------------------------
+
+def test_host_sync_flags_jit_decorated():
+    m = _mod("spark_rapids_tpu/ops/x.py", """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def k(a):
+            v = np.asarray(a)
+            s = float(a.sum())
+            return a.block_until_ready()
+        """)
+    out = _run([HostSyncInJitRule()], m)
+    assert sorted(f.line for f in out) == [7, 8, 9]
+
+
+def test_host_sync_flags_cached_kernel_builder():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        import numpy as np
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+
+        def build(w):
+            def run(m):
+                return np.asarray(m)
+            return run
+
+        def caller(w):
+            fn = cached_kernel(("k", w), lambda: build(w))
+            return fn
+        """)
+    out = _run([HostSyncInJitRule()], m)
+    assert [f.line for f in out] == [7]
+
+
+def test_host_sync_untraced_function_free():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        import numpy as np
+        def host_side(b):
+            return float(np.asarray(b).sum())
+        """)
+    assert _run([HostSyncInJitRule()], m) == []
+
+
+def test_host_sync_literal_coercion_ok():
+    m = _mod("spark_rapids_tpu/ops/x.py", """
+        import jax
+        @jax.jit
+        def k(a):
+            return a * float(1e-6)
+        """)
+    assert _run([HostSyncInJitRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
+# conf-drift
+# ---------------------------------------------------------------------------
+
+def test_conf_drift_phantom_key():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        def f(conf):
+            return conf.get_raw("spark.rapids.sql.noSuchKnob", 1)
+        """)
+    out = _run([ConfDriftRule()], m)
+    assert len(out) == 1 and "noSuchKnob" in out[0].message
+
+
+def test_conf_drift_registered_and_dynamic_keys_ok():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        def f(conf):
+            a = conf.get_raw("spark.rapids.sql.batchSizeBytes")
+            b = conf.get_raw("spark.rapids.sql.exec.SortExec")
+            return a, b
+        """)
+    assert _run([ConfDriftRule()], m) == []
+
+
+def test_conf_drift_dead_conf_detected():
+    """A key registered in conf.py with no read site anywhere fails.
+    Exercised on a miniature conf module so the real registry (which
+    must stay clean — see test_tree_is_lint_clean) is untouched."""
+    import spark_rapids_tpu.conf as C
+    from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule as R
+
+    class _FakeEntry(C.ConfEntry):
+        pass
+
+    rule = R()
+    conf_mod = _mod("spark_rapids_tpu/conf.py", """
+        DEAD = conf("spark.rapids.tpu.test.deadKnob").create()
+        """)
+    list(rule.check(conf_mod))
+    rule.conf_mod = conf_mod
+    rule.conf_rel = conf_mod.rel
+
+    real = dict(C.REGISTRY.entries)
+    C.REGISTRY.entries["spark.rapids.tpu.test.deadKnob"] = _FakeEntry(
+        key="spark.rapids.tpu.test.deadKnob", doc="fixture",
+        default=1, converter=int)
+    try:
+        out = list(rule.finalize())
+    finally:
+        C.REGISTRY.entries.clear()
+        C.REGISTRY.entries.update(real)
+    dead = [f for f in out if "deadKnob" in f.message]
+    assert len(dead) == 1 and "dead conf" in dead[0].message
+    assert dead[0].line == 2  # anchored at the conf.py declaration
+
+
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+def test_lock_order_nested_with_cycle():
+    m = _mod("spark_rapids_tpu/fixture.py", """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                with B:
+                    pass
+
+        def g():
+            with B:
+                with A:
+                    pass
+        """)
+    out = _run([LockOrderRule()], m)
+    assert any("cycle" in f.message for f in out)
+
+
+def test_lock_order_acquire_call_edge():
+    m = _mod("spark_rapids_tpu/fixture.py", """
+        import threading
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def f():
+            with A:
+                B.acquire()
+            B.release()
+
+        def g():
+            with B:
+                with A:
+                    pass
+        """)
+    out = _run([LockOrderRule()], m)
+    assert any("cycle" in f.message for f in out)
+
+
+def test_lock_order_self_deadlock():
+    m = _mod("spark_rapids_tpu/fixture.py", """
+        import threading
+        L = threading.Lock()
+
+        def f():
+            with L:
+                helper()
+
+        def helper():
+            with L:
+                pass
+        """)
+    out = _run([LockOrderRule()], m)
+    assert any("self-deadlock" in f.message for f in out)
+
+
+def test_lock_order_rlock_reentry_allowed():
+    m = _mod("spark_rapids_tpu/fixture.py", """
+        import threading
+        L = threading.RLock()
+
+        def f():
+            with L:
+                with L:
+                    pass
+        """)
+    assert _run([LockOrderRule()], m) == []
+
+
+def test_lock_order_cross_module_inversion():
+    """A leaf-tier (telemetry) lock holding across a call into the
+    cancel tier inverts the canonical order — resolved through the
+    package import alias and the global call closure."""
+    leaf = _mod("spark_rapids_tpu/runtime/telemetry.py", """
+        import threading
+        from spark_rapids_tpu.runtime import cancel as CC
+        TL = threading.Lock()
+
+        def flush():
+            with TL:
+                CC.poke()
+        """)
+    inner = _mod("spark_rapids_tpu/runtime/cancel.py", """
+        import threading
+        CL = threading.Lock()
+
+        def poke():
+            with CL:
+                pass
+        """)
+    out = _run([LockOrderRule()], leaf, inner)
+    assert any("inverts the canonical lock order" in f.message
+               for f in out)
+
+
+def test_lock_order_canonical_direction_clean():
+    """The same shape in the ALLOWED direction (cancel tier calling
+    into telemetry) produces no finding."""
+    outer = _mod("spark_rapids_tpu/runtime/cancel.py", """
+        import threading
+        from spark_rapids_tpu.runtime import telemetry as TM
+        CL = threading.Lock()
+
+        def f():
+            with CL:
+                TM.bump()
+        """)
+    leaf = _mod("spark_rapids_tpu/runtime/telemetry.py", """
+        import threading
+        TL = threading.Lock()
+
+        def bump():
+            with TL:
+                pass
+        """)
+    assert _run([LockOrderRule()], outer, leaf) == []
+
+
+def test_lock_order_instance_method_resolution():
+    """self-attribute locks + module-global instance calls resolve."""
+    m = _mod("spark_rapids_tpu/fixture.py", """
+        import threading
+
+        class Mgr:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def use(self):
+                with self._lock:
+                    pass
+
+        MGR = Mgr()
+        OUTER = threading.Lock()
+
+        def f():
+            with OUTER:
+                MGR.use()
+
+        def g():
+            with MGR._lock:
+                with OUTER:
+                    pass
+        """)
+    out = _run([LockOrderRule()], m)
+    assert any("cycle" in f.message for f in out)
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 gate: the real tree is clean
+# ---------------------------------------------------------------------------
+
+def test_tree_is_lint_clean():
+    """`python -m spark_rapids_tpu.utils.lint` exits 0 — every rule
+    active over the whole package, every exemption carrying a reason."""
+    findings = run_lint()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_cli_exit_codes(tmp_path):
+    from spark_rapids_tpu.utils.lint import main
+    assert main([]) == 0
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "runtime").mkdir()
+    (bad / "runtime" / "x.py").write_text(
+        "import time\ntime.sleep(1)\n")
+    assert main([str(bad)]) == 1
+
+
+def test_docs_gen_wrapper_matches_rule():
+    """check_blocking_waits_cancellable (tier-1's original wiring) is
+    now a view over the AST rule: clean tree ⇒ empty, and the legacy
+    path:lineno format is preserved for a violating tree."""
+    from spark_rapids_tpu.utils.docs_gen import (
+        check_blocking_waits_cancellable)
+    assert check_blocking_waits_cancellable() == []
+
+
+def test_docs_gen_wrapper_format(tmp_path):
+    from spark_rapids_tpu.utils.docs_gen import (
+        check_blocking_waits_cancellable)
+    pkg = tmp_path / "pkg"
+    (pkg / "runtime").mkdir(parents=True)
+    (pkg / "runtime" / "w.py").write_text(
+        "import time\n\n\ntime.sleep(2)\n")
+    out = check_blocking_waits_cancellable(str(pkg))
+    assert out == ["runtime/w.py:4: time.sleep(2)"]
+
+
+# ---------------------------------------------------------------------------
+# runtime lockdep
+# ---------------------------------------------------------------------------
+
+def test_lockdep_two_thread_inversion():
+    """The seeded lockdep demo: thread 1 takes A→B, thread 2 takes
+    B→A.  No deadlock occurs (the threads run sequentially), but the
+    watchdog reports the cycle the moment the second order is seen —
+    and raises at the closing acquisition in raise mode."""
+    from spark_rapids_tpu.runtime import lockdep
+
+    with lockdep.scoped(raise_on_cycle=True):
+        A = lockdep.tracked_lock("test.A")
+        B = lockdep.tracked_lock("test.B")
+
+        def order_ab():
+            with A:
+                with B:
+                    pass
+
+        raised = []
+
+        def order_ba():
+            try:
+                with B:
+                    with A:
+                        pass
+            except lockdep.LockOrderViolation as e:
+                raised.append(str(e))
+
+        t1 = threading.Thread(target=order_ab)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=order_ba)
+        t2.start()
+        t2.join()
+
+        assert len(raised) == 1
+        assert "test.B -> test.A" in raised[0]
+        vs = lockdep.violations()
+        assert len(vs) == 1
+        assert vs[0].cycle == ("test.A", "test.B")
+
+    # the seeded cycle stayed in the isolated scope
+    assert all(v.edge != ("test.B", "test.A")
+               for v in lockdep.violations())
+
+
+def test_lockdep_record_mode_does_not_raise():
+    from spark_rapids_tpu.runtime import lockdep
+
+    with lockdep.scoped(raise_on_cycle=False):
+        A = lockdep.tracked_lock("test.A")
+        B = lockdep.tracked_lock("test.B")
+        with A:
+            with B:
+                pass
+        with B:
+            with A:
+                pass
+        assert len(lockdep.violations()) == 1
+
+
+def test_lockdep_consistent_order_is_clean():
+    from spark_rapids_tpu.runtime import lockdep
+
+    with lockdep.scoped(raise_on_cycle=True):
+        A = lockdep.tracked_lock("test.A")
+        B = lockdep.tracked_lock("test.B")
+        for _ in range(3):
+            with A:
+                with B:
+                    pass
+        assert lockdep.violations() == []
+        assert ("test.A", "test.B") in lockdep.edges()
+
+
+def test_lockdep_rlock_reentry_no_self_edge():
+    from spark_rapids_tpu.runtime import lockdep
+
+    with lockdep.scoped(raise_on_cycle=True):
+        L = lockdep.tracked_lock("test.R", reentrant=True)
+        with L:
+            with L:
+                pass
+        assert lockdep.violations() == []
+        assert lockdep.edges() == {}
+
+
+def test_lockdep_condition_wait_drops_held():
+    """cv.wait() releases the mutex — holding another lock ACROSS the
+    wait must not fabricate an edge from the condition to it."""
+    from spark_rapids_tpu.runtime import lockdep
+
+    with lockdep.scoped(raise_on_cycle=True):
+        CV = lockdep.tracked_condition("test.CV")
+        A = lockdep.tracked_lock("test.A")
+
+        done = threading.Event()
+
+        def waiter():
+            with CV:
+                CV.wait(timeout=0.5)
+                # reacquired with nothing else held: no new edges
+            done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        # opposite order elsewhere would be a cycle only if wait kept
+        # the CV held; take A while the waiter sleeps inside CV.wait
+        with A:
+            with CV:
+                CV.notify_all()
+        t.join()
+        assert done.is_set()
+        assert lockdep.violations() == []
+        assert ("test.A", "test.CV") in lockdep.edges()
+
+
+def test_lockdep_site_filter_and_factories():
+    """enable() patches the factories; creation sites outside the
+    package get REAL primitives, and disable() restores the world."""
+    from spark_rapids_tpu.runtime import lockdep
+
+    was = lockdep.is_enabled()
+    lockdep.enable()
+    try:
+        L = threading.Lock()          # this file: outside the package
+        assert not isinstance(L, lockdep._TrackedLock)
+        assert threading.Lock is lockdep._make_lock
+    finally:
+        if not was:
+            lockdep.disable()
+            assert threading.Lock is lockdep._REAL_LOCK
+
+
+def test_lockdep_conf_gate():
+    from spark_rapids_tpu.conf import RapidsConf
+    from spark_rapids_tpu.runtime import lockdep
+
+    was = lockdep.is_enabled()
+    try:
+        lockdep.configure(RapidsConf({}))
+        assert lockdep.is_enabled() == was  # default off: no change
+        lockdep.configure(RapidsConf(
+            {"spark.rapids.tpu.lockdep.enabled": "true"}))
+        assert lockdep.is_enabled()
+    finally:
+        if not was:
+            lockdep.disable()
