@@ -1089,6 +1089,10 @@ def _sf1_query_main(name: str) -> None:
     # opTime dump below cannot give (parent/child double-counting)
     conf = dict(TPCH_SF1_CONF)
     conf["spark.rapids.sql.trace.enabled"] = True
+    # the stats plane rides the measured reps too: per-op observed
+    # rows/bytes + exchange skew keyed by stable plan signatures — the
+    # record utils/profile.py diff compares across bench runs
+    conf["spark.rapids.tpu.stats.enabled"] = True
     dfq = build(TpuSession(conf), sf1)
     try:
         dfq.toArrow(timeout_ms=remaining_ms())  # warm (compile)
@@ -1155,11 +1159,24 @@ def _sf1_query_main(name: str) -> None:
         print("TPCH_SF1_OPTIME=" + json.dumps(ops[:8]))
     except Exception as e:  # diagnostics must never fail the run
         print(f"TPCH_SF1_OPTIME_ERR={e}")
+    # stats-plane profile of the LAST run: observed per-op rows/bytes
+    # (top self-time slice) + the full exchange skew summary, keyed by
+    # stable plan signatures so profile.py diff lines bench runs up
+    try:
+        prof = getattr(dfq, "_last_profile", None)
+        if prof is not None:
+            top = sorted(prof["ops"],
+                         key=lambda r: -(r.get("self_s") or 0))[:12]
+            print("TPCH_SF1_STATS=" + json.dumps(
+                {"ops": top, "exchanges": prof["exchanges"]}))
+    except Exception as e:  # diagnostics must never fail the run
+        print(f"TPCH_SF1_STATS_ERR={e}")
 
 
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
     """Returns (seconds | "timeout" | "cancelled" | None,
-    fallback_summary | None, op_rollup | None, memory_stats | None).
+    fallback_summary | None, op_rollup | None, memory_stats | None,
+    stats_profile | None).
     The per-query deadline is enforced IN-PROCESS by the child (the
     engine's cancellation layer raises ``QueryCancelled`` at the
     deadline and reclaims resources); the subprocess timeout is kept
@@ -1172,7 +1189,7 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     budget_s = min(SF1_QUERY_BUDGET_S, budget_s)
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
-        return None, None, None, None
+        return None, None, None, None, None
     env = dict(os.environ)
     env["TPUQ_BENCH_QUERY_DEADLINE_S"] = f"{budget_s:.0f}"
     try:
@@ -1184,8 +1201,8 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     except subprocess.TimeoutExpired:
         mark(f"{name}: BACKSTOP kill after {budget_s + 60:.0f}s — the "
              f"in-process deadline failed to cancel the query")
-        return "timeout", None, None, None
-    secs = fb = rollup = mem = outcome = None
+        return "timeout", None, None, None, None
+    secs = fb = rollup = mem = stats = outcome = None
     for line in (out.stdout or "").splitlines():
         if line.startswith("TPCH_SF1_OUTCOME="):
             outcome = line.split("=", 1)[1].strip()
@@ -1197,16 +1214,18 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             rollup = json.loads(line.split("=", 1)[1])
         elif line.startswith("TPCH_SF1_MEMORY="):
             mem = json.loads(line.split("=", 1)[1])
+        elif line.startswith("TPCH_SF1_STATS="):
+            stats = json.loads(line.split("=", 1)[1])
     if outcome in ("timeout", "cancelled"):
         mark(f"{name}: {outcome} after {budget_s:.0f}s (in-process "
              f"deadline, resources reclaimed)")
-        return outcome, None, None, None
+        return outcome, None, None, None, None
     if secs is not None:
-        return secs, fb, rollup, mem
+        return secs, fb, rollup, mem, stats
     # crashed child: surface the failure, don't blur it into a timeout
     mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
          + (out.stderr or "")[-500:].replace("\n", " | "))
-    return None, None, None, None
+    return None, None, None, None, None
 
 
 def main():
@@ -1269,6 +1288,7 @@ def main():
     fallbacks = {name: None for name in TPCH_BUILDERS}
     rollups = {name: None for name in TPCH_BUILDERS}
     memories = {name: None for name in TPCH_BUILDERS}
+    statses = {name: None for name in TPCH_BUILDERS}
     result = {
         "metric": "tpch_q6_throughput",
         "value": round(ROWS / t_tpu / 1e6, 2),
@@ -1290,6 +1310,7 @@ def main():
         "tpch_sf1_fallback": fallbacks,
         "tpch_sf1_op_rollup": rollups,
         "tpch_sf1_memory": memories,
+        "tpch_sf1_stats": statses,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
         "host_memcpy_gb_per_s": round(host_memcpy_gb_per_s(), 2),
@@ -1335,8 +1356,8 @@ def main():
         # and the bench still completes; the persistent XLA cache keeps
         # whatever finished compiling, so later runs get further.
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
-        times[name], fallbacks[name], rollups[name], memories[name] = (
-            _sf1_query_subprocess(name, mark, remaining))
+        (times[name], fallbacks[name], rollups[name], memories[name],
+         statses[name]) = _sf1_query_subprocess(name, mark, remaining)
         mark(f"{name} sf1: {times[name]}s")
         emit()
 

@@ -707,6 +707,55 @@ QUERY_LOG_MAX_EVENTS = (
     .create_with_default(100000)
 )
 
+STATS_ENABLED = (
+    conf("spark.rapids.tpu.stats.enabled")
+    .doc("Per-operator runtime statistics (the stats plane): every exec "
+         "pump boundary records observed rows/batches/bytes and batch-"
+         "shape histograms, exchanges record per-partition sizes with a "
+         "skew factor, and df.explain('analyze') / "
+         "session.last_query_profile() surface the result. Off by "
+         "default — each pumped device batch pays one device sync for "
+         "its live-row count; df.explain('analyze') enables it for its "
+         "own execution regardless.")
+    .category("observability")
+    .boolean()
+    .create_with_default(False)
+)
+
+STATS_LEVEL = (
+    conf("spark.rapids.tpu.stats.level")
+    .doc("BASIC records rows/batches/bytes and batch-shape histograms; "
+         "FULL adds per-column observed null ratios (one extra device "
+         "sync per nullable column per batch).")
+    .category("observability")
+    .string()
+    .check(lambda v: v.upper() in ("BASIC", "FULL"), "BASIC or FULL")
+    .create_with_default("BASIC")
+)
+
+STATS_STORE_PATH = (
+    conf("spark.rapids.tpu.stats.storePath")
+    .doc("JSONL profile store appended with one record per executed "
+         "query: per-operator observed stats keyed by a stable plan-"
+         "node signature, plus exchange skew summaries. Read by "
+         "python -m spark_rapids_tpu.utils.profile (top/skew/diff) and "
+         "consultable by future planners across runs. Empty disables.")
+    .category("observability")
+    .string()
+    .create_with_default("")
+)
+
+STATS_SKEW_THRESHOLD = (
+    conf("spark.rapids.tpu.stats.skewThreshold")
+    .doc("An exchange partition-size skew factor (max/mean) above this "
+         "is reported as skewed in profiles, explain('analyze') and "
+         "the profiler CLI skew report.")
+    .category("observability")
+    .double()
+    .check(lambda v: v > 1.0, "> 1.0")
+    .create_with_default(2.0)
+)
+
 QUERY_TIMEOUT_MS = (
     conf("spark.rapids.tpu.query.timeoutMs")
     .doc("Per-query deadline in milliseconds, enforced in-process by "

@@ -57,10 +57,20 @@ class TpuAQEShuffleReadExec(TpuExec):
         return f"TpuAQEShuffleRead [{spec}]"
 
     def _plan(self) -> List[tuple]:
+        from spark_rapids_tpu.runtime import stats
         with self._lock:
             if self._specs is not None:
                 return self._specs
-            unit, sizes = self.children[0].aqe_partition_stats()
+            st = stats.current()
+            recorded = (st.partition_counts(self.children[0])
+                        if st is not None else None)
+            if recorded is not None:
+                # the stats plane already measured this exchange (an
+                # earlier materialization or a rendezvous-merged count)
+                # — prefer it over paying for a fresh device count
+                unit, sizes = recorded
+            else:
+                unit, sizes = self.children[0].aqe_partition_stats()
             counts = [int(c) for c in sizes]
             target = (max(self.target_bytes // self.row_bytes, 1)
                       if unit == "rows" else self.target_bytes)

@@ -21,6 +21,7 @@ from typing import Dict, Iterator, Tuple
 
 from spark_rapids_tpu.columnar import dtypes as T
 from spark_rapids_tpu.runtime import cancel
+from spark_rapids_tpu.runtime import stats
 from spark_rapids_tpu.runtime import trace
 
 # Metric verbosity levels [REF: GpuMetrics.scala :: MetricsLevel] —
@@ -127,6 +128,19 @@ def _cancellable_pump(tok, it: Iterator) -> Iterator:
         yield batch
 
 
+def _stats_pump(st, node: "ExecNode", it: Iterator) -> Iterator:
+    """Record every yielded batch on the query's OpStatsCollector —
+    rows/batches/bytes out per node, the observation side of the stats
+    plane (runtime/stats.py)."""
+    while True:
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        st.observe(node, batch)
+        yield batch
+
+
 def _wrap_execute(fn):
     @functools.wraps(fn)
     def execute(self, partition: int) -> Iterator:
@@ -134,6 +148,12 @@ def _wrap_execute(fn):
         tok = cancel.current()
         if tok is not None:
             it = _cancellable_pump(tok, it)
+        st = stats.current()
+        if st is not None:
+            # register the node up front: a pump that yields nothing
+            # still produces a (zeroed) stats record
+            st.node_stats(self)
+            it = _stats_pump(st, self, it)
         if trace.current() is None:  # fast path: tracing off
             return it
         return _traced_pump(self, partition, it)

@@ -32,6 +32,7 @@ from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.expressions import Expression
 from spark_rapids_tpu.parallel import shuffle as SH
 from spark_rapids_tpu.parallel.mesh import make_mesh
+from spark_rapids_tpu.runtime import stats as ST
 from spark_rapids_tpu.runtime import telemetry as TM
 
 _TM_COLLECTIVE_S = TM.REGISTRY.counter(
@@ -261,6 +262,12 @@ class TpuIciShuffleExchangeExec(TpuExec):
                     ("ici_count",) + base_key, self._count_builder())
                 counts = np.asarray(count_fn(sharded, *aux))  # [d*d]
                 cap = round_up_pow2(max(int(counts.max()), 1), 8)
+            st = ST.current()
+            if st is not None:
+                # counts is per-source-device × per-partition: summing
+                # over sources gives the global partition sizes
+                st.record_partitions(
+                    self, counts.reshape(d, d).sum(axis=0), unit="rows")
             # per-device collective working set: the [d*cap] layout and
             # the [d*cap] received block
             with mgr.transient(2 * d * cap * row_bytes):
@@ -453,17 +460,32 @@ class TpuIciShuffleExchangeExec(TpuExec):
                     # cross-process count program's output shards would
                     # not be addressable
                     local_max = 0
+                    local_counts = np.zeros(d, np.int64)
                     for li in range(len(local_devices)):
                         shard_b = _local_shard(sharded, local_ids[li])
-                        cnt = SH.local_partition_counts(
+                        cnt = np.asarray(SH.local_partition_counts(
                             shard_b, self._local_pid(shard_b, base_key),
-                            d)
-                        local_max = max(local_max,
-                                        int(np.asarray(cnt).max()))
-                counts = ctx.client.allgather(self._stage + ":counts",
-                                              local_max, timeout,
-                                              epoch=epoch)
-                cap = round_up_pow2(max(max(counts), 1), 8)
+                            d))
+                        local_max = max(local_max, int(cnt.max()))
+                        local_counts += cnt
+                # the payload carries this process's full per-partition
+                # contribution, not just the max: every process (the
+                # coordinator included) merges the replies into the
+                # CLUSTER-WIDE partition sizes, so skew is attributable
+                # from any executor's profile record
+                replies = ctx.client.allgather(
+                    self._stage + ":counts",
+                    {"max": local_max, "parts": local_counts.tolist()},
+                    timeout, epoch=epoch)
+                cap = round_up_pow2(
+                    max(max(r["max"] for r in replies), 1), 8)
+                st = ST.current()
+                if st is not None:
+                    st.record_partitions(
+                        self,
+                        ST.merge_partition_counts(
+                            r["parts"] for r in replies),
+                        unit="rows", executors=len(replies))
                 with mgr.transient(2 * d * cap * row_bytes):
                     ctx.client.barrier(self._stage + ":enter", timeout,
                                        epoch=epoch)

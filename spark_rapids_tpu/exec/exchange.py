@@ -31,6 +31,7 @@ from spark_rapids_tpu.columnar.column import DeviceBatch
 from spark_rapids_tpu.exec.base import CpuExec, TpuExec
 from spark_rapids_tpu.ops import hashing as HH
 from spark_rapids_tpu.ops.expressions import Expression
+from spark_rapids_tpu.runtime import stats
 
 
 class CpuShuffleExchangeExec(CpuExec):
@@ -91,6 +92,11 @@ class CpuShuffleExchangeExec(CpuExec):
                         for c in b.columns]
                     out[p_out].append(H.HostBatch(b.schema, cols))
         self._materialized = out
+        st = stats.current()
+        if st is not None:
+            st.record_partitions(
+                self, [sum(b.num_rows for b in bl) for bl in out],
+                unit="rows")
         return out
 
     def execute(self, partition: int) -> Iterator[H.HostBatch]:
@@ -202,7 +208,13 @@ class TpuShuffleExchangeExec(TpuExec):
                      for b, pid in self._materialize()]
         self._batch_counts = (np.stack(per_batch) if per_batch
                               else np.zeros((0, nparts), np.int64))
-        return self._batch_counts.sum(axis=0)
+        counts = self._batch_counts.sum(axis=0)
+        st = stats.current()
+        if st is not None:
+            # the map-output statistics AQE plans from double as the
+            # stats plane's per-partition record for this exchange
+            st.record_partitions(self, counts, unit="rows")
+        return counts
 
     def execute_pid_range(self, lo: int, hi: int
                           ) -> Iterator[DeviceBatch]:

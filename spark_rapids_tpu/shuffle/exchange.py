@@ -31,6 +31,7 @@ from spark_rapids_tpu.exec.base import TpuExec
 from spark_rapids_tpu.ops.expressions import Expression
 from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import resilience as R
+from spark_rapids_tpu.runtime import stats
 from spark_rapids_tpu.runtime import telemetry as TM
 from spark_rapids_tpu.shuffle.manager import (
     ShuffleEnv, ShuffleReader, ShuffleWriter)
@@ -217,6 +218,9 @@ class TpuHostShuffleExchangeExec(TpuExec):
                     rec = np.frombuffer(tbl, np.int64)
                     sizes += rec
                     f.seek(int(rec.sum()), os.SEEK_CUR)
+        st = stats.current()
+        if st is not None:
+            st.record_partitions(self, sizes, unit="bytes")
         return "bytes", sizes
 
     def _read_concat(self, parts) -> tuple:

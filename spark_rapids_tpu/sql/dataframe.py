@@ -792,6 +792,7 @@ class DataFrame:
         import contextlib
         from spark_rapids_tpu import conf as C
         from spark_rapids_tpu.runtime import cancel as cancel_mod
+        from spark_rapids_tpu.runtime import stats as stats_mod
         from spark_rapids_tpu.runtime import telemetry
         from spark_rapids_tpu.runtime import trace
         conf = self.session.rapids_conf()
@@ -806,6 +807,11 @@ class DataFrame:
         if conf.get(C.TRACE_ENABLED):
             tracer = trace.start_query(
                 qid, max_events=int(conf.get(C.QUERY_LOG_MAX_EVENTS)))
+        collector = None
+        if conf.get(C.STATS_ENABLED):
+            collector = stats_mod.start_query(
+                qid, level=str(conf.get(C.STATS_LEVEL)),
+                skew_threshold=float(conf.get(C.STATS_SKEW_THRESHOLD)))
         profile = contextlib.nullcontext()
         profile_dir = None
         if conf.get(C.PROFILE_ENABLED):
@@ -848,14 +854,16 @@ class DataFrame:
             raise
         finally:
             trace.end_query(tracer)
+            stats_mod.end_query(collector)
             cancel_mod.finish_query(cwin)
             self._record_query(qid, tracer, conf, profile_dir, error,
                                qwin, rwin, cancelled=cancelled,
-                               ctoken=cwin)
+                               ctoken=cwin, collector=collector)
         return out
 
     def _record_query(self, qid, tracer, conf, profile_dir, error,
-                      qwin=None, rwin=None, cancelled=None, ctoken=None):
+                      qwin=None, rwin=None, cancelled=None, ctoken=None,
+                      collector=None):
         """One event-log entry per execution: plan tree, device/fallback
         report, all metrics at their levels, span rollup, artifact
         cross-links — the reference's driver-log plan-conversion report,
@@ -927,6 +935,24 @@ class DataFrame:
                         f"!{d['op']} degraded to the host path at "
                         f"runtime [{d['domain']}] because {d['cause']}"
                         for d in res["degraded_ops"])
+        if collector is not None:
+            # the stats plane's profile record: per-op observed stats
+            # keyed by stable plan-node signatures + exchange skew
+            # summary, joined with the trace rollup's self-times
+            from spark_rapids_tpu.runtime import stats as stats_mod
+            profile = collector.report(
+                plan, rollup=entry.get("op_rollup"),
+                wall_s=entry.get("wall_s"))
+            profile["ts"] = entry["ts"]
+            profile["status"] = entry["status"]
+            entry["op_stats"] = profile["ops"]
+            if profile["exchanges"]:
+                entry["exchange_stats"] = profile["exchanges"]
+            self._last_profile = profile
+            self.session._last_profile = profile
+            store = str(conf.get(C.STATS_STORE_PATH))
+            if store:
+                stats_mod.append_profile(store, profile)
         self._last_query_entry = entry
         self.session._record_query(entry)
         log_path = str(conf.get(C.QUERY_LOG_PATH))
@@ -1070,9 +1096,14 @@ class DataFrame:
         """``explain()`` prints the physical plan; ``explain(True)`` adds
         the fallback report; ``explain("metrics")`` prints the last
         execution's per-node metrics (at the configured level) and, when
-        tracing was on, the per-operator self/total-time rollup."""
+        tracing was on, the per-operator self/total-time rollup;
+        ``explain("analyze")`` EXECUTES the query if needed and prints
+        the plan tree annotated with the observed per-operator stats
+        (rows/batches/bytes, exchange skew) + trace self-times."""
         if isinstance(extended, str) and extended.lower() == "metrics":
             return self._explain_metrics()
+        if isinstance(extended, str) and extended.lower() == "analyze":
+            return self._explain_analyze()
         from spark_rapids_tpu.plan.optimizer import optimize
         conf = self.session.rapids_conf()
         cpu = plan_physical(optimize(self._plan, conf), conf)
@@ -1099,6 +1130,77 @@ class DataFrame:
                                 key=lambda kv: -kv[1]["self_s"]):
                 print(f"  {op}: self={r['self_s']:.6f}s "
                       f"total={r['total_s']:.6f}s spans={r['spans']}")
+
+    @staticmethod
+    def _fmt_bytes(n) -> str:
+        n = float(n)
+        for unit in ("B", "KiB", "MiB", "GiB"):
+            if n < 1024 or unit == "GiB":
+                return (f"{int(n)}{unit}" if unit == "B"
+                        else f"{n:.1f}{unit}")
+            n /= 1024
+        return f"{n:.1f}GiB"
+
+    def _explain_analyze(self):
+        """EXPLAIN ANALYZE: run the query (with stats + tracing forced
+        on when it has not executed with stats yet), then print the
+        plan tree with each operator's observed statistics."""
+        profile = getattr(self, "_last_profile", None)
+        if profile is None:
+            from spark_rapids_tpu import conf as C
+            saved = {}
+            for key in (C.STATS_ENABLED.key, C.TRACE_ENABLED.key):
+                saved[key] = self.session.conf.get(key, None)
+                self.session.conf.set(key, True)
+            try:
+                self.toArrow()
+            finally:
+                for key, old in saved.items():
+                    if old is None:
+                        self.session.conf.unset(key)
+                    else:
+                        self.session.conf.set(key, old)
+            profile = getattr(self, "_last_profile", None)
+        if profile is None:
+            # a concurrent query owns the collector (nested execution)
+            print("<stats unavailable — another query owns the stats "
+                  "plane; re-run when it finishes>")
+            return
+        plan = self._last_plan
+        by_path = {r["path"]: r for r in profile["ops"]}
+        lines = []
+
+        def walk(node, path, depth):
+            rec = by_path.get(path, {})
+            ann = (f"rows={rec.get('rows_out', 0)} "
+                   f"batches={rec.get('batches_out', 0)} "
+                   f"bytes={self._fmt_bytes(rec.get('bytes_out', 0))}")
+            if rec.get("self_s") is not None:
+                ann += (f" self={rec['self_s']:.6f}s"
+                        f" total={rec['total_s']:.6f}s")
+            parts = rec.get("partition_rows",
+                            rec.get("partition_bytes"))
+            if parts is not None:
+                ann += (f" partitions={len(parts)}"
+                        f" skew={rec.get('skew_factor', 1.0):.2f}")
+                if rec.get("skewed"):
+                    ann += " SKEWED"
+                if rec.get("executors", 1) > 1:
+                    ann += f" executors={rec['executors']}"
+            if rec.get("fused"):
+                ann += " fused"
+            lines.append("  " * depth
+                         + ("*" if node.is_tpu else "")
+                         + node.node_string() + f"  [{ann}]")
+            for i, c in enumerate(node.children):
+                walk(c, f"{path}.{i}", depth + 1)
+
+        walk(plan, "0", 0)
+        print("\n".join(lines))
+        if profile.get("wall_s") is not None:
+            print(f"-- wall {profile['wall_s']:.6f}s "
+                  f"(query {profile['query_id']}, "
+                  f"stats level {profile['level']}) --")
 
     @property
     def write(self):

@@ -173,6 +173,16 @@ class TpuSession:
         h = self._query_history
         return list(h[-n:] if n else h)
 
+    def last_query_profile(self) -> Optional[Dict[str, Any]]:
+        """Structured stats-plane profile of the most recent query run
+        with ``spark.rapids.tpu.stats.enabled``: the same record
+        ``df.explain("analyze")`` renders and the profile store persists
+        — per-operator rows/batches/bytes, batch-shape histograms,
+        per-partition exchange counts with skew factors, and traced
+        self/total time when tracing was on.  None until a query has
+        executed with stats collection."""
+        return getattr(self, "_last_profile", None)
+
     # -- query lifecycle ----------------------------------------------------
     def active_queries(self) -> List[int]:
         """Ids of queries currently executing (cancellable)."""

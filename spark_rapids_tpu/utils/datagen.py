@@ -210,6 +210,46 @@ class TimestampGen(DataGen):
         return [ep]
 
 
+class SkewedLongGen(_IntGen):
+    """Long keys with a deliberately hot head: ``hot_mass`` of the rows
+    land on one of ``hot_keys`` values, the rest spread over
+    ``distinct`` — the shape that makes one hash partition dominate an
+    exchange (the skew the stats plane and AQE splitting must see)."""
+    BITS = 64
+
+    def __init__(self, hot_keys: int = 1, hot_mass: float = 0.9,
+                 distinct: int = 10_000, **kw):
+        super().__init__(T.LongT, **kw)
+        self.hot_keys = max(int(hot_keys), 1)
+        self.hot_mass = float(hot_mass)
+        self.distinct = max(int(distinct), self.hot_keys + 1)
+
+    def generate_values(self, rng, n):
+        hot = rng.random(n) < self.hot_mass
+        vals = np.where(
+            hot,
+            rng.integers(0, self.hot_keys, n, dtype=np.int64),
+            rng.integers(0, self.distinct, n, dtype=np.int64))
+        return vals.tolist()
+
+    def special_values(self):
+        # min/max sentinels would dilute the engineered hot head
+        return []
+
+
+def skewed_null_table(n: int, seed: int = 0, hot_mass: float = 0.9,
+                      null_ratio: float = 0.4) -> "pa.Table":
+    """The canonical nasty table for skew + null-ratio tests: a
+    non-null hot-headed long key ``k`` (hash-partitions into one fat
+    partition), a null-heavy double ``v``, and a null-heavy string
+    ``s``."""
+    return gen_table(
+        [SkewedLongGen(hot_mass=hot_mass, nullable=False),
+         DoubleGen(no_nans=True, null_ratio=null_ratio),
+         StringGen(min_len=1, max_len=8, null_ratio=null_ratio)],
+        n, seed=seed, names=["k", "v", "s"])
+
+
 # canonical suites used across tests (mirrors data_gen.py's *_gens lists)
 numeric_gens: List[DataGen] = [
     ByteGen(), ShortGen(), IntegerGen(), LongGen(), FloatGen(), DoubleGen(),

@@ -79,6 +79,20 @@ def check_telemetry_documented(doc_path: str = None) -> list:
     return sorted(collect_telemetry_names() - _documented_names(doc_path))
 
 
+def collect_stats_fields() -> set:
+    """Every stats-plane profile field.  The catalog dict in
+    runtime/stats.py IS the registry — the record builder and this
+    check both read it, so a field cannot ship undeclared."""
+    from spark_rapids_tpu.runtime.stats import STATS_FIELDS
+    return set(STATS_FIELDS)
+
+
+def check_stats_documented(doc_path: str = None) -> list:
+    """Stats-plane profile fields missing from docs/observability.md —
+    the tier-1 drift check's stats-plane arm."""
+    return sorted(collect_stats_fields() - _documented_names(doc_path))
+
+
 def check_blocking_waits_cancellable(pkg_dir: str = None) -> list:
     """Blocking waits in runtime/ and parallel/ that the cancellation
     layer cannot interrupt — enforced in tier-1 so no new unbounded
@@ -259,6 +273,10 @@ def main(out_dir: str = "docs"):
         if missing_tm:
             print(f"UNDOCUMENTED telemetry metrics (add to {obs}): "
                   f"{missing_tm}")
+        missing_st = check_stats_documented(obs)
+        if missing_st:
+            print(f"UNDOCUMENTED stats fields (add to {obs}): "
+                  f"{missing_st}")
     from spark_rapids_tpu.utils.lint import run_lint
     findings = run_lint()
     for f in findings:

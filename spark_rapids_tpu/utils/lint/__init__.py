@@ -28,6 +28,10 @@ Rules (catalog in docs/static_analysis.md):
 ``blocking-wait``     bare ``.wait()`` / ``time.sleep`` in runtime/ |
                       parallel/ that the cancellation layer cannot
                       interrupt (the former regex gate, now AST-exact)
+``op-stats``          every concrete exec's ``execute`` must be the
+                      auto-wrapped one: no inheriting it from a
+                      non-exec mixin, no module-level monkey-patching
+                      past the stats/trace/cancel pump wrapper
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -174,8 +178,9 @@ def all_rules() -> List[Rule]:
         FailureDomainRule)
     from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
     from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
+    from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
-            HostSyncInJitRule(), BlockingWaitRule()]
+            HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

@@ -1,0 +1,141 @@
+"""``op-stats`` — every concrete exec's pump is stats-covered.
+
+The stats plane (runtime/stats.py) and the tracer observe operators at
+the ``execute`` wrapper that ``ExecNode.__init_subclass__`` installs —
+and that hook wraps only an ``execute`` defined in the subclass's OWN
+body (``cls.__dict__``).  Two shapes silently escape it:
+
+* **mixin execute** — an exec class inheriting ``execute`` from a
+  non-exec mixin base: the mixin is outside the ``ExecNode`` hierarchy,
+  so ``__init_subclass__`` never saw its ``execute`` and every pump of
+  that class is invisible to stats, tracing, and cancellation;
+* **monkey-patch** — a module-level ``SomeExec.execute = fn``
+  assignment replaces the wrapped method with a bare one after class
+  creation.
+
+Inheriting ``execute`` from another exec-family class is fine (the
+definer was wrapped); an abstract intermediate that never defines
+``execute`` is fine too (it pumps nothing itself).  A deliberate
+escape carries ``# lint: exempt(op-stats): <why>`` on the class (or
+assignment) line.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+# the hierarchy whose __init_subclass__ owns the wrapping
+ROOT_CLASSES = {"ExecNode", "CpuExec", "TpuExec"}
+
+
+def _base_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class OpStatsRule(Rule):
+    name = "op-stats"
+
+    def __init__(self):
+        # class name -> (rel, line, base names, defines execute)
+        self._classes: Dict[str, Tuple[str, int, List[str], bool]] = {}
+        # names defined in >1 module: base resolution would guess
+        self._ambiguous: Set[str] = set()
+        # (rel, line, class name) of module-level X.execute = ...
+        self._patches: List[Tuple[str, int, str]] = []
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                bases = [b for b in map(_base_name, node.bases)
+                         if b is not None]
+                has_exec = any(
+                    isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and s.name == "execute" for s in node.body)
+                if node.name in self._classes:
+                    self._ambiguous.add(node.name)
+                else:
+                    self._classes[node.name] = (
+                        mod.rel, node.lineno, bases, has_exec)
+        # ONLY module top-level assignments: the wrapper's own
+        # ``cls.execute = _wrap_execute(fn)`` lives inside a method body
+        for stmt in mod.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            for tgt in stmt.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and tgt.attr == "execute"
+                        and isinstance(tgt.value, ast.Name)):
+                    self._patches.append(
+                        (mod.rel, stmt.lineno, tgt.value.id))
+        return ()
+
+    # -- cross-module resolution -----------------------------------------
+
+    def _is_exec_family(self, name: str, seen: Set[str]) -> bool:
+        if name in ROOT_CLASSES:
+            return True
+        if name in seen or name in self._ambiguous:
+            return False
+        seen.add(name)
+        info = self._classes.get(name)
+        if info is None:
+            return False
+        return any(self._is_exec_family(b, seen) for b in info[2])
+
+    def _execute_definer(self, name: str, seen: Set[str]
+                         ) -> Optional[str]:
+        """Nearest class (depth-first over declared bases — the static
+        stand-in for the MRO) defining ``execute``; None when
+        unresolvable (external / ambiguous base)."""
+        if name in seen:
+            return None
+        seen.add(name)
+        info = self._classes.get(name)
+        if info is None:
+            # ExecNode itself resolves here when base.py was scanned;
+            # an external base we can't see resolves to None
+            return name if name in ROOT_CLASSES else None
+        if info[3]:
+            return name
+        for b in info[2]:
+            d = self._execute_definer(b, seen)
+            if d is not None:
+                return d
+        return None
+
+    def finalize(self) -> Iterable[Finding]:
+        out: List[Finding] = []
+        exec_family = {n for n in self._classes
+                       if self._is_exec_family(n, set())}
+        for name in sorted(exec_family):
+            rel, line, _bases, has_exec = self._classes[name]
+            if has_exec:
+                continue  # own-body execute: __init_subclass__ wrapped it
+            definer = self._execute_definer(name, set())
+            if definer is None or definer in ROOT_CLASSES:
+                continue  # abstract (inherits the NotImplementedError)
+            if definer in exec_family:
+                continue  # definer's own body was wrapped at ITS creation
+            out.append(Finding(
+                self.name, rel, line,
+                f"exec class {name!r} inherits execute from non-exec "
+                f"mixin {definer!r} — __init_subclass__ never wrapped "
+                "it, so its pump is invisible to stats/trace/cancel; "
+                "define execute in the exec class (delegating is fine) "
+                "or exempt with a reason"))
+        for rel, line, cls in self._patches:
+            if cls in exec_family:
+                out.append(Finding(
+                    self.name, rel, line,
+                    f"module-level assignment replaces {cls}.execute "
+                    "AFTER class creation — the stats/trace/cancel "
+                    "wrapper is discarded; override in a subclass "
+                    "instead or exempt with a reason"))
+        return out

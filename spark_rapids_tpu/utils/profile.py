@@ -1,0 +1,353 @@
+"""Regression-diff profiler CLI over the engine's profile artifacts.
+
+[REF: the reference ships a qualification/profiling tool that
+post-processes event logs into per-query analyses and compares runs] —
+this is that tool for this engine's three artifact kinds, auto-detected
+per file:
+
+* **profile store** (``spark.rapids.tpu.stats.storePath``): one JSONL
+  record per query from the stats plane — per-op observed rows/bytes +
+  traced self-time keyed by STABLE plan-node signatures, plus the
+  exchange skew summary;
+* **query event log** (``spark.rapids.sql.queryLog``): JSONL entries
+  whose ``op_rollup``/``op_stats``/``telemetry`` fields carry the same
+  signals (plus compile counters for the storm report);
+* **bench scoreboard** (``BENCH_*.json``): one JSON object whose
+  ``tpch_sf1_op_rollup``/``tpch_sf1_stats`` maps key per-op records by
+  query name.
+
+Usage::
+
+    python -m spark_rapids_tpu.utils.profile top    <input> [--n N]
+    python -m spark_rapids_tpu.utils.profile skew   <input>
+    python -m spark_rapids_tpu.utils.profile storms <input>
+    python -m spark_rapids_tpu.utils.profile diff   <a> <b>
+        [--threshold R] [--min-self-s S]
+
+``diff`` compares per-op self-times of two runs (keys matched by plan
+signature when both sides have one) and exits nonzero when any op
+regressed by >= the threshold ratio — the bench gate's verdict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+EXIT_OK = 0
+EXIT_BAD_INPUT = 1
+EXIT_REGRESSION = 2
+
+
+# ---------------------------------------------------------------------------
+# Input loading + normalization
+# ---------------------------------------------------------------------------
+
+def _load_json_lines(path: str) -> List[dict]:
+    out: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def detect_kind(records: List[dict]) -> str:
+    """profile-store | event-log | bench, from record shape alone."""
+    if len(records) == 1 and ("tpch_sf1_op_rollup" in records[0]
+                              or "tpch_sf1_stats" in records[0]
+                              or "metric" in records[0]):
+        return "bench"
+    if any(r.get("record") == "profile" for r in records):
+        return "profile-store"
+    if any("op_rollup" in r or "op_stats" in r or "plan" in r
+           for r in records):
+        return "event-log"
+    raise ValueError("unrecognized input: neither a profile store, a "
+                     "query event log, nor a BENCH_*.json scoreboard")
+
+
+def _op_key(rec: dict) -> str:
+    """Diff key of a per-op record: signature-qualified when the record
+    carries a stable signature (profile store), bare op name otherwise
+    (event-log rollups)."""
+    sig = rec.get("sig")
+    return f"{rec['op']}[{sig}]" if sig else str(rec["op"])
+
+
+def _norm_op(rec: dict) -> dict:
+    return {
+        "op": rec.get("op"),
+        "sig": rec.get("sig"),
+        "self_s": rec.get("self_s"),
+        "total_s": rec.get("total_s"),
+        "rows_out": rec.get("rows_out"),
+        "bytes_out": rec.get("bytes_out"),
+        "batches_out": rec.get("batches_out"),
+    }
+
+
+def load_runs(path: str) -> List[dict]:
+    """Normalize any input into runs of shape
+    ``{label, ops: {key: oprec}, exchanges: [..], compiles, wall_s}``.
+    One run per query (profile store / event log) or per bench query."""
+    if path.endswith(".json"):
+        try:
+            with open(path) as f:
+                records = [json.load(f)]
+        except ValueError:
+            records = _load_json_lines(path)
+    else:
+        records = _load_json_lines(path)
+    if not records:
+        raise ValueError(f"{path}: no records")
+    kind = detect_kind(records)
+    runs: List[dict] = []
+    if kind == "bench":
+        b = records[0]
+        rollups = b.get("tpch_sf1_op_rollup") or {}
+        statses = b.get("tpch_sf1_stats") or {}
+        for q in sorted(set(rollups) | set(statses)):
+            ops: Dict[str, dict] = {}
+            for op, r in (rollups.get(q) or {}).items():
+                ops[f"{q}/{op}"] = {"op": op, "sig": None,
+                                    "self_s": r.get("self_s"),
+                                    "total_s": r.get("total_s")}
+            st = statses.get(q) or {}
+            for rec in st.get("ops") or []:
+                ops[f"{q}/{_op_key(rec)}"] = _norm_op(rec)
+            runs.append({"label": q, "ops": ops,
+                         "exchanges": (st.get("exchanges") or []),
+                         "compiles": None, "wall_s": None})
+        return runs
+    for r in records:
+        if kind == "profile-store":
+            if r.get("record") != "profile":
+                continue
+            ops = {_op_key(o): _norm_op(o) for o in r.get("ops", [])}
+            runs.append({"label": f"query {r.get('query_id')}",
+                         "ops": ops,
+                         "exchanges": r.get("exchanges") or [],
+                         "compiles": None,
+                         "wall_s": r.get("wall_s")})
+            continue
+        # event log: prefer the stats plane's op_stats, fall back to
+        # the trace rollup alone
+        ops = {}
+        for o in r.get("op_stats") or []:
+            ops[_op_key(o)] = _norm_op(o)
+        if not ops:
+            for op, ru in (r.get("op_rollup") or {}).items():
+                ops[op] = {"op": op, "sig": None,
+                           "self_s": ru.get("self_s"),
+                           "total_s": ru.get("total_s")}
+        compiles = None
+        tel = r.get("telemetry")
+        if isinstance(tel, dict):
+            compiles = tel.get("tpuq_kernel_compile_total")
+        runs.append({"label": f"query {r.get('query_id')}",
+                     "ops": ops,
+                     "exchanges": r.get("exchange_stats") or [],
+                     "compiles": compiles,
+                     "wall_s": r.get("wall_s"),
+                     "health": r.get("health") or []})
+    return runs
+
+
+def merge_ops(runs: List[dict]) -> Dict[str, dict]:
+    """Sum self/total time (and max rows/bytes) per op key across a
+    run set — the per-input aggregate the reports and diff work on."""
+    out: Dict[str, dict] = {}
+    for run in runs:
+        for key, rec in run["ops"].items():
+            slot = out.setdefault(key, {
+                "op": rec.get("op"), "self_s": 0.0, "total_s": 0.0,
+                "timed": False, "rows_out": rec.get("rows_out"),
+                "bytes_out": rec.get("bytes_out")})
+            if rec.get("self_s") is not None:
+                slot["self_s"] += float(rec["self_s"])
+                slot["timed"] = True
+            if rec.get("total_s") is not None:
+                slot["total_s"] += float(rec["total_s"])
+            for f in ("rows_out", "bytes_out"):
+                if rec.get(f) is not None:
+                    slot[f] = max(slot.get(f) or 0, rec[f])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Reports
+# ---------------------------------------------------------------------------
+
+def report_top(runs: List[dict], n: int) -> List[str]:
+    ops = merge_ops(runs)
+    timed = {k: v for k, v in ops.items() if v["timed"]}
+    lines = [f"top {n} ops by self time over {len(runs)} run(s):"]
+    if not timed:
+        lines.append("  (no traced self-times in this input — run with "
+                     "spark.rapids.sql.trace.enabled)")
+        ranked = sorted(ops.items(),
+                        key=lambda kv: -(kv[1].get("rows_out") or 0))[:n]
+        for key, v in ranked:
+            lines.append(f"  {key}: rows={v.get('rows_out')} "
+                         f"bytes={v.get('bytes_out')}")
+        return lines
+    ranked = sorted(timed.items(), key=lambda kv: -kv[1]["self_s"])[:n]
+    for key, v in ranked:
+        extra = ""
+        if v.get("rows_out") is not None:
+            extra = f" rows={v['rows_out']}"
+            if v.get("bytes_out") is not None:
+                extra += f" bytes={v['bytes_out']}"
+        lines.append(f"  {key}: self={v['self_s']:.6f}s "
+                     f"total={v['total_s']:.6f}s{extra}")
+    return lines
+
+
+def report_skew(runs: List[dict]) -> List[str]:
+    lines = [f"exchange skew over {len(runs)} run(s):"]
+    found = False
+    for run in runs:
+        for ex in run["exchanges"]:
+            found = True
+            flag = "  SKEWED" if ex.get("skewed") else ""
+            execs = (f" executors={ex['executors']}"
+                     if ex.get("executors", 1) > 1 else "")
+            lines.append(
+                f"  {run['label']} {ex['op']}[{ex.get('sig', '')}]: "
+                f"{ex.get('partitions')} parts "
+                f"max={ex.get('max')} total={ex.get('total')} "
+                f"({ex.get('unit')}) "
+                f"skew={ex.get('skew_factor'):.2f}{execs}{flag}")
+    if not found:
+        lines.append("  (no exchange partition stats in this input)")
+    return lines
+
+
+def report_storms(runs: List[dict]) -> List[str]:
+    lines = [f"compile activity over {len(runs)} run(s):"]
+    found = False
+    for run in runs:
+        storms = [h for h in run.get("health", [])
+                  if h.get("check") == "compile_storm"]
+        if run.get("compiles") or storms:
+            found = True
+            note = "".join(f"  WARN {h.get('detail', 'compile storm')}"
+                           for h in storms)
+            lines.append(f"  {run['label']}: "
+                         f"{run.get('compiles') or 0} kernel "
+                         f"compiles{note}")
+    if not found:
+        lines.append("  (no compile telemetry in this input — the "
+                     "query event log carries it)")
+    return lines
+
+
+# ---------------------------------------------------------------------------
+# diff — the regression gate
+# ---------------------------------------------------------------------------
+
+def diff_runs(a_runs: List[dict], b_runs: List[dict],
+              threshold: float = 1.5, min_self_s: float = 0.005
+              ) -> Tuple[List[str], List[dict]]:
+    """Compare per-op self-times of run set b (candidate) against a
+    (baseline).  A regression is an op whose summed self-time grew by
+    >= ``threshold``x AND is >= ``min_self_s`` in b (absolute floor so
+    microsecond noise on trivial ops never fails a gate).  Returns
+    (report lines, regressions)."""
+    a_ops, b_ops = merge_ops(a_runs), merge_ops(b_runs)
+    lines: List[str] = []
+    regressions: List[dict] = []
+    improved = 0
+    shared = sorted(set(a_ops) & set(b_ops))
+    for key in shared:
+        av, bv = a_ops[key], b_ops[key]
+        if not (av["timed"] and bv["timed"]):
+            continue
+        a_s, b_s = av["self_s"], bv["self_s"]
+        if b_s < min_self_s:
+            continue
+        ratio = b_s / a_s if a_s > 0 else float("inf")
+        if ratio >= threshold:
+            regressions.append({"op": key, "a_self_s": round(a_s, 6),
+                                "b_self_s": round(b_s, 6),
+                                "ratio": round(ratio, 2)})
+        elif ratio <= 1.0 / threshold:
+            improved += 1
+    for key in sorted(set(b_ops) - set(a_ops)):
+        bv = b_ops[key]
+        if bv["timed"] and bv["self_s"] >= min_self_s:
+            lines.append(f"  new op (no baseline): {key} "
+                         f"self={bv['self_s']:.6f}s")
+    lines.insert(0, f"compared {len(shared)} shared op(s); "
+                    f"{len(regressions)} regression(s), "
+                    f"{improved} improvement(s) at {threshold}x")
+    for r in sorted(regressions, key=lambda r: -r["ratio"]):
+        lines.append(f"  REGRESSION {r['op']}: "
+                     f"{r['a_self_s']:.6f}s -> {r['b_self_s']:.6f}s "
+                     f"({r['ratio']}x)")
+    return lines, regressions
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m spark_rapids_tpu.utils.profile",
+        description="profile reports + regression diff over profile "
+                    "stores, query event logs, and bench scoreboards")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name, help_ in (("top", "slowest ops by traced self time"),
+                        ("skew", "exchange partition-skew report"),
+                        ("storms", "kernel compile-storm report")):
+        sp = sub.add_parser(name, help=help_)
+        sp.add_argument("input")
+        if name == "top":
+            sp.add_argument("--n", type=int, default=10)
+    dp = sub.add_parser("diff", help="regression diff: b vs baseline a "
+                                     "(nonzero exit on regression)")
+    dp.add_argument("a", help="baseline input")
+    dp.add_argument("b", help="candidate input")
+    dp.add_argument("--threshold", type=float, default=1.5,
+                    help="self-time growth ratio that fails (default "
+                         "1.5)")
+    dp.add_argument("--min-self-s", type=float, default=0.005,
+                    help="ignore ops below this candidate self time")
+    args = p.parse_args(argv)
+
+    def load(path: str) -> List[dict]:
+        try:
+            return load_runs(path)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            raise SystemExit(EXIT_BAD_INPUT)
+
+    if args.cmd == "top":
+        print("\n".join(report_top(load(args.input), args.n)))
+        return EXIT_OK
+    if args.cmd == "skew":
+        print("\n".join(report_skew(load(args.input))))
+        return EXIT_OK
+    if args.cmd == "storms":
+        print("\n".join(report_storms(load(args.input))))
+        return EXIT_OK
+    lines, regressions = diff_runs(load(args.a), load(args.b),
+                                   threshold=args.threshold,
+                                   min_self_s=args.min_self_s)
+    print("\n".join(lines))
+    return EXIT_REGRESSION if regressions else EXIT_OK
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -23,6 +23,7 @@ from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
 from spark_rapids_tpu.utils.lint.failure_domains import FailureDomainRule
 from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
 from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
+from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
 
 
 def _mod(rel, src):
@@ -437,6 +438,95 @@ def test_lock_order_instance_method_resolution():
         """)
     out = _run([LockOrderRule()], m)
     assert any("cycle" in f.message for f in out)
+
+
+# ---------------------------------------------------------------------------
+# op-stats
+# ---------------------------------------------------------------------------
+
+def test_op_stats_mixin_execute_flagged():
+    """An exec class inheriting execute from a non-exec mixin escaped
+    the __init_subclass__ wrapper — its pump is invisible to stats."""
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        class _PumpMixin:
+            def execute(self):
+                yield
+
+        class BadExec(_PumpMixin, TpuExec):
+            pass
+        """)
+    out = _run([OpStatsRule()], m)
+    assert len(out) == 1
+    assert out[0].rule == "op-stats"
+    assert "non-exec mixin '_PumpMixin'" in out[0].message
+
+
+def test_op_stats_exec_hierarchy_clean():
+    """Own-body execute and execute inherited from another exec class
+    are both wrapped at their definer's creation; an abstract
+    intermediate that defines nothing pumps nothing."""
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        class BaseExec(TpuExec):
+            def execute(self):
+                yield
+
+        class ChildExec(BaseExec):
+            pass
+
+        class AbstractExec(ExecNode):
+            pass
+        """)
+    assert _run([OpStatsRule()], m) == []
+
+
+def test_op_stats_monkey_patch_flagged():
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        class GoodExec(TpuExec):
+            def execute(self):
+                yield
+
+        class NotAnExec:
+            def execute(self):
+                yield
+
+        def _fast(self):
+            yield
+
+        GoodExec.execute = _fast
+        NotAnExec.execute = _fast
+        """)
+    out = _run([OpStatsRule()], m)
+    assert len(out) == 1  # only the exec-family patch is a finding
+    assert "replaces GoodExec.execute AFTER class creation" \
+        in out[0].message
+
+
+def test_op_stats_cross_module_resolution_and_exempt():
+    """The mixin and the exec class live in different modules (finalize
+    resolves across the whole parse set); a reasoned exemption on the
+    class line suppresses."""
+    mixin = _mod("spark_rapids_tpu/exec/mixins.py", """
+        class _ReplayMixin:
+            def execute(self):
+                yield
+        """)
+    bad = _mod("spark_rapids_tpu/exec/y.py", """
+        from spark_rapids_tpu.exec.mixins import _ReplayMixin
+
+        class ReplayExec(_ReplayMixin, CpuExec):
+            pass
+        """)
+    out = _run([OpStatsRule()], mixin, bad)
+    assert [f.rule for f in out] == ["op-stats"]
+    assert out[0].path == "spark_rapids_tpu/exec/y.py"
+    exempted = _mod("spark_rapids_tpu/exec/y.py", """
+        from spark_rapids_tpu.exec.mixins import _ReplayMixin
+
+        # lint: exempt(op-stats): replay shim, pumps no real batches
+        class ReplayExec(_ReplayMixin, CpuExec):
+            pass
+        """)
+    assert _run([OpStatsRule()], mixin, exempted) == []
 
 
 # ---------------------------------------------------------------------------
