@@ -1228,6 +1228,126 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     return None, None, None, None, None
 
 
+CONCURRENCY_LEVELS = (1, 8, 64)
+CONCURRENCY_TENANTS = ("tenant_a", "tenant_b")
+
+
+def _concurrency_bench_main() -> None:
+    """Child-process entry: the multi-tenant concurrency ladder.
+
+    Submits q6-class TPC-H work through the ``QueryServer`` at 1, 8,
+    and 64 in-flight queries split across two equal-weight tenants, and
+    prints one ``TPCH_SF1_CONCURRENCY=<json>`` line: per-level p50/p99
+    end-to-end latency (submit→done, queue time included — that IS the
+    serving latency), aggregate scanned-rows/s throughput, per-tenant
+    completion/shed/reject counts from the scheduler, plus the
+    zero-deadlock/zero-leak verdicts and the equal-weight fairness
+    check under saturation."""
+    from spark_rapids_tpu.runtime import memory as M
+    from spark_rapids_tpu.sql.server import QueryRejected, QueryServer
+    from spark_rapids_tpu.sql.session import TpuSession
+    from spark_rapids_tpu.utils.harness import assert_fairness_invariant
+
+    sf = float(os.environ.get("TPUQ_BENCH_CONCURRENCY_SF", "1.0"))
+    t = gen_tpch(sf)
+    n_li = t["lineitem"].num_rows
+    conf = dict(TPCH_SF1_CONF)
+    conf.update({
+        # few run slots so 8/64 in-flight genuinely saturate + queue
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 4,
+        # headroom over the 64-deep level: this ladder measures
+        # scheduling under load, the shed path has its own tests
+        "spark.rapids.tpu.scheduler.maxQueuedQueries": 256,
+        "spark.rapids.tpu.scheduler.shed.queueDepth": 256,
+        "spark.rapids.tpu.scheduler.tenantMaxQueued": 128,
+        "spark.rapids.tpu.scheduler.tenantMaxInFlight": 4,
+    })
+    session = TpuSession(conf)
+    server = QueryServer(session)
+    q6_sf(session, t).toArrow()  # warm: compile outside the clock
+    per_query_timeout = float(os.environ.get(
+        "TPUQ_BENCH_CONCURRENCY_TIMEOUT_S", "600"))
+    records = []
+    for level in CONCURRENCY_LEVELS:
+        handles, rejected = [], 0
+        t0 = time.perf_counter()
+        for i in range(level):
+            tenant = CONCURRENCY_TENANTS[i % len(CONCURRENCY_TENANTS)]
+            try:
+                handles.append(server.submit(
+                    lambda: q6_sf(session, t), tenant=tenant))
+            except QueryRejected:
+                rejected += 1
+        lat, errors, deadlocks = [], 0, 0
+        for h in handles:
+            if not h.done.wait(timeout=per_query_timeout):
+                deadlocks += 1
+                continue
+            if h.state == "OK":
+                lat.append(h.wall_s)
+            else:
+                errors += 1
+        wall = time.perf_counter() - t0
+        lat.sort()
+        stats = server.stats()
+        fairness_ok = True
+        if level >= 8:  # saturated levels only — 1 query can't be fair
+            try:
+                assert_fairness_invariant(stats)
+            except AssertionError:
+                fairness_ok = False
+        mgr = M.peek_manager()
+        records.append({
+            "in_flight": level,
+            "tenants": len(CONCURRENCY_TENANTS),
+            "completed": len(lat),
+            "errors": errors,
+            "deadlocks": deadlocks,
+            "rejected_at_submit": rejected,
+            "p50_s": round(lat[len(lat) // 2], 3) if lat else None,
+            "p99_s": (round(lat[min(len(lat) - 1,
+                                    int(len(lat) * 0.99))], 3)
+                      if lat else None),
+            "wall_s": round(wall, 3),
+            "rows_per_s": (round(n_li * len(lat) / wall, 1)
+                           if wall > 0 else None),
+            "fairness_ok": fairness_ok,
+            "leaks": mgr.report_leaks() if mgr is not None else 0,
+            "per_tenant": {
+                name: {k: s[k] for k in ("completed", "shed",
+                                         "rejected",
+                                         "cancelled_queued")}
+                for name, s in stats.items()},
+        })
+    server.shutdown()
+    print("TPCH_SF1_CONCURRENCY=" + json.dumps(records))
+
+
+def concurrency_bench(mark, budget_s: float):
+    """Run the concurrency ladder in a subprocess (same isolation as
+    the SF1 per-query children); returns the records list or None."""
+    import subprocess
+    budget_s = min(float(os.environ.get(
+        "TPUQ_BENCH_CONCURRENCY_BUDGET_S", "1800")), budget_s)
+    if budget_s < 60:
+        mark("concurrency bench: skipped — outer budget exhausted")
+        return None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--concurrency-bench"],
+            capture_output=True, text=True, timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        mark(f"concurrency bench: timed out after {budget_s:.0f}s")
+        return None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("TPCH_SF1_CONCURRENCY="):
+            return json.loads(line.split("=", 1)[1])
+    mark(f"concurrency bench: child rc={out.returncode}; stderr tail: "
+         + (out.stderr or "")[-400:].replace("\n", " | "))
+    return None
+
+
 def main():
     from spark_rapids_tpu.sql.session import TpuSession
 
@@ -1311,6 +1431,7 @@ def main():
         "tpch_sf1_op_rollup": rollups,
         "tpch_sf1_memory": memories,
         "tpch_sf1_stats": statses,
+        "tpch_sf1_concurrency": None,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
         "host_memcpy_gb_per_s": round(host_memcpy_gb_per_s(), 2),
@@ -1348,6 +1469,12 @@ def main():
         checked[name] = _rows_equal(a, b, tol=1e-6)
         mark(f"{name} small oracle check: {checked[name]}")
         emit()
+    # concurrency ladder BEFORE the SF1 per-query ladder: the latter is
+    # the budget sponge, and a truncated run should still carry the
+    # multi-tenant serving numbers
+    result["tpch_sf1_concurrency"] = concurrency_bench(
+        mark, TOTAL_BUDGET_S - (time.monotonic() - t_start))
+    emit()
     for name in TPCH_BUILDERS:
         # each SF1 query runs in a SUBPROCESS with a hard deadline: a
         # first-ever compile of a heavy kernel set can exceed any
@@ -1368,5 +1495,7 @@ if __name__ == "__main__":
         _sf1_query_main(_sys.argv[2])
     elif len(_sys.argv) == 2 and _sys.argv[1] == "--ici-bench":
         _ici_bench_main()
+    elif len(_sys.argv) == 2 and _sys.argv[1] == "--concurrency-bench":
+        _concurrency_bench_main()
     else:
         main()

@@ -954,6 +954,135 @@ HEALTH_COMPILE_STORM = (
     .create_with_default(64)
 )
 
+# -- multi-tenant query service (runtime/scheduler.py + sql/server.py) ------
+#
+# Per-tenant overrides ride a dynamic key family the scheduler reads at
+# tenant creation:
+#   spark.rapids.tpu.scheduler.tenant.<name>.weight        (double)
+#   spark.rapids.tpu.scheduler.tenant.<name>.maxInFlight   (int)
+#   spark.rapids.tpu.scheduler.tenant.<name>.maxQueued     (int)
+#   spark.rapids.tpu.scheduler.tenant.<name>.hbmShare      (double)
+# Unlisted tenants get the tenantWeight/tenantMaxInFlight/tenantMaxQueued/
+# tenantHbmShare defaults below.
+
+SCHED_MAX_CONCURRENT = (
+    conf("spark.rapids.tpu.scheduler.maxConcurrentQueries")
+    .doc("How many admitted queries may execute concurrently across "
+         "ALL tenants. Queries beyond this wait in their tenant's "
+         "queue until the fairness scheduler (weighted deficit "
+         "round-robin across tenants, priority lanes within a tenant) "
+         "grants them a run slot. This caps whole queries; "
+         "spark.rapids.sql.concurrentGpuTasks still caps the "
+         "per-partition device admission inside each running query.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(4)
+)
+
+SCHED_MAX_QUEUED = (
+    conf("spark.rapids.tpu.scheduler.maxQueuedQueries")
+    .doc("Global cap on queries waiting for a run slot, across all "
+         "tenants. A submission beyond it is rejected with "
+         "QueryRejected(reason='queue_full').")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(256)
+)
+
+SCHED_TENANT_WEIGHT = (
+    conf("spark.rapids.tpu.scheduler.tenantWeight")
+    .doc("Default fair-share weight of a tenant in the deficit "
+         "round-robin dispatcher: a tenant with weight 2 is granted "
+         "run slots twice as often as a weight-1 tenant under "
+         "contention. Per-tenant override: "
+         "spark.rapids.tpu.scheduler.tenant.<name>.weight.")
+    .category("scheduler")
+    .double()
+    .check(lambda v: v >= 0.01, ">= 0.01")
+    .create_with_default(1.0)
+)
+
+SCHED_TENANT_MAX_IN_FLIGHT = (
+    conf("spark.rapids.tpu.scheduler.tenantMaxInFlight")
+    .doc("Default per-tenant cap on concurrently RUNNING queries. "
+         "Submissions beyond it queue (they are not rejected). "
+         "Per-tenant override: "
+         "spark.rapids.tpu.scheduler.tenant.<name>.maxInFlight.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(4)
+)
+
+SCHED_TENANT_MAX_QUEUED = (
+    conf("spark.rapids.tpu.scheduler.tenantMaxQueued")
+    .doc("Default per-tenant cap on QUEUED queries. A submission "
+         "beyond it is rejected with "
+         "QueryRejected(reason='tenant_queue_full'). Per-tenant "
+         "override: spark.rapids.tpu.scheduler.tenant.<name>.maxQueued.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(64)
+)
+
+SCHED_TENANT_HBM_SHARE = (
+    conf("spark.rapids.tpu.scheduler.tenantHbmShare")
+    .doc("Default per-tenant HBM-reservation share, enforced as the "
+         "fraction of maxConcurrentQueries run slots the tenant may "
+         "hold at once (each running query may reserve up to the full "
+         "HBM pool, so bounding a tenant's share of run slots bounds "
+         "its share of device memory pressure). Per-tenant override: "
+         "spark.rapids.tpu.scheduler.tenant.<name>.hbmShare.")
+    .category("scheduler")
+    .double()
+    .check(lambda v: 0.0 < v <= 1.0, "in (0, 1]")
+    .create_with_default(1.0)
+)
+
+SCHED_SHED_QUEUE_DEPTH = (
+    conf("spark.rapids.tpu.scheduler.shed.queueDepth")
+    .doc("Load-shed watermark on total service depth (queued + running "
+         "queries): a submission arriving at or above it is shed with "
+         "QueryRejected(reason='shed_queue_depth'), counted in "
+         "tpuq_admission_shed_total and WARNed by the health "
+         "evaluator, instead of joining a queue that can no longer "
+         "drain within any useful deadline.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(128)
+)
+
+SCHED_SHED_SPILL_RATIO = (
+    conf("spark.rapids.tpu.scheduler.shed.spillRatio")
+    .doc("Load-shed watermark on spill pressure: when the host spill "
+         "tier's occupancy fraction (DeviceMemoryManager.spill_pressure) "
+         "is at or above this, new submissions are shed with "
+         "QueryRejected(reason='shed_spill_pressure') BEFORE the "
+         "arbiter starts thrashing the disk tier.")
+    .category("scheduler")
+    .double()
+    .check(lambda v: v > 0.0, "positive")
+    .create_with_default(0.85)
+)
+
+SCHED_SHED_SEM_SATURATION = (
+    conf("spark.rapids.tpu.scheduler.shed.semaphoreSaturation")
+    .doc("Load-shed watermark on device-admission saturation: "
+         "(semaphore holders + blocked waiters) / permits at or above "
+         "this sheds new submissions with "
+         "QueryRejected(reason='shed_semaphore_saturation'). The "
+         "default 4.0 means: shed when 4x more tasks want the device "
+         "than it admits.")
+    .category("scheduler")
+    .double()
+    .check(lambda v: v > 0.0, "positive")
+    .create_with_default(4.0)
+)
+
 
 class RapidsConf:
     """Immutable-ish view over a raw key->value dict, validated at init.
@@ -974,6 +1103,11 @@ class RapidsConf:
                     # per-op kill switches are registered dynamically by the
                     # overrides rule table; store raw
                     self._values[k] = _parse_bool(v)
+                elif k.startswith("spark.rapids.tpu.scheduler.tenant."):
+                    # per-tenant scheduler overrides (weight/maxInFlight/
+                    # maxQueued/hbmShare) keyed by tenant name; the scheduler
+                    # parses and validates at tenant creation
+                    self._values[k] = v
                 elif k.startswith("spark.rapids."):
                     unknown.append(k)
                 else:

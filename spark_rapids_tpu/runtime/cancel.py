@@ -35,6 +35,7 @@ returns 0 after every cancelled query.
 
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Callable, Dict, List, Optional
@@ -236,79 +237,150 @@ class CancelToken:
 
 
 # ---------------------------------------------------------------------------
-# process-wide query scope (mirrors resilience._QueryState: one active
-# query scope; nested executions join the outer scope)
+# per-THREAD query scope.  PR 8 made this thread-local: concurrent
+# queries (the multi-tenant QueryServer runs one per worker thread) each
+# own an independent token, while nested executions ON THE SAME THREAD
+# still join the outer scope.  Worker threads a query fans out to (the
+# partition-pump pool) re-enter the query's scope via ``bind(token)``.
 # ---------------------------------------------------------------------------
 
-class _Scope:
+class _Scope(threading.local):
+    token: Optional[CancelToken]
+    depth: int
+
     def __init__(self):
-        self.lock = threading.Lock()
-        self.token: Optional[CancelToken] = None
+        self.token = None
         self.depth = 0
 
 
 _SCOPE = _Scope()
 _ACTIVE: Dict[int, CancelToken] = {}   # query_id -> token (in-flight)
 _ACTIVE_LOCK = threading.Lock()
+# tokens of OPEN begin_query scopes, in open order.  When exactly one
+# query is running, helper threads the engine spawns without an
+# explicit bind() (legacy serial-world pattern) still see its token;
+# with several concurrent scopes the ambient view is ambiguous, so
+# unbound threads get None and every concurrent path must bind().
+_AMBIENT: List[CancelToken] = []
+
+
+def _thread_token() -> Optional[CancelToken]:
+    tok = _SCOPE.token
+    if tok is not None:
+        return tok
+    amb = _AMBIENT
+    return amb[0] if len(amb) == 1 else None
+
+
+def register(token: CancelToken) -> None:
+    """Make a pre-created token addressable by ``cancel_query`` /
+    ``active_queries`` BEFORE its query executes — the scheduler
+    registers tokens at submit time so queued-not-yet-running queries
+    can be cancelled and deadline-expired like running ones."""
+    if token.query_id is None:
+        raise ValueError("cannot register a token without a query_id")
+    with _ACTIVE_LOCK:
+        _ACTIVE[token.query_id] = token
+
+
+def unregister(token: CancelToken) -> None:
+    """Drop a ``register``-ed token (idempotent; never drops a
+    different token that reused the id)."""
+    if token.query_id is None:
+        return
+    with _ACTIVE_LOCK:
+        if _ACTIVE.get(token.query_id) is token:
+            del _ACTIVE[token.query_id]
 
 
 def begin_query(query_id: int, conf=None,
-                timeout_ms: Optional[float] = None
+                timeout_ms: Optional[float] = None,
+                token: Optional[CancelToken] = None
                 ) -> Optional[CancelToken]:
-    """Open (or join) the query's cancel scope.  Returns the token for
-    the OUTERMOST open (the handle ``finish_query`` needs); nested
-    executions join the outer token and get None.  ``timeout_ms``
-    overrides ``spark.rapids.tpu.query.timeoutMs``; <= 0 means no
-    deadline."""
-    poll_ms = DEFAULT_POLL_S * 1000.0
-    conf_timeout = None
-    if conf is not None:
-        from spark_rapids_tpu import conf as C
-        poll_ms = float(conf.get(C.CANCEL_POLL_MS))
-        conf_timeout = float(conf.get(C.QUERY_TIMEOUT_MS))
-    eff = timeout_ms if timeout_ms is not None else conf_timeout
-    if eff is not None and eff <= 0:
-        eff = None
-    with _SCOPE.lock:
-        _SCOPE.depth += 1
-        if _SCOPE.depth > 1:
-            return None  # joined the outer query's token
-        tok = CancelToken(query_id, timeout_ms=eff, poll_ms=poll_ms)
-        _SCOPE.token = tok
+    """Open (or join) the calling thread's cancel scope.  Returns the
+    token for the OUTERMOST open (the handle ``finish_query`` needs);
+    nested executions on the same thread join the outer token and get
+    None.  ``timeout_ms`` overrides ``spark.rapids.tpu.query.timeoutMs``;
+    <= 0 means no deadline.  ``token`` adopts a pre-created token (the
+    scheduler creates tokens at submit time so deadlines tick and
+    cancels land while the query is still queued) instead of minting a
+    fresh one — its deadline/poll settings are kept as created."""
+    _SCOPE.depth += 1
+    if _SCOPE.depth > 1:
+        return None  # joined this thread's outer query token
+    if token is None:
+        poll_ms = DEFAULT_POLL_S * 1000.0
+        conf_timeout = None
+        if conf is not None:
+            from spark_rapids_tpu import conf as C
+            poll_ms = float(conf.get(C.CANCEL_POLL_MS))
+            conf_timeout = float(conf.get(C.QUERY_TIMEOUT_MS))
+        eff = timeout_ms if timeout_ms is not None else conf_timeout
+        if eff is not None and eff <= 0:
+            eff = None
+        token = CancelToken(query_id, timeout_ms=eff, poll_ms=poll_ms)
+    _SCOPE.token = token
     with _ACTIVE_LOCK:
-        _ACTIVE[query_id] = tok
-    return tok
+        _ACTIVE[query_id] = token
+        _AMBIENT.append(token)
+    return token
 
 
 def finish_query(token: Optional[CancelToken]) -> None:
     """Close the scope opened by ``begin_query`` (no-op for joiners)."""
-    with _SCOPE.lock:
-        _SCOPE.depth = max(0, _SCOPE.depth - 1)
-        if token is None or _SCOPE.depth > 0:
-            return
-        _SCOPE.token = None
-    if token.query_id is not None:
-        with _ACTIVE_LOCK:
-            _ACTIVE.pop(token.query_id, None)
+    _SCOPE.depth = max(0, _SCOPE.depth - 1)
+    if token is None or _SCOPE.depth > 0:
+        return
+    _SCOPE.token = None
+    with _ACTIVE_LOCK:
+        if (token.query_id is not None
+                and _ACTIVE.get(token.query_id) is token):
+            del _ACTIVE[token.query_id]
+        try:
+            _AMBIENT.remove(token)
+        except ValueError:
+            pass
+
+
+@contextlib.contextmanager
+def bind(token: Optional[CancelToken]):
+    """Run a block under a query's token on a DIFFERENT thread than the
+    one that opened the scope — the partition pump binds the submitting
+    thread's token into each pool worker so every blocking boundary
+    downstream (semaphore, retry backoff, spill IO, shuffle) polls the
+    right query's token.  ``bind(None)`` is a no-op scope.  Restores
+    the thread's previous scope on exit, so nested binds and
+    worker-thread reuse across queries are safe."""
+    prev_token, prev_depth = _SCOPE.token, _SCOPE.depth
+    if token is not None:
+        _SCOPE.token = token
+        _SCOPE.depth = prev_depth + 1
+    try:
+        yield token
+    finally:
+        _SCOPE.token, _SCOPE.depth = prev_token, prev_depth
 
 
 def current() -> Optional[CancelToken]:
-    """The active query's token (None outside any query scope)."""
-    return _SCOPE.token
+    """The calling thread's active query token — its own scope, or the
+    sole open query's token when exactly one query is running (so
+    helper threads spawned without ``bind`` stay cancellable in the
+    serial world).  None when out of scope under concurrency."""
+    return _thread_token()
 
 
 def check() -> None:
-    """Module-level poll: raise ``QueryCancelled`` if the active
-    query's token fired.  Free outside a query scope."""
-    tok = _SCOPE.token
+    """Module-level poll: raise ``QueryCancelled`` if the calling
+    thread's query token fired.  Free outside a query scope."""
+    tok = _thread_token()
     if tok is not None:
         tok.check()
 
 
 def sleep(seconds: float) -> None:
-    """Cancellable sleep under the active token; a plain sleep outside
-    any query scope."""
-    tok = _SCOPE.token
+    """Cancellable sleep under the calling thread's token; a plain
+    sleep outside any query scope."""
+    tok = _thread_token()
     if tok is not None:
         tok.sleep(seconds)
     else:
@@ -333,9 +405,11 @@ def active_queries() -> List[int]:
 
 
 def reset() -> None:
-    """Test hook: drop any leaked scope state."""
-    with _SCOPE.lock:
-        _SCOPE.token = None
-        _SCOPE.depth = 0
+    """Test hook: drop any leaked scope state.  Scopes are thread-local
+    now, so this clears the CALLING thread's scope plus the process-wide
+    active-token table."""
+    _SCOPE.token = None
+    _SCOPE.depth = 0
     with _ACTIVE_LOCK:
         _ACTIVE.clear()
+        del _AMBIENT[:]

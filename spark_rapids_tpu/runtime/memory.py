@@ -420,6 +420,15 @@ class DeviceMemoryManager:
                     for i, s in self._spillables.items()
                     if include_pinned or i not in pinned]
 
+    def spill_pressure(self) -> float:
+        """Occupancy fraction of the HOST spill tier (0.0 = empty,
+        >= 1.0 = the next host spill will push victims to disk).  The
+        admission controller sheds new queries when this crosses its
+        watermark — BEFORE the arbiter starts thrashing the disk tier."""
+        if self.host_limit <= 0:
+            return 0.0
+        return self._host_used / self.host_limit
+
     def report_leaks(self) -> int:
         leaks = self.leaked()
         for s, origin in leaks:

@@ -1,0 +1,575 @@
+"""Multi-tenant admission control and fair query scheduling.
+
+The serving tier's gate in front of the whole engine: many callers
+submit queries across named *tenants*; this module decides — before any
+plan executes or reserves a byte of HBM — whether each submission is
+admitted, queued, or shed, and in what order queued queries get one of
+the ``maxConcurrentQueries`` run slots.
+
+Three layers, checked in order:
+
+1. **Load shedding** (service-wide watermarks, conf family
+   ``spark.rapids.tpu.scheduler.shed.*``): total depth (queued +
+   running), host spill-tier pressure
+   (``DeviceMemoryManager.spill_pressure``), and device-admission
+   saturation (``(holders + waiting) / permits`` on the
+   ``DeviceSemaphore``).  A breach rejects the submission with
+   ``QueryRejected(reason='shed_*')``, bumps
+   ``tpuq_admission_shed_total{tenant=...}`` and records a health WARN
+   — the service defends itself BEFORE the HBM arbiter starts
+   thrashing the disk tier.
+2. **Per-tenant quotas**: ``maxQueued`` rejects
+   (``reason='tenant_queue_full'``); ``maxInFlight`` and the HBM share
+   never reject — they bound how many of the tenant's queries may RUN
+   at once, so excess submissions queue.  The HBM share is enforced as
+   a fraction of the global run slots (each running query may reserve
+   up to the full HBM pool, so capping a tenant's concurrent run slots
+   caps its share of device-memory pressure).
+3. **Fair dispatch**: weighted deficit round-robin across tenants —
+   each refill round adds ``weight`` credit to every backlogged
+   tenant, one run-slot grant costs one credit — with strict priority
+   lanes inside a tenant (higher ``priority`` first, FIFO within a
+   lane).  A weight-2 tenant drains twice as fast as a weight-1 tenant
+   under contention, and no backlogged tenant starves: its deficit
+   grows every round until it wins one.
+
+Cancellation composes: a queued ticket's worker blocks in
+``acquire()`` polling its ``CancelToken``, so ``session.cancel`` and
+deadline expiry surface ``QueryCancelled`` within ~2x the poll
+interval *without* the query ever being admitted, and the vacated
+queue entry is dispatched past immediately.
+
+``device_hold`` at the bottom is THE sanctioned path to the
+``DeviceSemaphore`` — the ``scheduler-bypass`` tier-1 lint rule fails
+any other module that reaches for ``get_semaphore`` directly, so
+future execs cannot dodge admission control.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from spark_rapids_tpu.runtime import telemetry as TM
+from spark_rapids_tpu.runtime.semaphore import get_semaphore, peek_semaphore
+
+_TM_SUBMITTED = TM.REGISTRY.labeled_counter(
+    "tpuq_scheduler_submitted_total",
+    "queries admitted into the scheduler (queued or dispatched)",
+    label="tenant")
+_TM_COMPLETED = TM.REGISTRY.labeled_counter(
+    "tpuq_scheduler_completed_total",
+    "queries that finished (released their run slot) per tenant",
+    label="tenant")
+_TM_REJECTED = TM.REGISTRY.labeled_counter(
+    "tpuq_admission_rejected_total",
+    "submissions rejected at admission, by structured reason "
+    "(shed_* reasons also count in tpuq_admission_shed_total)",
+    label="reason")
+_TM_SHED = TM.REGISTRY.labeled_counter(
+    "tpuq_admission_shed_total",
+    "submissions load-shed by watermark breach, per tenant",
+    label="tenant")
+_TM_CANCELLED_QUEUED = TM.REGISTRY.counter(
+    "tpuq_scheduler_cancelled_queued_total",
+    "queries cancelled or deadline-expired while still QUEUED "
+    "(never admitted to a run slot)")
+_TM_QUEUE_WAIT = TM.REGISTRY.histogram(
+    "tpuq_scheduler_queue_wait_seconds",
+    "queued-to-granted latency per admitted query")
+
+# ticket lifecycle
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+DONE = "DONE"
+CANCELLED = "CANCELLED"
+
+#: rejection reasons that mean "the service is overloaded" (counted in
+#: the shed counter + health WARN) as opposed to "this tenant hit its
+#: own quota"
+SHED_REASONS = frozenset({"shed_queue_depth", "shed_spill_pressure",
+                          "shed_semaphore_saturation"})
+
+_TENANT_PREFIX = "spark.rapids.tpu.scheduler.tenant."
+
+
+class QueryRejected(RuntimeError):
+    """Structured admission rejection.  ``reason`` is machine-readable
+    (``shed_queue_depth`` / ``shed_spill_pressure`` /
+    ``shed_semaphore_saturation`` / ``tenant_queue_full`` /
+    ``queue_full``); callers switch on it to retry, back off, or fail
+    over to another replica."""
+
+    def __init__(self, reason: str, tenant: Optional[str] = None,
+                 detail: str = ""):
+        self.reason = reason
+        self.tenant = tenant
+        self.detail = detail
+        msg = f"query rejected at admission: {reason}"
+        if tenant is not None:
+            msg += f" (tenant={tenant})"
+        if detail:
+            msg += f" — {detail}"
+        super().__init__(msg)
+
+
+class Ticket:
+    """One submission's place in the service.  Created by ``submit``;
+    the owning worker blocks in ``acquire`` until granted, runs the
+    query, then ``release``s the slot."""
+
+    __slots__ = ("query_id", "tenant", "priority", "token", "state",
+                 "submitted_at", "granted_at")
+
+    def __init__(self, query_id: int, tenant: str, priority: int, token):
+        self.query_id = query_id
+        self.tenant = tenant
+        self.priority = priority
+        self.token = token
+        self.state = QUEUED
+        self.submitted_at = time.monotonic()
+        self.granted_at: Optional[float] = None
+
+
+class TenantState:
+    """Per-tenant queues, quotas, and accounting.  All mutation happens
+    under the owning scheduler's condition lock."""
+
+    __slots__ = ("name", "weight", "max_in_flight", "max_queued",
+                 "hbm_share", "run_cap", "lanes", "deficit", "running",
+                 "queued", "submitted", "completed", "rejected", "shed",
+                 "cancelled_queued")
+
+    def __init__(self, name: str, weight: float, max_in_flight: int,
+                 max_queued: int, hbm_share: float, max_concurrent: int):
+        self.name = name
+        self.weight = max(0.01, float(weight))
+        self.max_in_flight = max(1, int(max_in_flight))
+        self.max_queued = max(0, int(max_queued))
+        self.hbm_share = min(1.0, max(0.0, float(hbm_share)))
+        # the HBM share caps concurrent run slots (each slot may
+        # reserve up to the whole pool); always at least 1 so a
+        # configured tenant can make progress
+        self.run_cap = max(1, min(self.max_in_flight,
+                                  math.ceil(self.hbm_share
+                                            * max_concurrent)))
+        # priority -> FIFO of queued tickets; higher priority drains
+        # first, strictly
+        self.lanes: Dict[int, deque] = {}
+        self.deficit = 0.0
+        self.running = 0
+        self.queued = 0
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.shed = 0
+        self.cancelled_queued = 0
+
+    def backlogged(self) -> bool:
+        return self.queued > 0 and self.running < self.run_cap
+
+    def pop_ticket(self) -> Ticket:
+        prio = max(p for p, lane in self.lanes.items() if lane)
+        lane = self.lanes[prio]
+        ticket = lane.popleft()
+        if not lane:
+            del self.lanes[prio]
+        return ticket
+
+    def remove_ticket(self, ticket: Ticket) -> bool:
+        lane = self.lanes.get(ticket.priority)
+        if lane is None:
+            return False
+        try:
+            lane.remove(ticket)
+        except ValueError:
+            return False
+        if not lane:
+            del self.lanes[ticket.priority]
+        return True
+
+
+class QueryScheduler:
+    """The admission controller + fair dispatcher.  One condition
+    variable guards all state; dispatch is event-driven (runs inside
+    ``submit``/``release``/queued-cancel removal — there is no
+    scheduler thread to leak or deadlock).
+
+    Lock order: ``self._cv`` may be held while touching a
+    ``CancelToken`` (``check``/``add_waiter``) — safe because token
+    cancel/deadline callbacks notify waiter CVs OUTSIDE the token
+    lock.  The scheduler never touches ``DeviceSemaphore._cv`` or the
+    memory-manager lock while holding ``self._cv`` (the pressure
+    probes read plain attributes).
+    """
+
+    def __init__(self, conf=None):
+        from spark_rapids_tpu import conf as C
+        self._cv = threading.Condition()
+        self._conf = conf
+        if conf is not None:
+            self.max_concurrent = int(conf.get(C.SCHED_MAX_CONCURRENT))
+            self.max_queued = int(conf.get(C.SCHED_MAX_QUEUED))
+            self.shed_queue_depth = int(conf.get(C.SCHED_SHED_QUEUE_DEPTH))
+            self.shed_spill_ratio = float(conf.get(C.SCHED_SHED_SPILL_RATIO))
+            self.shed_sem_saturation = float(
+                conf.get(C.SCHED_SHED_SEM_SATURATION))
+            self._default_weight = float(conf.get(C.SCHED_TENANT_WEIGHT))
+            self._default_in_flight = int(
+                conf.get(C.SCHED_TENANT_MAX_IN_FLIGHT))
+            self._default_queued = int(conf.get(C.SCHED_TENANT_MAX_QUEUED))
+            self._default_hbm_share = float(
+                conf.get(C.SCHED_TENANT_HBM_SHARE))
+        else:
+            self.max_concurrent = C.SCHED_MAX_CONCURRENT.default
+            self.max_queued = C.SCHED_MAX_QUEUED.default
+            self.shed_queue_depth = C.SCHED_SHED_QUEUE_DEPTH.default
+            self.shed_spill_ratio = C.SCHED_SHED_SPILL_RATIO.default
+            self.shed_sem_saturation = C.SCHED_SHED_SEM_SATURATION.default
+            self._default_weight = C.SCHED_TENANT_WEIGHT.default
+            self._default_in_flight = C.SCHED_TENANT_MAX_IN_FLIGHT.default
+            self._default_queued = C.SCHED_TENANT_MAX_QUEUED.default
+            self._default_hbm_share = C.SCHED_TENANT_HBM_SHARE.default
+        self._tenants: Dict[str, TenantState] = {}
+        self._rr_order: deque = deque()  # round-robin tie-break rotation
+        self._tickets: Dict[int, Ticket] = {}
+        self.queued_total = 0
+        self.running_total = 0
+
+    # -- tenants -----------------------------------------------------------
+
+    def _tenant_override(self, name: str, suffix: str, default):
+        if self._conf is None:
+            return default
+        raw = self._conf.get_raw(f"{_TENANT_PREFIX}{name}.{suffix}")
+        if raw is None:
+            return default
+        try:
+            return type(default)(raw)
+        except (TypeError, ValueError):
+            raise QueryRejected(
+                "bad_tenant_conf", tenant=name,
+                detail=f"{_TENANT_PREFIX}{name}.{suffix}={raw!r} is not "
+                       f"a valid {type(default).__name__}")
+
+    def _tenant_locked(self, name: str) -> TenantState:
+        t = self._tenants.get(name)
+        if t is None:
+            t = TenantState(
+                name,
+                weight=self._tenant_override(
+                    name, "weight", self._default_weight),
+                max_in_flight=self._tenant_override(
+                    name, "maxInFlight", self._default_in_flight),
+                max_queued=self._tenant_override(
+                    name, "maxQueued", self._default_queued),
+                hbm_share=self._tenant_override(
+                    name, "hbmShare", self._default_hbm_share),
+                max_concurrent=self.max_concurrent)
+            self._tenants[name] = t
+            self._rr_order.append(name)
+        return t
+
+    # -- admission ---------------------------------------------------------
+
+    def _shed_reason(self) -> Optional[tuple]:
+        """(reason, detail) if a service-wide watermark is breached.
+        Reads live pressure signals; never creates runtime state."""
+        depth = self.queued_total + self.running_total
+        if depth >= self.shed_queue_depth:
+            return ("shed_queue_depth",
+                    f"{depth} queued+running >= shed.queueDepth="
+                    f"{self.shed_queue_depth}")
+        from spark_rapids_tpu.runtime import memory
+        mgr = memory.peek_manager()
+        if mgr is not None:
+            pressure = mgr.spill_pressure()
+            if pressure >= self.shed_spill_ratio:
+                return ("shed_spill_pressure",
+                        f"host spill tier {pressure:.2f} full >= "
+                        f"shed.spillRatio={self.shed_spill_ratio} — "
+                        "shedding before the disk tier thrashes")
+        sem = peek_semaphore()
+        if sem is not None and sem.permits > 0:
+            saturation = (sem.holders + sem.waiting) / sem.permits
+            if saturation >= self.shed_sem_saturation:
+                return ("shed_semaphore_saturation",
+                        f"(holders+waiting)/permits={saturation:.2f} >= "
+                        "shed.semaphoreSaturation="
+                        f"{self.shed_sem_saturation}")
+        return None
+
+    def submit(self, query_id: int, tenant: str = "default",
+               priority: int = 0, token=None) -> Ticket:
+        """Admit or reject one submission.  Returns a QUEUED ``Ticket``
+        (pass it to ``acquire`` from the thread that will run the
+        query) or raises ``QueryRejected(reason=...)``.  Never blocks
+        beyond the scheduler lock."""
+        shed = None
+        reason = None
+        detail = ""
+        ticket = None
+        with self._cv:
+            t = self._tenant_locked(tenant)
+            shed = self._shed_reason()
+            if shed is not None:
+                reason, detail = shed
+                t.shed += 1
+                t.rejected += 1
+            elif t.queued >= t.max_queued:
+                reason = "tenant_queue_full"
+                detail = (f"{t.queued} queued >= tenant maxQueued="
+                          f"{t.max_queued}")
+                t.rejected += 1
+            elif self.queued_total >= self.max_queued:
+                reason = "queue_full"
+                detail = (f"{self.queued_total} queued >= "
+                          f"maxQueuedQueries={self.max_queued}")
+                t.rejected += 1
+            else:
+                ticket = Ticket(query_id, tenant, int(priority), token)
+                t.lanes.setdefault(ticket.priority,
+                                   deque()).append(ticket)
+                t.queued += 1
+                t.submitted += 1
+                self.queued_total += 1
+                self._tickets[query_id] = ticket
+                self._dispatch_locked()
+        if reason is not None:
+            _TM_REJECTED.inc(reason)
+            if reason in SHED_REASONS:
+                _TM_SHED.inc(tenant)
+                TM.REGISTRY.record_health({
+                    "severity": "WARN", "check": "admission_shed",
+                    "value": 1, "threshold": 0, "query_id": query_id,
+                    "detail": f"tenant={tenant} {detail}"})
+            raise QueryRejected(reason, tenant=tenant, detail=detail)
+        _TM_SUBMITTED.inc(tenant)
+        return ticket
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch_locked(self) -> None:
+        """Grant free run slots to queued tickets, fairest-first.
+        Tickets flip to RUNNING here (the grant is the state change —
+        the acquiring thread merely observes it), so a grant holds even
+        if the acquirer is slow to wake."""
+        granted = False
+        while (self.running_total < self.max_concurrent
+               and self.queued_total > 0):
+            ticket = self._next_ticket_locked()
+            if ticket is None:
+                break
+            t = self._tenants[ticket.tenant]
+            t.queued -= 1
+            t.running += 1
+            self.queued_total -= 1
+            self.running_total += 1
+            ticket.state = RUNNING
+            ticket.granted_at = time.monotonic()
+            granted = True
+        if granted:
+            self._cv.notify_all()
+
+    def _next_ticket_locked(self) -> Optional[Ticket]:
+        """Deficit weighted round-robin: each full pass over backlogged
+        tenants without a grant refills every backlogged tenant's
+        deficit by its weight; a grant costs 1.0.  Weight >= 0.01, so
+        at most ~100 refill rounds reach a grant — the loop is bounded,
+        not heuristic."""
+        if not any(t.backlogged() for t in self._tenants.values()):
+            return None
+        for _round in range(102):
+            for _ in range(len(self._rr_order)):
+                name = self._rr_order[0]
+                self._rr_order.rotate(-1)
+                t = self._tenants[name]
+                if t.backlogged() and t.deficit >= 1.0:
+                    t.deficit -= 1.0
+                    return t.pop_ticket()
+            for t in self._tenants.values():
+                if t.backlogged():
+                    t.deficit += t.weight
+                else:
+                    # an idle tenant must not bank unbounded credit and
+                    # later monopolize the device in a burst
+                    t.deficit = min(t.deficit, t.weight)
+        return None
+
+    # -- the worker side ---------------------------------------------------
+
+    def acquire(self, ticket: Ticket) -> float:
+        """Block the calling (worker) thread until the ticket is
+        granted a run slot; returns seconds spent queued.  The wait is
+        cancellable and deadline-aware via the ticket's ``CancelToken``
+        — cancel/expiry while still QUEUED raises ``QueryCancelled``
+        within ~one poll interval, removes the ticket from its lane,
+        and counts ``tpuq_scheduler_cancelled_queued_total``."""
+        tok = ticket.token
+        registered = False
+        try:
+            with self._cv:
+                try:
+                    while ticket.state == QUEUED:
+                        if tok is not None:
+                            tok.check()
+                            if not registered:
+                                tok.add_waiter(self._cv)
+                                registered = True
+                            timeout = tok.wait_interval()
+                        else:
+                            timeout = 0.1
+                        self._cv.wait(timeout=timeout)
+                except BaseException:
+                    if ticket.state == QUEUED:
+                        self._remove_queued_locked(ticket)
+                    raise
+        finally:
+            if registered:
+                tok.remove_waiter(self._cv)
+        waited = (ticket.granted_at or time.monotonic()) \
+            - ticket.submitted_at
+        _TM_QUEUE_WAIT.observe(max(0.0, waited))
+        return max(0.0, waited)
+
+    def _remove_queued_locked(self, ticket: Ticket) -> None:
+        t = self._tenants.get(ticket.tenant)
+        if t is not None and t.remove_ticket(ticket):
+            t.queued -= 1
+            t.cancelled_queued += 1
+            self.queued_total -= 1
+            ticket.state = CANCELLED
+            self._tickets.pop(ticket.query_id, None)
+            _TM_CANCELLED_QUEUED.inc()
+
+    def release(self, ticket: Ticket) -> None:
+        """Return the run slot (worker's ``finally``).  Idempotent for
+        tickets that never ran (cancelled while queued)."""
+        completed = False
+        with self._cv:
+            if ticket.state == RUNNING:
+                ticket.state = DONE
+                t = self._tenants[ticket.tenant]
+                t.running -= 1
+                t.completed += 1
+                self.running_total -= 1
+                self._tickets.pop(ticket.query_id, None)
+                completed = True
+                self._dispatch_locked()
+                self._cv.notify_all()
+            elif ticket.state == QUEUED:
+                # worker bailed without acquire() ever raising
+                self._remove_queued_locked(ticket)
+                self._cv.notify_all()
+        if completed:
+            _TM_COMPLETED.inc(ticket.tenant)
+
+    # -- introspection -----------------------------------------------------
+
+    def active_queries(self, tenant: Optional[str] = None) -> List[int]:
+        """Query ids currently queued or running, optionally filtered
+        by tenant, oldest submission first."""
+        with self._cv:
+            tickets = [k for k in self._tickets.values()
+                       if tenant is None or k.tenant == tenant]
+        tickets.sort(key=lambda k: k.submitted_at)
+        return [k.query_id for k in tickets]
+
+    def ticket_state(self, query_id: int) -> Optional[str]:
+        with self._cv:
+            ticket = self._tickets.get(query_id)
+            return ticket.state if ticket is not None else None
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-tenant accounting snapshot — the bench driver records
+        this (shed/reject counts per tenant) into every
+        TPCH_SF1_CONCURRENCY record."""
+        with self._cv:
+            return {name: {"weight": t.weight,
+                           "run_cap": t.run_cap,
+                           "running": t.running,
+                           "queued": t.queued,
+                           "submitted": t.submitted,
+                           "completed": t.completed,
+                           "rejected": t.rejected,
+                           "shed": t.shed,
+                           "cancelled_queued": t.cancelled_queued}
+                    for name, t in self._tenants.items()}
+
+
+# -- process singleton (mirrors semaphore.py) ------------------------------
+
+_scheduler: Optional[QueryScheduler] = None
+_sched_lock = threading.Lock()
+
+
+def get_scheduler(conf=None) -> QueryScheduler:
+    """The process scheduler, created on first use.  A later conf only
+    re-tunes the service-wide limits/watermarks in place (existing
+    tenants keep the quotas they were created with — tenant state must
+    not reset under live queries)."""
+    from spark_rapids_tpu import conf as C
+    global _scheduler
+    with _sched_lock:
+        if _scheduler is None:
+            _scheduler = QueryScheduler(conf)
+        elif conf is not None:
+            s = _scheduler
+            with s._cv:
+                s._conf = conf
+                s.max_concurrent = int(conf.get(C.SCHED_MAX_CONCURRENT))
+                s.max_queued = int(conf.get(C.SCHED_MAX_QUEUED))
+                s.shed_queue_depth = int(
+                    conf.get(C.SCHED_SHED_QUEUE_DEPTH))
+                s.shed_spill_ratio = float(
+                    conf.get(C.SCHED_SHED_SPILL_RATIO))
+                s.shed_sem_saturation = float(
+                    conf.get(C.SCHED_SHED_SEM_SATURATION))
+                s._default_weight = float(conf.get(C.SCHED_TENANT_WEIGHT))
+                s._default_in_flight = int(
+                    conf.get(C.SCHED_TENANT_MAX_IN_FLIGHT))
+                s._default_queued = int(
+                    conf.get(C.SCHED_TENANT_MAX_QUEUED))
+                s._default_hbm_share = float(
+                    conf.get(C.SCHED_TENANT_HBM_SHARE))
+                s._dispatch_locked()
+                s._cv.notify_all()
+        return _scheduler
+
+
+def peek_scheduler() -> Optional[QueryScheduler]:
+    """The process scheduler if one exists — never creates (telemetry
+    and session introspection must not instantiate runtime state)."""
+    return _scheduler
+
+
+def reset_scheduler() -> None:
+    global _scheduler
+    with _sched_lock:
+        _scheduler = None
+
+
+@contextlib.contextmanager
+def device_hold(conf=None, waited_out: Optional[list] = None):
+    """THE sanctioned ``DeviceSemaphore`` acquisition path.  Every
+    device-admission hold in the engine goes through here so admission
+    control, saturation accounting, and the scheduler's pressure
+    signals all see the same traffic; the ``scheduler-bypass`` lint
+    rule fails any other module that calls ``get_semaphore``."""
+    sem = get_semaphore(conf)
+    with sem.hold(waited_out=waited_out):
+        yield sem
+
+
+TM.REGISTRY.gauge(
+    "tpuq_scheduler_queue_depth",
+    "queries currently waiting for a run slot, all tenants",
+    fn=lambda: _scheduler.queued_total if _scheduler is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_scheduler_running",
+    "queries currently holding a run slot, all tenants",
+    fn=lambda: _scheduler.running_total if _scheduler is not None else 0)

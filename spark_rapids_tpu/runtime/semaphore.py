@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+from collections import OrderedDict
 from typing import Optional
 
 from spark_rapids_tpu.runtime import telemetry as TM
@@ -34,20 +35,32 @@ _TM_ACQUIRE = TM.REGISTRY.histogram(
 class DeviceSemaphore:
     """Counting semaphore with in-place resize + wait accounting.
 
-    ``max_holders``/``wait_time`` are *query-window* stats — the query
-    boundary (``telemetry.begin_query``) calls ``reset_query_stats`` so
-    one query's report never bleeds into the next.  The registry's
-    ``tpuq_semaphore_*`` counters and the ``peak_holders`` attribute
-    keep the process-lifetime view.
+    Per-query stats are keyed **by query id** (``begin_query_stats`` /
+    ``end_query_stats``): each open window tracks the high-water holder
+    count observed while that query was in flight and the wait time its
+    OWN tasks spent blocked (attributed via the acquiring thread's
+    CancelToken), so overlapping queries no longer bleed stats into
+    each other.  The legacy ``max_holders``/``wait_time`` attributes
+    remain the serial-query view — reset at each query boundary, last
+    boundary wins — for callers that predate concurrent execution.  The
+    registry's ``tpuq_semaphore_*`` counters and the ``peak_holders``
+    attribute keep the process-lifetime view.
     """
+
+    # open per-query windows beyond this evict oldest-first (a window
+    # whose query died without end_query_stats must not leak forever)
+    QUERY_WINDOW_CAP = 256
 
     def __init__(self, permits: int):
         self._cv = threading.Condition()
         self.permits = max(1, int(permits))
         self.holders = 0          # currently admitted tasks
+        self.waiting = 0          # tasks currently blocked in acquire
         self.max_holders = 0      # high-water mark (query window)
         self.wait_time = 0.0      # seconds blocked (query window)
         self.peak_holders = 0     # high-water mark (process lifetime)
+        # query_id -> {"max_holders": int, "wait_time": float}
+        self._windows: "OrderedDict[int, dict]" = OrderedDict()
 
     def resize(self, permits: int) -> None:
         with self._cv:
@@ -71,27 +84,42 @@ class DeviceSemaphore:
         waited = 0.0
         tok = cancel.current()
         registered = False
+        blocked = False
         try:
             with self._cv:
-                while self.holders >= self.permits:
-                    if tok is not None:
-                        tok.check()
-                        if not registered:
-                            tok.add_waiter(self._cv)
-                            registered = True
-                        timeout = tok.wait_interval()
-                    else:
-                        # bounded even without a token: a token opened
-                        # by a later query must never find this thread
-                        # parked in an unbounded wait
-                        timeout = 0.1
-                    t0 = time.monotonic()
-                    self._cv.wait(timeout=timeout)
-                    waited += time.monotonic() - t0
+                try:
+                    while self.holders >= self.permits:
+                        if not blocked:
+                            blocked = True
+                            self.waiting += 1
+                        if tok is not None:
+                            tok.check()
+                            if not registered:
+                                tok.add_waiter(self._cv)
+                                registered = True
+                            timeout = tok.wait_interval()
+                        else:
+                            # bounded even without a token: a token
+                            # opened by a later query must never find
+                            # this thread parked in an unbounded wait
+                            timeout = 0.1
+                        t0 = time.monotonic()
+                        self._cv.wait(timeout=timeout)
+                        waited += time.monotonic() - t0
+                finally:
+                    if blocked:
+                        self.waiting -= 1
                 self.holders += 1
                 self.max_holders = max(self.max_holders, self.holders)
                 self.peak_holders = max(self.peak_holders, self.holders)
                 self.wait_time += waited
+                for w in self._windows.values():
+                    if self.holders > w["max_holders"]:
+                        w["max_holders"] = self.holders
+                if waited and tok is not None and tok.query_id is not None:
+                    w = self._windows.get(tok.query_id)
+                    if w is not None:
+                        w["wait_time"] += waited
         finally:
             if registered:
                 tok.remove_waiter(self._cv)
@@ -100,12 +128,38 @@ class DeviceSemaphore:
             _TM_ACQUIRE.observe(waited)
         return waited
 
-    def reset_query_stats(self) -> None:
-        """New query window: the high-water mark restarts from the
-        holders still admitted, the wait clock from zero."""
+    def begin_query_stats(self, query_id: Optional[int]) -> None:
+        """Open a per-query stats window keyed by ``query_id`` AND
+        restart the legacy serial-query window (``max_holders`` /
+        ``wait_time``): the high-water mark restarts from the holders
+        still admitted, the wait clock from zero."""
         with self._cv:
             self.max_holders = self.holders
             self.wait_time = 0.0
+            if query_id is not None:
+                self._windows[query_id] = {"max_holders": self.holders,
+                                           "wait_time": 0.0}
+                while len(self._windows) > self.QUERY_WINDOW_CAP:
+                    self._windows.popitem(last=False)
+
+    def end_query_stats(self, query_id: Optional[int]) -> Optional[dict]:
+        """Close a keyed window and return its stats (None when no
+        window is open for that id)."""
+        if query_id is None:
+            return None
+        with self._cv:
+            return self._windows.pop(query_id, None)
+
+    def query_stats(self, query_id: int) -> Optional[dict]:
+        """Peek an open keyed window without closing it."""
+        with self._cv:
+            w = self._windows.get(query_id)
+            return dict(w) if w is not None else None
+
+    def reset_query_stats(self) -> None:
+        """Legacy (serial-query) boundary: restart the un-keyed window
+        only."""
+        self.begin_query_stats(None)
 
     def release(self) -> None:
         with self._cv:
@@ -159,6 +213,11 @@ def reset_semaphore() -> None:
 TM.REGISTRY.gauge(
     "tpuq_semaphore_holders", "tasks currently holding a permit",
     fn=lambda: _semaphore.holders if _semaphore is not None else 0)
+TM.REGISTRY.gauge(
+    "tpuq_semaphore_waiting",
+    "tasks currently blocked waiting for a permit (the admission "
+    "controller's saturation signal)",
+    fn=lambda: _semaphore.waiting if _semaphore is not None else 0)
 TM.REGISTRY.gauge(
     "tpuq_semaphore_holders_peak",
     "process-lifetime peak concurrent holders",

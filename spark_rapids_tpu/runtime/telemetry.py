@@ -361,6 +361,7 @@ def ensure_producers() -> None:
     would otherwise miss the shuffle family)."""
     import importlib
     for mod in ("runtime.cancel", "runtime.memory", "runtime.semaphore",
+                "runtime.scheduler",
                 "runtime.kernel_cache", "runtime.resilience",
                 "runtime.lockdep",
                 "shuffle.manager", "shuffle.exchange",
@@ -496,14 +497,18 @@ class QueryWindow:
 
 
 def begin_query(query_id: int) -> QueryWindow:
-    """Open a telemetry window and reset the semaphore's per-query
-    stats (``max_holders``/``wait_time`` report THIS query, not the
-    process lifetime — the registry keeps the cumulative view)."""
+    """Open a telemetry window and a semaphore stats window KEYED by
+    this query id (overlapping queries each get their own
+    ``max_holders``/``wait_time`` — the registry keeps the cumulative
+    view).  Re-entrant under concurrency: every piece of per-query
+    state this boundary touches is either per-``QueryWindow`` instance
+    or keyed by ``query_id``; the only process-wide effect is the
+    legacy serial-query semaphore window, which keyed readers ignore."""
     _QUERIES.inc()
     from spark_rapids_tpu.runtime import semaphore as SEM
     sem = SEM.peek_semaphore()
     if sem is not None:
-        sem.reset_query_stats()
+        sem.begin_query_stats(query_id)
     return QueryWindow(query_id)
 
 
@@ -553,6 +558,13 @@ def evaluate_health(deltas: Dict[str, float], elapsed_s: float, conf,
              f"{degraded} device step(s) re-ran on the host path after "
              "retry exhaustion tripped a circuit breaker — see "
              "docs/resilience.md")
+    shed = sum(v for name, v in deltas.items()
+               if name.startswith("tpuq_admission_shed_total"))
+    if shed:
+        warn("admission_shed", shed, 0,
+             f"{shed} submission(s) were load-shed by admission control "
+             "while this query ran — the service is saturated; see "
+             "docs/serving.md for the watermark tuning guide")
     for e in events:
         _HEALTH_WARNS.inc()
         REGISTRY.record_health(e)

@@ -780,7 +780,9 @@ class DataFrame:
             res = self._last_override
         return res.fallback_summary()
 
-    def toArrow(self, timeout_ms: Optional[float] = None) -> pa.Table:
+    def toArrow(self, timeout_ms: Optional[float] = None,
+                query_id: Optional[int] = None,
+                cancel_token=None) -> pa.Table:
         """Execute and return the result as an Arrow table.
 
         ``timeout_ms`` puts an in-process deadline on THIS execution
@@ -788,7 +790,13 @@ class DataFrame:
         expires, every blocking boundary raises
         ``QueryCancelled(reason="deadline")`` and the engine reclaims
         the query's resources before the exception reaches the
-        caller."""
+        caller.
+
+        ``query_id``/``cancel_token`` are the ``QueryServer``'s
+        plumbing: the server mints the id and registers the token at
+        *submit* time (so the query is cancellable while still queued
+        for a run slot), then the admitted worker passes both here and
+        the execution adopts them instead of minting fresh ones."""
         import contextlib
         from spark_rapids_tpu import conf as C
         from spark_rapids_tpu.runtime import cancel as cancel_mod
@@ -798,11 +806,12 @@ class DataFrame:
         conf = self.session.rapids_conf()
         plan = self._execute_plan()
         self._last_plan = plan
-        qid = trace.next_query_id()
+        qid = query_id if query_id is not None else trace.next_query_id()
         qwin = telemetry.begin_query(qid)
         from spark_rapids_tpu.runtime import resilience
         rwin = resilience.begin_query(qid)
-        cwin = cancel_mod.begin_query(qid, conf, timeout_ms=timeout_ms)
+        cwin = cancel_mod.begin_query(qid, conf, timeout_ms=timeout_ms,
+                                      token=cancel_token)
         tracer = None
         if conf.get(C.TRACE_ENABLED):
             tracer = trace.start_query(
@@ -920,6 +929,18 @@ class DataFrame:
                                                query_id=qid)
             if health:
                 entry["health"] = health
+        from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+        sem = peek_semaphore()
+        if sem is not None:
+            # close THIS query's keyed stats window (opened by
+            # telemetry.begin_query) — under concurrency the legacy
+            # process-wide max_holders/wait_time bleed across queries,
+            # the keyed window doesn't
+            sw = sem.end_query_stats(qid)
+            if sw is not None:
+                entry["semaphore"] = {
+                    "max_holders": sw["max_holders"],
+                    "wait_s": round(sw["wait_time"], 6)}
         if rwin is not None:
             # retry/breaker/degradation rollup for the query's failure
             # domains (see runtime/resilience.py)
@@ -1026,8 +1047,9 @@ class DataFrame:
                 out.extend(pump(p))
             return out
 
-        from spark_rapids_tpu.runtime.semaphore import get_semaphore
-        sem = get_semaphore(conf)
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import cancel as cancel_mod
+        from spark_rapids_tpu.runtime import scheduler as sched_mod
         waits: List[float] = []  # this query's waits only
 
         parts = list(range(nparts))
@@ -1047,19 +1069,27 @@ class DataFrame:
                 if isinstance(node, TpuIciShuffleExchangeExec):
                     node._materialize()
 
-            with sem.hold(waited_out=waits):
+            with sched_mod.device_hold(conf, waited_out=waits):
                 pre_materialize(plan)
             parts = owned_partitions(plan)
 
+        # the query's cancel scope is thread-local — capture the token
+        # here (the query thread) and re-bind it inside each pump-pool
+        # worker so device admission stays cancellable and wait time
+        # attributes to the right query under concurrency
+        tok = cancel_mod.current()
+
         def task(p: int) -> List[pa.Table]:
-            with sem.hold(waited_out=waits):
+            with cancel_mod.bind(tok), \
+                    sched_mod.device_hold(conf, waited_out=waits):
                 return pump(p)
 
         # a single task still holds a permit — a 1-partition query must
         # count against the concurrency cap like any other; the pump
         # pool records queue depth + per-task latency either way
         from spark_rapids_tpu.parallel.executor import run_pump_tasks
-        workers = min(len(parts), max(sem.permits * 2, 4))
+        permits = int(conf.get(C.CONCURRENT_TASKS) or 2)
+        workers = min(len(parts), max(permits * 2, 4))
         chunks = run_pump_tasks(task, parts, max_workers=workers)
         plan.metric("semaphoreWaitTime").add(sum(waits))
         return [t for chunk in chunks for t in chunk]

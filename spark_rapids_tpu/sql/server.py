@@ -1,0 +1,222 @@
+"""QueryServer — the multi-tenant serving front door over a session.
+
+``TpuSession`` executes one query per caller thread; the server turns
+that into a *service*: many concurrent ``submit`` calls across named
+tenants, each admission-checked and fairness-scheduled by
+``runtime/scheduler.py`` before it may touch the device.  The flow per
+submission:
+
+1. ``submit`` mints the query id and its ``CancelToken`` (deadline
+   ticking from SUBMIT time — queue time counts against it) and
+   registers the token, so ``session.cancel(qid)`` and per-tenant
+   ``active_queries`` work while the query is still QUEUED.
+2. The scheduler admits (or raises ``QueryRejected(reason=...)`` —
+   quota breach or load shed; nothing was started, retry/back off).
+3. A worker thread blocks in ``scheduler.acquire`` until the fairness
+   dispatcher grants a run slot, then runs ``DataFrame.toArrow`` which
+   adopts the server's query id and token.
+4. ``poll``/``result`` observe completion; ``release`` in the worker's
+   ``finally`` hands the slot to the next waiter no matter how the
+   query ended.
+
+See docs/serving.md for the admission-state walkthrough and tuning
+guide.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+from spark_rapids_tpu.runtime.scheduler import (  # re-exported API
+    QueryRejected, get_scheduler, peek_scheduler)
+
+#: handle states reported by ``poll``
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+OK = "OK"
+CANCELLED = "CANCELLED"
+ERROR = "ERROR"
+
+
+class QueryHandle:
+    """One submission's future.  ``done`` is set exactly once, after
+    the run slot has been released and the token unregistered — a
+    ``result()`` returner can immediately submit a follow-up without
+    racing the slot it just freed."""
+
+    __slots__ = ("query_id", "tenant", "priority", "token", "ticket",
+                 "done", "result", "error", "state", "submitted_at",
+                 "queue_wait_s", "wall_s")
+
+    def __init__(self, query_id: int, tenant: str, priority: int,
+                 token, ticket):
+        self.query_id = query_id
+        self.tenant = tenant
+        self.priority = priority
+        self.token = token
+        self.ticket = ticket
+        self.done = threading.Event()
+        self.result = None
+        self.error: Optional[BaseException] = None
+        self.state = QUEUED
+        self.submitted_at = time.monotonic()
+        self.queue_wait_s: Optional[float] = None
+        self.wall_s: Optional[float] = None
+
+
+class QueryServer:
+    """Accepts concurrent query submissions for one ``TpuSession``.
+
+    A submission is either a ``DataFrame`` or a zero-arg callable
+    returning one.  Prefer the callable for concurrent load: it is
+    invoked on the admitted worker thread, so plan construction happens
+    per-execution and per-DataFrame caches (``_last_plan`` etc.) are
+    not raced by overlapping runs of the SAME DataFrame object.
+    """
+
+    def __init__(self, session):
+        self.session = session
+        self._lock = threading.Lock()
+        self._handles: Dict[int, QueryHandle] = {}
+        self._threads: List[threading.Thread] = []
+        self._closed = False
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, query: Union[Callable, object],
+               tenant: str = "default", priority: int = 0,
+               timeout_ms: Optional[float] = None) -> QueryHandle:
+        """Admit one query for ``tenant``.  Returns a ``QueryHandle``
+        immediately (the query is queued or already running) or raises
+        ``QueryRejected(reason=...)`` without side effects.  Higher
+        ``priority`` drains first within the tenant; ``timeout_ms``
+        deadlines the query from NOW — time spent queued counts, so a
+        deadline can expire a query that was never admitted."""
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import cancel
+        from spark_rapids_tpu.runtime import trace
+        with self._lock:
+            if self._closed:
+                raise QueryRejected("server_shutdown", tenant=tenant,
+                                    detail="QueryServer.shutdown() ran")
+        conf = self.session.rapids_conf()
+        qid = trace.next_query_id()
+        eff = (timeout_ms if timeout_ms is not None
+               else float(conf.get(C.QUERY_TIMEOUT_MS)))
+        if eff is not None and eff <= 0:
+            eff = None
+        token = cancel.CancelToken(
+            qid, timeout_ms=eff,
+            poll_ms=float(conf.get(C.CANCEL_POLL_MS)))
+        cancel.register(token)
+        sched = get_scheduler(conf)
+        try:
+            ticket = sched.submit(qid, tenant=tenant, priority=priority,
+                                  token=token)
+        except BaseException:
+            cancel.unregister(token)
+            raise
+        handle = QueryHandle(qid, tenant, priority, token, ticket)
+        with self._lock:
+            self._handles[qid] = handle
+        worker = threading.Thread(target=self._run, args=(handle, query),
+                                  name=f"tpuq-serve-{qid}", daemon=True)
+        with self._lock:
+            self._threads.append(worker)
+            self._threads = [t for t in self._threads if t.is_alive()
+                             or t is worker]
+        worker.start()
+        return handle
+
+    def _run(self, handle: QueryHandle, query) -> None:
+        from spark_rapids_tpu.runtime import cancel
+        sched = peek_scheduler()
+        t0 = time.monotonic()
+        try:
+            handle.queue_wait_s = sched.acquire(handle.ticket)
+            handle.state = RUNNING
+            df = query() if callable(query) else query
+            handle.result = df.toArrow(query_id=handle.query_id,
+                                       cancel_token=handle.token)
+            handle.state = OK
+        except cancel.QueryCancelled as e:
+            handle.error = e
+            handle.state = CANCELLED
+        except BaseException as e:
+            handle.error = e
+            handle.state = ERROR
+        finally:
+            handle.wall_s = time.monotonic() - t0
+            sched.release(handle.ticket)
+            cancel.unregister(handle.token)
+            with self._lock:
+                self._handles.pop(handle.query_id, None)
+            handle.done.set()
+
+    # -- observation -------------------------------------------------------
+
+    def poll(self, handle: QueryHandle) -> dict:
+        """Non-blocking status snapshot."""
+        return {"query_id": handle.query_id,
+                "tenant": handle.tenant,
+                "state": handle.state,
+                "done": handle.done.is_set(),
+                "queue_wait_s": handle.queue_wait_s,
+                "wall_s": handle.wall_s}
+
+    def result(self, handle: QueryHandle,
+               timeout_s: Optional[float] = None):
+        """Block until the query finishes and return its Arrow table;
+        re-raises the query's ``QueryCancelled``/error.  ``timeout_s``
+        bounds the wait (``TimeoutError``) without affecting the query
+        itself."""
+        if not handle.done.wait(timeout=timeout_s):
+            raise TimeoutError(
+                f"query {handle.query_id} still {handle.state} after "
+                f"{timeout_s}s")
+        if handle.error is not None:
+            raise handle.error
+        return handle.result
+
+    def cancel(self, query_id: int, reason: str = "user") -> bool:
+        """Cancel a submitted query — queued or running.  A queued
+        query surfaces ``QueryCancelled`` within ~one poll interval
+        WITHOUT ever being admitted; its queue entry is removed and the
+        dispatcher moves on."""
+        from spark_rapids_tpu.runtime import cancel
+        return cancel.cancel_query(query_id, reason=reason)
+
+    def active_queries(self, tenant: Optional[str] = None) -> List[int]:
+        """Queued + running query ids, optionally one tenant's."""
+        sched = peek_scheduler()
+        if sched is None:
+            return []
+        return sched.active_queries(tenant)
+
+    def stats(self) -> Dict[str, dict]:
+        """Per-tenant scheduler accounting (see
+        ``QueryScheduler.stats``)."""
+        sched = peek_scheduler()
+        return sched.stats() if sched is not None else {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def shutdown(self, timeout_s: float = 30.0,
+                 cancel_pending: bool = True) -> None:
+        """Stop accepting submissions; optionally cancel everything
+        outstanding; join workers.  Idempotent."""
+        with self._lock:
+            self._closed = True
+            handles = list(self._handles.values())
+            threads = list(self._threads)
+            self._threads = []
+        if cancel_pending:
+            from spark_rapids_tpu.runtime import cancel
+            for h in handles:
+                cancel.cancel_query(h.query_id, reason="user",
+                                    detail="server shutdown")
+        deadline = time.monotonic() + timeout_s
+        for t in threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))

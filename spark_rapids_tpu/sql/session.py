@@ -184,9 +184,18 @@ class TpuSession:
         return getattr(self, "_last_profile", None)
 
     # -- query lifecycle ----------------------------------------------------
-    def active_queries(self) -> List[int]:
-        """Ids of queries currently executing (cancellable)."""
+    def active_queries(self, tenant: Optional[str] = None) -> List[int]:
+        """Ids of queries currently executing or queued (cancellable).
+        ``tenant`` filters to one tenant's queries via the scheduler
+        (queries submitted through a ``QueryServer``); without it,
+        every registered cancellable query is listed — including
+        server-submitted queries still waiting for a run slot, whose
+        tokens are registered at submit time."""
         from spark_rapids_tpu.runtime import cancel
+        if tenant is not None:
+            from spark_rapids_tpu.runtime import scheduler
+            sched = scheduler.peek_scheduler()
+            return sched.active_queries(tenant) if sched is not None else []
         return cancel.active_queries()
 
     def cancel(self, query_id: Optional[int] = None,

@@ -449,6 +449,134 @@ def assert_cancel_invariant(df_builder: Callable[[TpuSession], "object"],
     return rec
 
 
+def run_scheduler_chaos(n_queries: int = 24,
+                        tenants: Tuple[str, ...] = ("a", "b"),
+                        conf: Optional[Dict] = None,
+                        seed: int = 0,
+                        max_concurrent: int = 2,
+                        cancel_fraction: float = 0.25,
+                        inject: Optional[Dict[str, Tuple[int, int]]] = None,
+                        poll_ms: float = 20.0,
+                        timeout_s: float = 120.0) -> dict:
+    """Concurrency soak for the multi-tenant scheduler: blast
+    ``n_queries`` submissions round-robin across ``tenants`` through a
+    ``QueryServer`` (run-slot cap pinned low so the service saturates),
+    cancel a seed-randomized fraction mid-flight, optionally with chaos
+    faults armed (``inject`` uses the ``run_chaos`` schedule format —
+    transient budgets make queries ride faults out under load), and
+    drain everything.
+
+    Returns a record::
+
+        {"outcomes": {"ok": n, "cancelled": n, "error": n},
+         "errors":   [the non-cancel exceptions, if any],
+         "rejected": submissions QueryRejected at admission,
+         "stats":    per-tenant scheduler accounting (completions,
+                     shed/reject counts — what the bench records),
+         "leaks":    DeviceMemoryManager.report_leaks() afterwards,
+         "sem_holders": semaphore holders afterwards,
+         "queued", "running": scheduler totals afterwards (must be 0)}
+
+    Asserts the no-deadlock invariant itself: every admitted query
+    reaches ``done`` within ``timeout_s``.
+    """
+    from spark_rapids_tpu.runtime import cancel as CN
+    from spark_rapids_tpu.runtime import memory as M
+    from spark_rapids_tpu.runtime import resilience as R
+    from spark_rapids_tpu.runtime import scheduler as SCH
+    from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+    from spark_rapids_tpu.sql.server import QueryRejected, QueryServer
+
+    full: Dict = {
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": max_concurrent,
+        "spark.rapids.tpu.query.cancelPollMs": int(poll_ms),
+        "spark.rapids.tpu.retry.backoffBaseMs": 0,
+    }
+    full.update(conf or {})
+    for d, (at, budget) in (inject or {}).items():
+        full[f"spark.rapids.tpu.test.inject.{d}.at"] = at
+        full[f"spark.rapids.tpu.test.inject.{d}.transientCount"] = budget
+    R.INJECTOR.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    s = tpu_session(full)
+    server = QueryServer(s)
+    rnd = random.Random(seed)
+    handles = []
+    rejected = 0
+    for i in range(n_queries):
+        tenant = tenants[i % len(tenants)]
+        n = 512 + rnd.randint(0, 1536)
+
+        def build(n=n):
+            return s.range(n, numPartitions=2)
+
+        try:
+            handles.append(server.submit(
+                build, tenant=tenant, priority=rnd.choice((0, 0, 1))))
+        except QueryRejected:
+            rejected += 1
+    # cancel a random slice mid-flight (queued or running)
+    for h in rnd.sample(handles,
+                        k=int(len(handles) * cancel_fraction)):
+        server.cancel(h.query_id, reason="user")
+    outcomes = {"ok": 0, "cancelled": 0, "error": 0}
+    errors = []
+    for h in handles:
+        assert h.done.wait(timeout=timeout_s), (
+            f"scheduler chaos deadlock: query {h.query_id} "
+            f"({h.tenant}) still {h.state} after {timeout_s}s")
+        if h.state == "OK":
+            outcomes["ok"] += 1
+        elif h.state == "CANCELLED":
+            outcomes["cancelled"] += 1
+        else:
+            outcomes["error"] += 1
+            errors.append(h.error)
+    stats = server.stats()
+    sched = SCH.peek_scheduler()
+    server.shutdown()
+    R.INJECTOR.reset()
+    mgr = M.peek_manager()
+    sem = peek_semaphore()
+    return {
+        "outcomes": outcomes,
+        "errors": errors,
+        "rejected": rejected,
+        "stats": stats,
+        "leaks": mgr.report_leaks() if mgr is not None else 0,
+        "sem_holders": sem.holders if sem is not None else 0,
+        "queued": sched.queued_total if sched is not None else 0,
+        "running": sched.running_total if sched is not None else 0,
+    }
+
+
+def assert_fairness_invariant(stats: Dict[str, dict],
+                              min_share: float = 0.25) -> None:
+    """THE fairness invariant over a per-tenant scheduler ``stats``
+    snapshot: among tenants of EQUAL weight, nobody gets less than
+    ``min_share`` of its fair share of completions (fair share =
+    the group's completions / group size).  Weighted tenants are
+    compared only against peers of the same weight — a deliberately
+    light tenant draining slower is policy, not unfairness."""
+    groups: Dict[float, Dict[str, int]] = {}
+    for name, t in stats.items():
+        groups.setdefault(round(float(t["weight"]), 6), {})[name] = \
+            int(t["completed"])
+    for weight, members in groups.items():
+        if len(members) < 2:
+            continue
+        total = sum(members.values())
+        if total == 0:
+            continue
+        fair = total / len(members)
+        for name, completed in members.items():
+            assert completed >= min_share * fair, (
+                f"tenant {name!r} (weight {weight}) completed "
+                f"{completed} of a fair share of {fair:.1f} "
+                f"(< {min_share:.0%}) — {members}")
+
+
 def run_rendezvous_cancel_chaos(nprocs: int = 3,
                                 cancel_pid: int = 0,
                                 cancel_after_s: float = 0.2,

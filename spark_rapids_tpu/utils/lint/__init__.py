@@ -32,6 +32,12 @@ Rules (catalog in docs/static_analysis.md):
                       auto-wrapped one: no inheriting it from a
                       non-exec mixin, no module-level monkey-patching
                       past the stats/trace/cancel pump wrapper
+``scheduler-bypass``  ``get_semaphore`` calls / ``DeviceSemaphore``
+                      construction outside the scheduler's admission
+                      path (runtime/scheduler.py, runtime/semaphore.py)
+                      — device admission must flow through
+                      ``runtime.scheduler.device_hold`` so multi-tenant
+                      fairness and load shedding see all traffic
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -179,8 +185,11 @@ def all_rules() -> List[Rule]:
     from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
     from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
     from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
+    from spark_rapids_tpu.utils.lint.scheduler_bypass import (
+        SchedulerBypassRule)
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
-            HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule()]
+            HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
+            SchedulerBypassRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

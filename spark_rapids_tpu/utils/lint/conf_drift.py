@@ -27,7 +27,8 @@ from typing import Dict, Iterable, List, Set, Tuple
 from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
 
 DYNAMIC_PREFIXES = ("spark.rapids.sql.exec.",
-                    "spark.rapids.sql.expression.")
+                    "spark.rapids.sql.expression.",
+                    "spark.rapids.tpu.scheduler.tenant.")
 READ_CALLS = {"get", "get_raw"}
 
 

@@ -1,0 +1,68 @@
+"""scheduler-bypass rule: all device admission goes through the
+scheduler.
+
+The multi-tenant admission controller (runtime/scheduler.py) is only a
+real gate if nothing routes around it: an exec or IO path that grabs
+``get_semaphore`` and ``hold``s permits directly would consume device
+admission the fairness dispatcher and load-shed watermarks never saw.
+This rule fails any module outside the sanctioned set that
+
+- calls ``get_semaphore`` (the gateway to the process semaphore), or
+- instantiates ``DeviceSemaphore`` directly (a private semaphore
+  escapes the cap entirely).
+
+``peek_semaphore`` stays legal everywhere — observation (telemetry
+gauges, health probes, the admission controller's own saturation
+signal) must not require an exemption.  A deliberate bypass carries::
+
+    # lint: exempt(scheduler-bypass): <why>
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+# the admission path itself + the module that owns the semaphore
+ALLOWED = (
+    "spark_rapids_tpu/runtime/scheduler.py",
+    "spark_rapids_tpu/runtime/semaphore.py",
+)
+
+
+def _callee_name(func: ast.AST) -> str:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return ""
+
+
+class SchedulerBypassRule(Rule):
+    name = "scheduler-bypass"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        rel = mod.rel.replace("\\", "/")
+        if rel in ALLOWED:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _callee_name(node.func)
+            if callee == "get_semaphore":
+                yield Finding(
+                    self.name, mod.rel, node.lineno,
+                    "get_semaphore() outside the scheduler's admission "
+                    "path — acquire device admission via "
+                    "runtime.scheduler.device_hold so per-tenant "
+                    "fairness and load shedding see this traffic "
+                    "(peek_semaphore is fine for observation)")
+            elif callee == "DeviceSemaphore":
+                yield Finding(
+                    self.name, mod.rel, node.lineno,
+                    "direct DeviceSemaphore construction outside "
+                    "runtime/semaphore.py — a private semaphore "
+                    "escapes the process concurrency cap and the "
+                    "scheduler's admission control")

@@ -24,6 +24,7 @@ from spark_rapids_tpu.utils.lint.failure_domains import FailureDomainRule
 from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
 from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
 from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
+from spark_rapids_tpu.utils.lint.scheduler_bypass import SchedulerBypassRule
 
 
 def _mod(rel, src):
@@ -527,6 +528,53 @@ def test_op_stats_cross_module_resolution_and_exempt():
             pass
         """)
     assert _run([OpStatsRule()], mixin, exempted) == []
+
+
+# ---------------------------------------------------------------------------
+# scheduler-bypass
+# ---------------------------------------------------------------------------
+
+def test_scheduler_bypass_flags_get_semaphore_and_ctor():
+    m = _mod("spark_rapids_tpu/exec/fast_path.py", """
+        from spark_rapids_tpu.runtime.semaphore import (
+            DeviceSemaphore, get_semaphore)
+
+        def run(conf):
+            sem = get_semaphore(conf)
+            private = DeviceSemaphore(2)
+            return sem, private
+        """)
+    out = _run([SchedulerBypassRule()], m)
+    assert [f.rule for f in out] == ["scheduler-bypass"] * 2
+    assert "device_hold" in out[0].message
+    assert "private semaphore" in out[1].message
+
+
+def test_scheduler_bypass_peek_and_allowed_paths_clean():
+    observer = _mod("spark_rapids_tpu/runtime/telemetry2.py", """
+        from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+
+        def gauge():
+            sem = peek_semaphore()
+            return 0 if sem is None else sem.holders
+        """)
+    owner = _mod("spark_rapids_tpu/runtime/scheduler.py", """
+        from spark_rapids_tpu.runtime.semaphore import get_semaphore
+
+        def device_hold(conf):
+            return get_semaphore(conf)
+        """)
+    assert _run([SchedulerBypassRule()], observer, owner) == []
+
+
+def test_scheduler_bypass_exemption():
+    m = _mod("spark_rapids_tpu/exec/fast_path.py", """
+        from spark_rapids_tpu.runtime.semaphore import get_semaphore
+
+        # lint: exempt(scheduler-bypass): startup warmup, no tenants yet
+        sem = get_semaphore(None)
+        """)
+    assert _run([SchedulerBypassRule()], m) == []
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,439 @@
+"""Multi-tenant admission control + fair scheduling tests.
+
+Coverage map over runtime/scheduler.py and sql/server.py:
+
+* admission quotas — per-tenant ``maxQueued`` and the global
+  ``maxQueuedQueries`` reject with structured reasons; ``maxInFlight``
+  and the HBM share bound concurrency WITHOUT rejecting.
+* load shedding — each of the three watermarks (queue depth, host
+  spill-tier pressure, semaphore saturation) sheds with its own
+  ``QueryRejected.reason``, bumps the shed counter, records a health
+  WARN, and — the acceptance criterion — does so BEFORE the disk spill
+  tier moves a byte.
+* fair dispatch — weighted DWRR drain ratios, strict priority lanes
+  within a tenant, no starvation of equal-weight tenants.
+* cancellation × scheduler — cancel and deadline expiry landing while
+  a query is still QUEUED: prompt ``QueryCancelled``, never admitted,
+  queue entry removed, the vacated slot goes to the next waiter, zero
+  leaks.
+* the QueryServer end to end — concurrent submissions across tenants
+  with chaos armed, plus the seed-randomized soak (slow) asserting the
+  fairness invariant.
+"""
+
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.runtime import cancel as CN
+from spark_rapids_tpu.runtime import memory as M
+from spark_rapids_tpu.runtime import resilience as R
+from spark_rapids_tpu.runtime import scheduler as SCH
+from spark_rapids_tpu.runtime import semaphore as SEM
+from spark_rapids_tpu.runtime import telemetry as TM
+from spark_rapids_tpu.runtime.scheduler import (
+    QueryRejected, QueryScheduler)
+from spark_rapids_tpu.utils import harness as H
+
+pytestmark = pytest.mark.chaos
+
+POLL_MS = 50.0
+BOUND_S = 2.0 * POLL_MS / 1000.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_service_state():
+    """Scheduler, semaphore, memory manager, cancel scope, and injector
+    are process singletons — every test here starts and ends with none,
+    so one test's watermark state can't shed the next test's
+    submissions."""
+    R.INJECTOR.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    SEM.reset_semaphore()
+    M.reset_manager()
+    yield
+    R.INJECTOR.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    SEM.reset_semaphore()
+    M.reset_manager()
+
+
+def sched_conf(**over):
+    raw = {"spark.rapids.tpu.scheduler.maxConcurrentQueries": 1}
+    raw.update(over)
+    return RapidsConf(raw)
+
+
+def occupy(sched, qid=9000, tenant="default"):
+    """Submit one query that is immediately granted the free slot."""
+    ticket = sched.submit(qid, tenant=tenant)
+    assert ticket.state == SCH.RUNNING
+    return ticket
+
+
+def running_ticket(tickets):
+    live = [t for t in tickets if t.state == SCH.RUNNING]
+    assert len(live) == 1, [t.state for t in tickets]
+    return live[0]
+
+
+# ---------------------------------------------------------------------------
+# quotas
+# ---------------------------------------------------------------------------
+
+def test_tenant_max_queued_rejects_structured():
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.tenantMaxQueued": 2}))
+    occupy(sched)
+    sched.submit(9001)
+    sched.submit(9002)
+    with pytest.raises(QueryRejected) as ei:
+        sched.submit(9003)
+    assert ei.value.reason == "tenant_queue_full"
+    assert ei.value.tenant == "default"
+    st = sched.stats()["default"]
+    assert st["rejected"] == 1 and st["shed"] == 0
+    assert st["queued"] == 2 and st["running"] == 1
+
+
+def test_global_max_queued_rejects_across_tenants():
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.maxQueuedQueries": 2,
+        # per-tenant quota is NOT the binding constraint here
+        "spark.rapids.tpu.scheduler.tenantMaxQueued": 64}))
+    occupy(sched, tenant="a")
+    sched.submit(9001, tenant="a")
+    sched.submit(9002, tenant="b")
+    with pytest.raises(QueryRejected) as ei:
+        sched.submit(9003, tenant="c")
+    assert ei.value.reason == "queue_full"
+
+
+def test_max_in_flight_and_hbm_share_bound_without_rejecting():
+    """A tenant over its run cap queues — quota never rejects, and the
+    HBM share translates to a run-slot cap (share x global slots)."""
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 4,
+        "spark.rapids.tpu.scheduler.tenant.greedy.hbmShare": "0.5"}))
+    tickets = [sched.submit(9000 + i, tenant="greedy") for i in range(4)]
+    st = sched.stats()["greedy"]
+    assert st["run_cap"] == 2  # ceil(0.5 * 4)
+    assert st["running"] == 2 and st["queued"] == 2
+    assert [t.state for t in tickets].count(SCH.RUNNING) == 2
+    # the other half of the device is still free for another tenant
+    other = [sched.submit(9100 + i, tenant="frugal") for i in range(2)]
+    assert all(t.state == SCH.RUNNING for t in other)
+
+
+def test_bad_tenant_conf_rejects_structured():
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.tenant.broken.weight": "fast"}))
+    with pytest.raises(QueryRejected) as ei:
+        sched.submit(9001, tenant="broken")
+    assert ei.value.reason == "bad_tenant_conf"
+    assert "weight" in ei.value.detail
+
+
+# ---------------------------------------------------------------------------
+# load shedding — each watermark, with its observable side effects
+# ---------------------------------------------------------------------------
+
+def _assert_shed(sched, reason, tenant="default"):
+    shed_before = TM.REGISTRY.counter_values().get(
+        f'tpuq_admission_shed_total{{tenant="{tenant}"}}', 0)
+    with pytest.raises(QueryRejected) as ei:
+        sched.submit(9999, tenant=tenant)
+    assert ei.value.reason == reason
+    after = TM.REGISTRY.counter_values().get(
+        f'tpuq_admission_shed_total{{tenant="{tenant}"}}', 0)
+    assert after == shed_before + 1
+    warns = [e for e in TM.REGISTRY.recent_health()
+             if e.get("check") == "admission_shed"]
+    assert warns and warns[-1]["severity"] == "WARN"
+    assert reason.startswith("shed_")
+    assert sched.stats()[tenant]["shed"] >= 1
+    return ei.value
+
+
+def test_shed_on_queue_depth():
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.shed.queueDepth": 3}))
+    occupy(sched)
+    sched.submit(9001)
+    sched.submit(9002)  # depth now 3 = watermark
+    _assert_shed(sched, "shed_queue_depth")
+
+
+def test_shed_on_spill_pressure_before_disk_tier_moves():
+    """THE acceptance criterion: with the host spill tier nearly full,
+    admission sheds — and the disk spill counter has not moved (the
+    service defended itself before the arbiter started thrashing
+    disk)."""
+    mgr = M.get_manager()
+    mgr._host_used = int(mgr.host_limit * 0.9)
+    try:
+        sched = QueryScheduler(sched_conf(**{
+            "spark.rapids.tpu.scheduler.shed.spillRatio": 0.85}))
+        disk_before = TM.REGISTRY.counter_values().get(
+            "tpuq_spill_disk_bytes_total", 0)
+        err = _assert_shed(sched, "shed_spill_pressure")
+        assert "disk" in err.detail
+        assert TM.REGISTRY.counter_values().get(
+            "tpuq_spill_disk_bytes_total", 0) == disk_before
+    finally:
+        mgr._host_used = 0
+
+
+def test_shed_on_semaphore_saturation():
+    sem = SEM.get_semaphore()
+    for _ in range(sem.permits):
+        sem.acquire()
+    try:
+        sched = QueryScheduler(sched_conf(**{
+            "spark.rapids.tpu.scheduler.shed.semaphoreSaturation": 1.0}))
+        _assert_shed(sched, "shed_semaphore_saturation")
+    finally:
+        for _ in range(sem.permits):
+            sem.release()
+
+
+def test_no_shed_below_watermarks():
+    sched = QueryScheduler(sched_conf())
+    ticket = occupy(sched)
+    sched.release(ticket)
+    assert sched.stats()["default"]["shed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# fair dispatch: DWRR + priority lanes
+# ---------------------------------------------------------------------------
+
+def drain(sched, tickets, n):
+    """Release the running ticket n times, recording the tenant granted
+    the vacated slot each time."""
+    order = []
+    for _ in range(n):
+        sched.release(running_ticket(tickets))
+        live = [t for t in tickets if t.state == SCH.RUNNING]
+        if not live:
+            break
+        order.append(live[0])
+    return order
+
+
+def test_dwrr_weighted_drain_ratio():
+    """Weight 3 vs weight 1 under a single contended run slot: the
+    heavy tenant drains ~3x as fast, and the light tenant is never
+    starved out of a full refill round."""
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.tenant.heavy.weight": "3.0",
+        "spark.rapids.tpu.scheduler.tenant.light.weight": "1.0"}))
+    tickets = [occupy(sched, qid=8999, tenant="heavy")]
+    tickets += [sched.submit(9000 + i, tenant="heavy") for i in range(12)]
+    tickets += [sched.submit(9100 + i, tenant="light") for i in range(4)]
+    grants = [t.tenant for t in drain(sched, tickets, 12)]
+    heavy = grants.count("heavy")
+    assert 8 <= heavy <= 10, grants
+    assert grants.count("light") == 12 - heavy
+
+
+def test_priority_lanes_strict_within_tenant():
+    sched = QueryScheduler(sched_conf())
+    tickets = [occupy(sched)]
+    lo1 = sched.submit(9001, priority=0)
+    hi = sched.submit(9002, priority=2)
+    lo2 = sched.submit(9003, priority=0)
+    mid = sched.submit(9004, priority=1)
+    tickets += [lo1, hi, lo2, mid]
+    grants = drain(sched, tickets, 4)
+    assert [t.query_id for t in grants] == [9002, 9004, 9001, 9003]
+
+
+def test_equal_weights_round_robin_fairly():
+    sched = QueryScheduler(sched_conf())
+    tickets = [occupy(sched, tenant="a")]
+    tickets += [sched.submit(9000 + i, tenant="a") for i in range(8)]
+    tickets += [sched.submit(9100 + i, tenant="b") for i in range(8)]
+    grants = [t.tenant for t in drain(sched, tickets, 12)]
+    assert grants.count("a") == 6 and grants.count("b") == 6
+
+
+def test_fairness_invariant_helper():
+    ok = {"a": {"weight": 1.0, "completed": 10},
+          "b": {"weight": 1.0, "completed": 6},
+          "slow": {"weight": 0.1, "completed": 0}}  # different weight
+    H.assert_fairness_invariant(ok)
+    bad = {"a": {"weight": 1.0, "completed": 15},
+           "b": {"weight": 1.0, "completed": 1}}
+    with pytest.raises(AssertionError):
+        H.assert_fairness_invariant(bad)
+
+
+# ---------------------------------------------------------------------------
+# cancellation x scheduler: cancel / deadline while QUEUED
+# ---------------------------------------------------------------------------
+
+def _queued_waiter(sched, ticket):
+    """acquire() on a worker thread; returns (thread, box) where box
+    gets {"err" or "granted", "at"}."""
+    box = {}
+
+    def run():
+        try:
+            sched.acquire(ticket)
+            box["granted"] = True
+        except CN.QueryCancelled as e:
+            box["err"] = e
+        box["at"] = time.monotonic()
+
+    th = threading.Thread(target=run, daemon=True)
+    th.start()
+    return th, box
+
+
+def test_cancel_while_queued_prompt_removal_and_slot_handoff():
+    sched = QueryScheduler(sched_conf())
+    holder = occupy(sched, qid=9000)
+    tok = CN.CancelToken(9001, poll_ms=POLL_MS)
+    CN.register(tok)
+    try:
+        queued = sched.submit(9001, token=tok)
+        behind = sched.submit(9002)
+        th, box = _queued_waiter(sched, queued)
+        time.sleep(0.15)  # the waiter is parked in the CV wait
+        t0 = time.monotonic()
+        assert CN.cancel_query(9001, detail="test queued cancel")
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        assert isinstance(box.get("err"), CN.QueryCancelled)
+        # registered waiter: the cancel wakes it, not the next poll tick
+        assert box["at"] - t0 < BOUND_S
+        assert queued.state == SCH.CANCELLED
+        # removed from the lane without being admitted; the slot is
+        # still the holder's
+        assert behind.state == SCH.QUEUED
+        assert sched.stats()["default"]["cancelled_queued"] == 1
+        assert 9001 not in sched.active_queries()
+        # release() after a queued-cancel is idempotent (server workers
+        # always release in their finally)
+        sched.release(queued)
+        # the vacated slot goes to the next waiter, not into the void
+        sched.release(holder)
+        assert behind.state == SCH.RUNNING
+        sched.release(behind)
+        assert sched.queued_total == 0 and sched.running_total == 0
+    finally:
+        CN.unregister(tok)
+
+
+def test_deadline_expiry_while_queued():
+    """A deadline ticks from submit — it can expire a query that was
+    never admitted, within ~one poll interval of the instant."""
+    sched = QueryScheduler(sched_conf())
+    occupy(sched, qid=9000)
+    tok = CN.CancelToken(9001, timeout_ms=120, poll_ms=POLL_MS)
+    CN.register(tok)
+    try:
+        queued = sched.submit(9001, token=tok)
+        th, box = _queued_waiter(sched, queued)
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        err = box.get("err")
+        assert isinstance(err, CN.QueryCancelled)
+        assert err.reason == "deadline"
+        assert queued.state == SCH.CANCELLED
+        assert sched.stats()["default"]["cancelled_queued"] == 1
+    finally:
+        CN.unregister(tok)
+
+
+# ---------------------------------------------------------------------------
+# the QueryServer end to end, chaos armed
+# ---------------------------------------------------------------------------
+
+def test_server_end_to_end_queued_cancel_and_handoff():
+    """One run slot, a running query provably spinning in the execute
+    retry loop (armed injector), two queued behind it.  Cancel the
+    queued one: prompt, never admitted.  Cancel the runner: the slot
+    hands off and the last query completes.  Nothing leaks."""
+    from spark_rapids_tpu.sql.server import QueryServer
+    s = H.tpu_session({
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.tpu.query.cancelPollMs": int(POLL_MS),
+        "spark.rapids.tpu.retry.backoffBaseMs": int(2 * POLL_MS),
+        "spark.rapids.tpu.retry.backoffMaxMs": int(2 * POLL_MS),
+        "spark.rapids.tpu.retry.maxAttempts": 10**6,
+        "spark.rapids.tpu.retry.budgetPerQuery": 0,
+    })
+    server = QueryServer(s)
+    R.INJECTOR.configure({"execute": (1, 10**6)})
+    hA = server.submit(lambda: s.range(256, numPartitions=2), tenant="a")
+    base = dict(R._TM_INJECTED.child_values())
+    deadline = time.monotonic() + 30.0
+    while (time.monotonic() < deadline
+           and R._TM_INJECTED.child_values().get("execute", 0)
+           <= base.get("execute", 0)):
+        time.sleep(0.005)  # until A is spinning inside execute retries
+    hB = server.submit(lambda: s.range(256, numPartitions=2), tenant="a")
+    hC = server.submit(lambda: s.range(256, numPartitions=2), tenant="b")
+    t0 = time.monotonic()
+    assert server.cancel(hB.query_id)
+    assert hB.done.wait(timeout=5.0)
+    assert time.monotonic() - t0 < 5.0
+    assert hB.state == "CANCELLED"
+    assert hB.queue_wait_s is None  # never admitted to a run slot
+    assert hC.state == "QUEUED"  # B's removal frees no slot — A has it
+    R.INJECTOR.reset()  # let C run clean once admitted
+    assert server.cancel(hA.query_id)
+    assert hA.done.wait(timeout=10.0)
+    assert hA.state == "CANCELLED"
+    out = server.result(hC, timeout_s=30.0)
+    assert out.num_rows == 256
+    st = server.stats()
+    assert st["b"]["completed"] == 1
+    # A was cancelled while RUNNING: it still released its slot, which
+    # is what "completed" counts; B never got one
+    assert st["a"]["completed"] == 1
+    assert st["a"]["cancelled_queued"] == 1
+    sched = SCH.peek_scheduler()
+    assert sched.queued_total == 0 and sched.running_total == 0
+    assert server.active_queries() == []
+    mgr = M.peek_manager()
+    assert (mgr.report_leaks() if mgr is not None else 0) == 0
+    sem = SEM.peek_semaphore()
+    assert (sem.holders if sem is not None else 0) == 0
+    server.shutdown()
+
+
+def test_scheduler_chaos_smoke():
+    """Deterministic tier-1 smoke of the soak harness: modest load,
+    no injected faults, everything drains clean."""
+    out = H.run_scheduler_chaos(n_queries=10, seed=3,
+                                cancel_fraction=0.2, timeout_s=60.0)
+    assert out["errors"] == []
+    assert out["outcomes"]["error"] == 0
+    assert out["outcomes"]["ok"] >= 1
+    assert out["leaks"] == 0 and out["sem_holders"] == 0
+    assert out["queued"] == 0 and out["running"] == 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [101, 202, 303])
+def test_scheduler_soak_randomized_chaos(seed):
+    """Seed-randomized concurrency soak with chaos armed: transient
+    execute faults under load, a random cancel slice, and at the end —
+    zero deadlocks (the harness asserts every handle drains), zero
+    leaks, and the fairness invariant across the equal-weight
+    tenants."""
+    out = H.run_scheduler_chaos(n_queries=24, tenants=("a", "b"),
+                                seed=seed, cancel_fraction=0.25,
+                                inject={"execute": (2, 3)},
+                                timeout_s=180.0)
+    assert out["errors"] == []
+    assert out["leaks"] == 0 and out["sem_holders"] == 0
+    assert out["queued"] == 0 and out["running"] == 0
+    H.assert_fairness_invariant(out["stats"])
