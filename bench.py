@@ -1094,16 +1094,36 @@ def _sf1_query_main(name: str) -> None:
     # record utils/profile.py diff compares across bench runs
     conf["spark.rapids.tpu.stats.enabled"] = True
     dfq = build(TpuSession(conf), sf1)
+    # cold-vs-warm compile split: the shape plane's whole value
+    # proposition is warm_compiles == 0 — the second sweep pays zero
+    # compile tax because every batch landed on a canonical bucket
+    from spark_rapids_tpu.runtime import shapes as SHP
+    from spark_rapids_tpu.runtime.kernel_cache import compile_snapshot
+    c0, cs0 = compile_snapshot()
+    sh0 = SHP.snapshot()
     try:
         dfq.toArrow(timeout_ms=remaining_ms())  # warm (compile)
+        c1, cs1 = compile_snapshot()
         t, _ = timed(lambda: dfq.toArrow(timeout_ms=remaining_ms()),
                      reps=2)
     except QueryCancelled as e:
         outcome = "timeout" if e.reason == "deadline" else "cancelled"
         print(f"TPCH_SF1_OUTCOME={outcome}")
         return
+    c2, cs2 = compile_snapshot()
+    sh2 = SHP.snapshot()
     print("TPCH_SF1_OUTCOME=ok")
     print(f"TPCH_SF1_SECONDS={t:.3f}")
+    print("TPCH_SF1_COMPILE=" + json.dumps({
+        "cold_compiles": c1 - c0,
+        "cold_compile_s": round(cs1 - cs0, 3),
+        "warm_compiles": c2 - c1,
+        "warm_compile_s": round(cs2 - cs1, 3),
+        "bucketing": SHP.current_policy().mode,
+        "bucket_hits": sh2[0] - sh0[0],
+        "bucket_misses": sh2[1] - sh0[1],
+        "pad_rows": sh2[2] - sh0[2],
+        "pad_bytes": sh2[3] - sh0[3]}))
     rollup = getattr(dfq, "_last_rollup", None)
     if rollup:
         print("TPCH_SF1_ROLLUP=" + json.dumps(rollup))
@@ -1176,7 +1196,7 @@ def _sf1_query_main(name: str) -> None:
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
     """Returns (seconds | "timeout" | "cancelled" | None,
     fallback_summary | None, op_rollup | None, memory_stats | None,
-    stats_profile | None).
+    stats_profile | None, compile_record | None).
     The per-query deadline is enforced IN-PROCESS by the child (the
     engine's cancellation layer raises ``QueryCancelled`` at the
     deadline and reclaims resources); the subprocess timeout is kept
@@ -1189,7 +1209,7 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     budget_s = min(SF1_QUERY_BUDGET_S, budget_s)
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
-        return None, None, None, None, None
+        return None, None, None, None, None, None
     env = dict(os.environ)
     env["TPUQ_BENCH_QUERY_DEADLINE_S"] = f"{budget_s:.0f}"
     try:
@@ -1201,8 +1221,8 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     except subprocess.TimeoutExpired:
         mark(f"{name}: BACKSTOP kill after {budget_s + 60:.0f}s — the "
              f"in-process deadline failed to cancel the query")
-        return "timeout", None, None, None, None
-    secs = fb = rollup = mem = stats = outcome = None
+        return "timeout", None, None, None, None, None
+    secs = fb = rollup = mem = stats = compiles = outcome = None
     for line in (out.stdout or "").splitlines():
         if line.startswith("TPCH_SF1_OUTCOME="):
             outcome = line.split("=", 1)[1].strip()
@@ -1216,16 +1236,18 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             mem = json.loads(line.split("=", 1)[1])
         elif line.startswith("TPCH_SF1_STATS="):
             stats = json.loads(line.split("=", 1)[1])
+        elif line.startswith("TPCH_SF1_COMPILE="):
+            compiles = json.loads(line.split("=", 1)[1])
     if outcome in ("timeout", "cancelled"):
         mark(f"{name}: {outcome} after {budget_s:.0f}s (in-process "
              f"deadline, resources reclaimed)")
-        return outcome, None, None, None, None
+        return outcome, None, None, None, None, None
     if secs is not None:
-        return secs, fb, rollup, mem, stats
+        return secs, fb, rollup, mem, stats, compiles
     # crashed child: surface the failure, don't blur it into a timeout
     mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
          + (out.stderr or "")[-500:].replace("\n", " | "))
-    return None, None, None, None, None
+    return None, None, None, None, None, None
 
 
 CONCURRENCY_LEVELS = (1, 8, 64)
@@ -1409,6 +1431,7 @@ def main():
     rollups = {name: None for name in TPCH_BUILDERS}
     memories = {name: None for name in TPCH_BUILDERS}
     statses = {name: None for name in TPCH_BUILDERS}
+    compile_recs = {name: None for name in TPCH_BUILDERS}
     result = {
         "metric": "tpch_q6_throughput",
         "value": round(ROWS / t_tpu / 1e6, 2),
@@ -1431,6 +1454,7 @@ def main():
         "tpch_sf1_op_rollup": rollups,
         "tpch_sf1_memory": memories,
         "tpch_sf1_stats": statses,
+        "tpch_sf1_compile": compile_recs,
         "tpch_sf1_concurrency": None,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
@@ -1484,7 +1508,8 @@ def main():
         # whatever finished compiling, so later runs get further.
         remaining = TOTAL_BUDGET_S - (time.monotonic() - t_start)
         (times[name], fallbacks[name], rollups[name], memories[name],
-         statses[name]) = _sf1_query_subprocess(name, mark, remaining)
+         statses[name], compile_recs[name]) = _sf1_query_subprocess(
+             name, mark, remaining)
         mark(f"{name} sf1: {times[name]}s")
         emit()
 

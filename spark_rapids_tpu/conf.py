@@ -954,6 +954,91 @@ HEALTH_COMPILE_STORM = (
     .create_with_default(64)
 )
 
+# -- shape plane + persistent kernel cache (runtime/shapes.py +
+#    runtime/kernel_cache.py) ------------------------------------------------
+
+
+def _valid_ladder(v) -> bool:
+    """CSV of strictly-increasing positive row counts ('' = unset)."""
+    s = str(v).strip()
+    if not s:
+        return True
+    try:
+        rungs = [int(x.strip()) for x in s.split(",")]
+    except ValueError:
+        return False
+    return (all(r > 0 for r in rungs)
+            and all(a < b for a, b in zip(rungs, rungs[1:])))
+
+
+KERNEL_CACHE_DIR = (
+    conf("spark.rapids.tpu.kernel.cacheDir")
+    .doc("Directory for the persistent (on-disk) XLA compilation cache. "
+         "Compiled executables survive process restarts, so a warm "
+         "QueryServer restart pays zero hot-path compiles. The directory "
+         "carries a manifest versioned on (jax, jaxlib, engine); a "
+         "version mismatch invalidates the cache wholesale. Empty "
+         "(default) falls back to the SPARK_RAPIDS_TPU_XLA_CACHE "
+         "environment variable. Ignored on the XLA:CPU backend, whose "
+         "AOT cache entries are unsafe to reload.")
+    .category("kernel")
+    .string()
+    .create_with_default("")
+)
+
+KERNEL_BUCKETING = (
+    conf("spark.rapids.tpu.kernel.bucketing")
+    .doc("Batch-shape bucketing policy of the shape plane: 'pow2' pads "
+         "device batch capacities up to power-of-two row buckets, "
+         "'ladder' pads up to the explicit rung list in "
+         "kernel.bucketLadder (pow2 above the top rung), 'off' disables "
+         "re-bucketing at the exec pump boundary. Fewer distinct shapes "
+         "means fewer (op, schema, bucket) XLA compiles.")
+    .category("kernel")
+    .string()
+    .check(lambda v: str(v).lower() in ("off", "pow2", "ladder"),
+           "one of off, pow2, ladder")
+    .create_with_default("pow2")
+)
+
+KERNEL_BUCKET_LADDER = (
+    conf("spark.rapids.tpu.kernel.bucketLadder")
+    .doc("Comma-separated strictly-increasing row-count rungs for "
+         "kernel.bucketing=ladder, e.g. '1024,8192,65536,1048576'. "
+         "Capacities above the top rung fall back to pow2 rounding. "
+         "Empty means ladder mode behaves like pow2.")
+    .category("kernel")
+    .string()
+    .check(_valid_ladder, "comma-separated strictly-increasing "
+                          "positive integers")
+    .create_with_default("")
+)
+
+KERNEL_MAX_PAD_FRACTION = (
+    conf("spark.rapids.tpu.kernel.maxPadFraction")
+    .doc("Upper bound on the padding a bucket may introduce, as "
+         "(bucket - capacity) / bucket. A rung that would exceed it is "
+         "rejected in favor of the batch's pow2 bucket, trading a "
+         "possible extra compile for bounded pad-waste bytes.")
+    .category("kernel")
+    .double()
+    .check(lambda v: 0.0 <= v < 1.0, "in [0, 1)")
+    .create_with_default(0.75)
+)
+
+KERNEL_WARMUP_ON_START = (
+    conf("spark.rapids.tpu.kernel.warmupOnStart")
+    .doc("QueryServer construction pre-executes the warmup plans handed "
+         "to it (session.warmup), compiling the op x bucket matrix "
+         "outside any query window — so the first tenant query never "
+         "pays XLA compile and never trips the compile-storm health "
+         "WARN. Disable to defer compilation to first use.")
+    .category("kernel")
+    .boolean()
+    .create_with_default(True)
+)
+
+
 # -- multi-tenant query service (runtime/scheduler.py + sql/server.py) ------
 #
 # Per-tenant overrides ride a dynamic key family the scheduler reads at

@@ -21,6 +21,7 @@ from typing import Dict, Iterator, Tuple
 
 from spark_rapids_tpu.columnar import dtypes as T
 from spark_rapids_tpu.runtime import cancel
+from spark_rapids_tpu.runtime import shapes
 from spark_rapids_tpu.runtime import stats
 from spark_rapids_tpu.runtime import trace
 
@@ -128,6 +129,25 @@ def _cancellable_pump(tok, it: Iterator) -> Iterator:
         yield batch
 
 
+def _shape_pump(node: "ExecNode", it: Iterator) -> Iterator:
+    """Pin every pumped DeviceBatch to the shape plane's canonical
+    bucket (runtime/shapes.py) — the operator boundary where stray
+    batch capacities would otherwise fan out into fresh (op, schema,
+    bucket) XLA compiles downstream.  Pad rows are dead (sel=False)
+    and recorded per node in the stats plane as ``padded_rows``."""
+    while True:
+        try:
+            batch = next(it)
+        except StopIteration:
+            return
+        batch, pad = shapes.bucket_batch(batch)
+        if pad:
+            st = stats.current()
+            if st is not None:
+                st.node_stats(node).add_padded(pad)
+        yield batch
+
+
 def _stats_pump(st, node: "ExecNode", it: Iterator) -> Iterator:
     """Record every yielded batch on the query's OpStatsCollector —
     rows/batches/bytes out per node, the observation side of the stats
@@ -145,6 +165,10 @@ def _wrap_execute(fn):
     @functools.wraps(fn)
     def execute(self, partition: int) -> Iterator:
         it = fn(self, partition)
+        if shapes.current_policy().enabled and isinstance(self, TpuExec):
+            # innermost: downstream pumps (and consumers) see the
+            # bucketed batch
+            it = _shape_pump(self, it)
         tok = cancel.current()
         if tok is not None:
             it = _cancellable_pump(tok, it)

@@ -732,6 +732,7 @@ class DistributedShuffleExecutor:
         d = self.nparts
         # 1. local counts (plain per-device jit, no collective)
         pid_fn = SH.make_pid_fn(keys, d)
+        # jit-exempt: one throwaway counting program per rendezvous epoch
         cnt = jax.jit(lambda b: SH.local_partition_counts(
             b, pid_fn(b), d))
         local_max = 0

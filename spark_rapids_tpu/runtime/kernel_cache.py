@@ -7,6 +7,13 @@ keys on input shapes, so each (op, schema, bucket) pair compiles exactly
 once and stays hot across queries — the analog of cuDF's precompiled
 kernels, and essential on TPU where eager dispatch means one XLA
 compilation per arithmetic op.
+
+Three layers, innermost first: jax's jit cache (per shape bucket), this
+module's fingerprint cache (per op structure), and — when
+``spark.rapids.tpu.kernel.cacheDir`` is set — jax's on-disk
+compilation cache (per machine, survives process restarts; see
+``configure_persistent_cache``).  The shape plane (runtime/shapes.py)
+bounds the bucket axis so all three stay small.
 """
 
 from __future__ import annotations
@@ -14,7 +21,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from typing import Callable, Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 
@@ -147,6 +154,112 @@ def cached_kernel(key: tuple, builder: Callable[[], Callable]) -> Callable:
 
 def cache_stats() -> Tuple[int,]:
     return (len(_CACHE),)
+
+
+def compile_snapshot() -> Tuple[int, float]:
+    """(compile count, compile seconds) observed so far — the
+    before/after pair bench.py and ``session.warmup`` diff to attribute
+    compiles to a phase (cold run, warm run, warmup)."""
+    return (int(_TM_COMPILES.value), float(_TM_COMPILE_S.value))
+
+
+# ---------------------------------------------------------------------------
+# Persistent compilation cache (spark.rapids.tpu.kernel.cacheDir)
+# ---------------------------------------------------------------------------
+#
+# The in-process layers above make each (op, schema, bucket) compile once
+# per PROCESS; this layer makes it compile once per MACHINE.  It enables
+# jax's on-disk compilation cache under the conf'd directory, so a fresh
+# QueryServer process whose cacheDir was warmed by a previous run (or by
+# ``session.warmup``) loads executables from disk instead of invoking
+# XLA on the hot path.
+
+MANIFEST_NAME = "tpuq_cache_manifest.json"
+_PERSISTENT_DIR: Optional[str] = None
+
+
+def _cache_versions() -> Dict[str, str]:
+    """The compatibility tuple a cache directory is valid for."""
+    import jaxlib
+
+    from spark_rapids_tpu import __version__ as engine_version
+    return {"format": "1", "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__, "engine": engine_version}
+
+
+def _sync_manifest(cache_dir: str) -> bool:
+    """Validate ``cache_dir`` against the current versions.
+
+    Returns True when existing entries were kept (manifest matched).
+    On mismatch — a different jax/jaxlib/engine wrote them, and XLA's
+    serialized executables make no cross-version promises — every entry
+    is dropped and the manifest is rewritten for this build."""
+    import json
+    import os
+    import shutil
+    path = os.path.join(cache_dir, MANIFEST_NAME)
+    want = _cache_versions()
+    try:
+        with open(path) as f:
+            have = json.load(f)
+    except (OSError, ValueError):
+        have = None
+    if have == want:
+        return True
+    for name in os.listdir(cache_dir):
+        if name == MANIFEST_NAME:
+            continue
+        p = os.path.join(cache_dir, name)
+        try:
+            if os.path.isdir(p):
+                shutil.rmtree(p)
+            else:
+                os.unlink(p)
+        except OSError:
+            pass  # a torn delete only costs one stale entry re-check
+    with open(path, "w") as f:
+        json.dump(want, f)
+    return False
+
+
+def configure_persistent_cache(conf) -> Optional[str]:
+    """Point jax's on-disk compilation cache at kernel.cacheDir.
+
+    Called at session init (after the backend is resolved).  An empty
+    cacheDir leaves the runtime/device.py env-var default in charge.
+    On the XLA:CPU backend this is a hard no-op regardless of conf —
+    CPU AOT cache entries carry target pseudo-features the loader's
+    host check rejects, and reading one SEGFAULTS the process (see
+    runtime/device.py) — TPU compile times are what the cache is for.
+    Returns the active directory, or None when disabled."""
+    import os
+
+    from spark_rapids_tpu import conf as C
+    from spark_rapids_tpu.runtime.device import (
+        _machine_fingerprint, ensure_initialized)
+    global _PERSISTENT_DIR
+    cache_dir = str(conf.get(C.KERNEL_CACHE_DIR)).strip()
+    if not cache_dir:
+        return _PERSISTENT_DIR
+    ensure_initialized()
+    if jax.default_backend() == "cpu":
+        return None
+    cache_dir = os.path.join(os.path.expanduser(cache_dir),
+                             _machine_fingerprint())
+    os.makedirs(cache_dir, exist_ok=True)
+    _sync_manifest(cache_dir)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # persist EVERY executable, not only slow ones: the warm-restart
+    # contract is zero hot-path compiles, and a 50 ms compile skipped
+    # from disk is still a compile the storm detector would count
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _PERSISTENT_DIR = cache_dir
+    return cache_dir
+
+
+def persistent_cache_dir() -> Optional[str]:
+    """The conf-selected on-disk cache directory, when one is active."""
+    return _PERSISTENT_DIR
 
 
 def clear() -> None:

@@ -50,6 +50,8 @@ STATS_FIELDS = {
     "bytes_in": "sum of the children's bytes_out",
     "batches_in": "sum of the children's batches_out",
     "batch_rows_hist": "pow-2 histogram of observed batch row counts",
+    "padded_rows": "dead rows the shape plane appended to this "
+                   "operator's output batches (bucket padding)",
     "null_ratio": "per-column observed null fraction (level=FULL)",
     "partition_rows": "per-partition live-row counts at an exchange",
     "partition_bytes": "per-partition byte sizes at an exchange",
@@ -130,12 +132,14 @@ class NodeStats:
     nodes never contend (same policy as exec.base.Metric)."""
 
     __slots__ = ("rows", "batches", "bytes", "hist", "nulls", "observed",
-                 "partitions", "partition_unit", "executors", "_lock")
+                 "partitions", "partition_unit", "executors", "padded",
+                 "_lock")
 
     def __init__(self):
         self.rows = 0
         self.batches = 0
         self.bytes = 0
+        self.padded = 0
         self.hist: Dict[str, int] = {}
         # col name -> [null count, rows observed]
         self.nulls: Dict[str, List[int]] = {}
@@ -159,6 +163,10 @@ class NodeStats:
                     slot = self.nulls.setdefault(name, [0, 0])
                     slot[0] += nc
                     slot[1] += n
+
+    def add_padded(self, n: int) -> None:
+        with self._lock:
+            self.padded += int(n)
 
     def set_partitions(self, counts: Sequence[int], unit: str,
                        executors: int = 1) -> None:
@@ -300,6 +308,8 @@ class OpStatsCollector:
                     key=lambda kv: 0 if kv[0] == "0"
                     else int(kv[0].split("-")[0]))),
             }
+            if ns.padded:
+                rec["padded_rows"] = ns.padded
             fused = getattr(node, "metrics", {}).get("fusedIntoConsumer")
             if fused is not None and fused.value:
                 rec["fused"] = True

@@ -74,14 +74,27 @@ class QueryServer:
     invoked on the admitted worker thread, so plan construction happens
     per-execution and per-DataFrame caches (``_last_plan`` etc.) are
     not raced by overlapping runs of the SAME DataFrame object.
+
+    ``warmup_plans`` (DataFrames, or callables taking the session)
+    name the shapes the server expects to serve; when
+    ``spark.rapids.tpu.kernel.warmupOnStart`` is on (default) they run
+    through ``session.warmup`` at construction — so the op x bucket
+    matrix compiles BEFORE the first tenant submission, outside any
+    query's telemetry window, and (with kernel.cacheDir set) the
+    executables persist for the next server process.
     """
 
-    def __init__(self, session):
+    def __init__(self, session, warmup_plans=None):
+        from spark_rapids_tpu import conf as C
         self.session = session
         self._lock = threading.Lock()
         self._handles: Dict[int, QueryHandle] = {}
         self._threads: List[threading.Thread] = []
         self._closed = False
+        self.warmup_report: Optional[dict] = None
+        conf = session.rapids_conf()
+        if warmup_plans and conf.get(C.KERNEL_WARMUP_ON_START):
+            self.warmup_report = session.warmup(warmup_plans)
 
     # -- submission --------------------------------------------------------
 

@@ -159,6 +159,14 @@ class TpuSession:
         # conf-gated lock-order watchdog (spark.rapids.tpu.lockdep.*)
         from spark_rapids_tpu.runtime import lockdep
         lockdep.configure(self.conf.snapshot())
+        # shape plane: batch-shape bucketing policy for every exec pump
+        # (spark.rapids.tpu.kernel.bucketing/bucketLadder/maxPadFraction)
+        from spark_rapids_tpu.runtime import shapes
+        shapes.configure(self.conf.snapshot())
+        # persistent (on-disk) XLA compilation cache
+        # (spark.rapids.tpu.kernel.cacheDir; no-op on the CPU backend)
+        from spark_rapids_tpu.runtime import kernel_cache
+        kernel_cache.configure_persistent_cache(self.conf.snapshot())
 
     # -- observability ------------------------------------------------------
     def _record_query(self, entry: Dict[str, Any]) -> None:
@@ -212,6 +220,44 @@ class TpuSession:
                 return False
             query_id = active[0]
         return cancel.cancel_query(query_id, reason=reason)
+
+    def warmup(self, plans: Iterable[Any]) -> Dict[str, Any]:
+        """Pre-compile the kernels a set of plans will need.
+
+        ``plans`` is an iterable of DataFrames (or callables taking this
+        session and returning one — handy for conf-parameterized plan
+        builders).  Each plan is planned and every partition drained
+        through the full exec pipeline, so the op x schema x bucket
+        matrix the plan touches compiles NOW — and, with
+        ``spark.rapids.tpu.kernel.cacheDir`` set, lands in the on-disk
+        cache for future processes.
+
+        Deliberately OUTSIDE the query-window machinery ``toArrow``
+        runs: compiles triggered here never enter any query's telemetry
+        delta, so the compile-storm health check (which diffs per-query
+        counter windows) sees a clean hot path afterwards — warming up
+        is not a storm.  Results are discarded; only compilation state
+        survives.
+
+        Returns ``{"plans", "compiles", "compile_seconds", "wall_s"}``.
+        """
+        import time as _time
+        from spark_rapids_tpu.runtime import kernel_cache
+        t0 = _time.perf_counter()
+        c0, s0 = kernel_cache.compile_snapshot()
+        count = 0
+        for p in plans:
+            df = p(self) if callable(p) else p
+            plan = df._execute_plan()
+            for part in range(plan.num_partitions()):
+                for _ in plan.execute(part):
+                    pass
+            count += 1
+        c1, s1 = kernel_cache.compile_snapshot()
+        return {"plans": count,
+                "compiles": c1 - c0,
+                "compile_seconds": round(s1 - s0, 6),
+                "wall_s": round(_time.perf_counter() - t0, 6)}
 
     def metrics_report(self) -> Dict[str, Any]:
         """Point-in-time process telemetry: every registry counter/gauge

@@ -38,6 +38,11 @@ Rules (catalog in docs/static_analysis.md):
                       — device admission must flow through
                       ``runtime.scheduler.device_hold`` so multi-tenant
                       fairness and load shedding see all traffic
+``raw-jit``           ``jax.jit`` calls/decorators outside
+                      runtime/kernel_cache.py — raw jits bypass the
+                      fingerprint cache, compile-storm telemetry, the
+                      compile failure domain, and the persistent
+                      on-disk cache (kernel.cacheDir)
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -46,7 +51,8 @@ annotation::
 
 The reason is mandatory — an empty reason is itself a finding.  The
 legacy ``# cancel-exempt: <why>`` annotation is honored as an alias
-for ``exempt(blocking-wait)``.
+for ``exempt(blocking-wait)``, and ``# jit-exempt: <why>`` as an alias
+for ``exempt(raw-jit)``.
 """
 
 from __future__ import annotations
@@ -64,6 +70,8 @@ EXEMPT_RE = re.compile(
     r"\s*(?::\s*(.*))?")
 # legacy PR-5 annotation, kept working so the two gates can't disagree
 CANCEL_EXEMPT_RE = re.compile(r"#\s*cancel-exempt\s*(?::\s*(.*))?")
+# raw-jit's domain-specific spelling (mirrors cancel-exempt)
+JIT_EXEMPT_RE = re.compile(r"#\s*jit-exempt\s*(?::\s*(.*))?")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -115,6 +123,16 @@ class SourceModule:
                         "cancel-exempt without a reason — write "
                         "'# cancel-exempt: <why>'"))
                 self.exemptions[i] = ({"blocking-wait"}, reason)
+                continue
+            m = JIT_EXEMPT_RE.search(ln)
+            if m:
+                reason = (m.group(1) or "").strip()
+                if not reason:
+                    self._bad_exemptions.append(Finding(
+                        "exemption", rel, i,
+                        "jit-exempt without a reason — write "
+                        "'# jit-exempt: <why>'"))
+                self.exemptions[i] = ({"raw-jit"}, reason)
 
     def _comments(self):
         """(line, comment_text) for real COMMENT tokens only — an
@@ -185,11 +203,12 @@ def all_rules() -> List[Rule]:
     from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
     from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
     from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
+    from spark_rapids_tpu.utils.lint.raw_jit import RawJitRule
     from spark_rapids_tpu.utils.lint.scheduler_bypass import (
         SchedulerBypassRule)
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
-            SchedulerBypassRule()]
+            SchedulerBypassRule(), RawJitRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

@@ -1,0 +1,67 @@
+"""raw-jit rule: every jit compilation goes through the kernel cache.
+
+``runtime/kernel_cache.py`` is the engine's single compile chokepoint:
+it fingerprints the kernel, counts the compile in
+``tpuq_kernel_compile_total`` (the compile-storm health signal), tags
+the trace span, routes the build through the ``compile`` failure
+domain, and — with ``spark.rapids.tpu.kernel.cacheDir`` — persists the
+executable.  A ``jax.jit`` call anywhere else bypasses ALL of that: its
+compiles are invisible to storm detection, un-retried on injected
+faults, and never land in the persistent cache, so a "warmed" server
+still pays them on the hot path.
+
+This rule flags ``jax.jit(...)`` calls and ``@jax.jit`` decorators in
+any module other than runtime/kernel_cache.py.  A deliberate raw jit
+(e.g. a sharding-constrained collective wrapper ``cached_kernel``
+cannot express) carries::
+
+    # jit-exempt: <why>
+
+(an alias for ``# lint: exempt(raw-jit): <why>``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+# the compile chokepoint itself
+ALLOWED = ("spark_rapids_tpu/runtime/kernel_cache.py",)
+
+
+def _is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` as an attribute access (call or decorator base)."""
+    return (isinstance(node, ast.Attribute) and node.attr == "jit"
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "jax")
+
+
+class RawJitRule(Rule):
+    name = "raw-jit"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        rel = mod.rel.replace("\\", "/")
+        if rel in ALLOWED:
+            return
+        for node in ast.walk(mod.tree):
+            sites = []
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func):
+                sites.append(node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    # @jax.jit and @jax.jit(static_argnums=...) — the
+                    # call form is also an ast.Call caught above, so
+                    # only the bare-attribute decorator needs this arm
+                    if _is_jax_jit(dec):
+                        sites.append(dec)
+            for site in sites:
+                yield Finding(
+                    self.name, mod.rel, site.lineno,
+                    "raw jax.jit outside runtime/kernel_cache.py — "
+                    "route compilation through cached_kernel so it is "
+                    "fingerprint-cached, counted by compile-storm "
+                    "telemetry, retried via the compile failure "
+                    "domain, and persisted by kernel.cacheDir "
+                    "(deliberate: '# jit-exempt: <why>')")

@@ -14,7 +14,8 @@ per file:
   signals (plus compile counters for the storm report);
 * **bench scoreboard** (``BENCH_*.json``): one JSON object whose
   ``tpch_sf1_op_rollup``/``tpch_sf1_stats`` maps key per-op records by
-  query name.
+  query name, plus the ``tpch_sf1_compile`` cold-vs-warm compile split
+  the ``storms`` report reads.
 
 Usage::
 
@@ -116,7 +117,8 @@ def load_runs(path: str) -> List[dict]:
         b = records[0]
         rollups = b.get("tpch_sf1_op_rollup") or {}
         statses = b.get("tpch_sf1_stats") or {}
-        for q in sorted(set(rollups) | set(statses)):
+        compile_recs = b.get("tpch_sf1_compile") or {}
+        for q in sorted(set(rollups) | set(statses) | set(compile_recs)):
             ops: Dict[str, dict] = {}
             for op, r in (rollups.get(q) or {}).items():
                 ops[f"{q}/{op}"] = {"op": op, "sig": None,
@@ -125,9 +127,11 @@ def load_runs(path: str) -> List[dict]:
             st = statses.get(q) or {}
             for rec in st.get("ops") or []:
                 ops[f"{q}/{_op_key(rec)}"] = _norm_op(rec)
+            crec = compile_recs.get(q)
             runs.append({"label": q, "ops": ops,
                          "exchanges": (st.get("exchanges") or []),
-                         "compiles": None, "wall_s": None})
+                         "compiles": (crec or {}).get("cold_compiles"),
+                         "compile_rec": crec, "wall_s": None})
         return runs
     for r in records:
         if kind == "profile-store":
@@ -237,6 +241,20 @@ def report_storms(runs: List[dict]) -> List[str]:
     lines = [f"compile activity over {len(runs)} run(s):"]
     found = False
     for run in runs:
+        rec = run.get("compile_rec")
+        if rec:
+            # bench scoreboard: cold-vs-warm split from the shape plane
+            found = True
+            warm = rec.get("warm_compiles") or 0
+            flag = "  WARM-PATH COMPILES" if warm else ""
+            lines.append(
+                f"  {run['label']}: cold {rec.get('cold_compiles', 0)} "
+                f"compiles ({rec.get('cold_compile_s', 0.0):.1f}s), "
+                f"warm {warm}, bucketing={rec.get('bucketing')} "
+                f"hits/misses {rec.get('bucket_hits', 0)}/"
+                f"{rec.get('bucket_misses', 0)}, "
+                f"pad {rec.get('pad_rows', 0)} rows{flag}")
+            continue
         storms = [h for h in run.get("health", [])
                   if h.get("check") == "compile_storm"]
         if run.get("compiles") or storms:

@@ -212,3 +212,43 @@ def test_coalesce_single_batch_under_sort():
         lambda s: s.createDataFrame(t).orderBy("a", "b"),
         conf={"spark.default.parallelism": 3,
               "spark.rapids.tpu.batchRows": 512}, approx_float=True)
+
+
+# -- shape plane + persistent kernel cache ----------------------------------
+
+def test_shape_conf_defaults_and_wiring():
+    """The five kernel.* confs parse, default sanely, and actually
+    steer the installed shape policy (not just the registry)."""
+    from spark_rapids_tpu import conf as Cf
+    from spark_rapids_tpu.runtime import shapes
+    try:
+        s = tpu_session()
+        rc = s.rapids_conf()
+        assert rc.get(Cf.KERNEL_BUCKETING) == "pow2"
+        assert rc.get(Cf.KERNEL_BUCKET_LADDER) == ""
+        assert rc.get(Cf.KERNEL_MAX_PAD_FRACTION) == 0.75
+        assert rc.get(Cf.KERNEL_CACHE_DIR) == ""
+        assert rc.get(Cf.KERNEL_WARMUP_ON_START) is True
+        assert shapes.current_policy().mode == "pow2"
+        tpu_session({"spark.rapids.tpu.kernel.bucketing": "off"})
+        assert not shapes.current_policy().enabled
+        tpu_session({"spark.rapids.tpu.kernel.bucketing": "ladder",
+                     "spark.rapids.tpu.kernel.bucketLadder":
+                     "4096,16384"})
+        assert shapes.current_policy().ladder == (4096, 16384)
+    finally:
+        shapes._POLICY = shapes.ShapePolicy()
+
+
+@pytest.mark.parametrize("key,bad", [
+    ("spark.rapids.tpu.kernel.bucketing", "diagonal"),
+    ("spark.rapids.tpu.kernel.bucketLadder", "1024,512"),   # not increasing
+    ("spark.rapids.tpu.kernel.bucketLadder", "12,-4"),      # negative rung
+    ("spark.rapids.tpu.kernel.bucketLadder", "a,b"),        # not ints
+    ("spark.rapids.tpu.kernel.maxPadFraction", 1.5),
+    ("spark.rapids.tpu.kernel.maxPadFraction", -0.1),
+    ("spark.rapids.tpu.kernel.maxPadFraction", 1.0),        # half-open
+])
+def test_shape_conf_validation_rejects(key, bad):
+    with pytest.raises(ValueError, match="invalid value"):
+        tpu_session({key: bad})

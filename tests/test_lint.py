@@ -24,6 +24,7 @@ from spark_rapids_tpu.utils.lint.failure_domains import FailureDomainRule
 from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
 from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
 from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
+from spark_rapids_tpu.utils.lint.raw_jit import RawJitRule
 from spark_rapids_tpu.utils.lint.scheduler_bypass import SchedulerBypassRule
 
 
@@ -575,6 +576,68 @@ def test_scheduler_bypass_exemption():
         sem = get_semaphore(None)
         """)
     assert _run([SchedulerBypassRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
+# raw-jit
+# ---------------------------------------------------------------------------
+
+def test_raw_jit_flags_call_and_decorator():
+    m = _mod("spark_rapids_tpu/exec/fast_math.py", """
+        import jax
+
+        hot = jax.jit(lambda x: x + 1)
+
+        @jax.jit
+        def hotter(x):
+            return x * 2
+
+        @jax.jit
+        def hottest(x):
+            return x * 3
+        """)
+    out = _run([RawJitRule()], m)
+    assert [f.rule for f in out] == ["raw-jit"] * 3
+    assert "cached_kernel" in out[0].message
+
+
+def test_raw_jit_kernel_cache_and_cached_kernel_clean():
+    owner = _mod("spark_rapids_tpu/runtime/kernel_cache.py", """
+        import jax
+
+        def _build_wrapper(key, builder):
+            return jax.jit(builder())
+        """)
+    consumer = _mod("spark_rapids_tpu/exec/clean_op.py", """
+        from spark_rapids_tpu.runtime.kernel_cache import cached_kernel
+
+        def kernel(schema):
+            return cached_kernel(("op", schema), lambda: (lambda b: b))
+        """)
+    assert _run([RawJitRule()], owner, consumer) == []
+
+
+def test_raw_jit_jit_exempt_alias():
+    m = _mod("spark_rapids_tpu/parallel/collective.py", """
+        import jax
+
+        # jit-exempt: mesh-bound SPMD program, not fingerprintable
+        prog = jax.jit(lambda x: x)
+        inline = jax.jit(lambda x: x)  # jit-exempt: same-line spelling
+        """)
+    assert _run([RawJitRule()], m) == []
+
+
+def test_raw_jit_jit_exempt_requires_reason():
+    m = _mod("spark_rapids_tpu/parallel/collective.py", """
+        import jax
+
+        # jit-exempt:
+        prog = jax.jit(lambda x: x)
+        """)
+    out = _run([RawJitRule()], m)
+    assert [f.rule for f in out] == ["exemption"]
+    assert "jit-exempt" in out[0].message
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,396 @@
+"""Shape-plane tests: bucketing policy, pad-mask correctness, the
+persistent-cache manifest, warmup, and the zero-batch concat regression.
+
+The load-bearing invariant: a batch padded up to a canonical bucket is
+*observationally identical* to the unpadded batch — pad rows are dead
+(``sel=False``) and every kernel already honors row liveness, so query
+results must be bit-identical with bucketing on or off.
+"""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import column as C
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.runtime import kernel_cache as KC
+from spark_rapids_tpu.runtime import shapes
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.sql.window import Window
+from spark_rapids_tpu.utils import datagen as dg
+from spark_rapids_tpu.utils.asserts import assert_tables_equal
+from spark_rapids_tpu.utils.datagen import skewed_null_table
+from spark_rapids_tpu.utils.harness import tpu_session
+
+
+@pytest.fixture(autouse=True)
+def _reset_policy():
+    """Sessions install the shape policy globally; park it back at the
+    default so test order can't leak a forced-padding ladder."""
+    yield
+    shapes._POLICY = shapes.ShapePolicy()
+
+
+# ---------------------------------------------------------------------------
+# ShapePolicy unit tests
+# ---------------------------------------------------------------------------
+
+def test_policy_pow2():
+    p = shapes.ShapePolicy(mode="pow2", min_bucket=1024)
+    assert p.enabled
+    assert p.bucket_for(1) == 1024
+    assert p.bucket_for(1000) == 1024
+    assert p.bucket_for(1024) == 1024
+    assert p.bucket_for(1025) == 2048
+
+
+def test_policy_ladder_rungs_and_fallbacks():
+    p = shapes.ShapePolicy(mode="ladder", ladder=(4096, 16384),
+                           max_pad_fraction=0.75, min_bucket=1024)
+    assert p.bucket_for(3000) == 4096     # within pad budget
+    assert p.bucket_for(4096) == 4096     # exact rung
+    assert p.bucket_for(5000) == 16384    # (16384-5000)/16384 ~ 0.69
+    # smallest fitting rung would waste >75% -> pow2 fallback
+    assert p.bucket_for(100) == 1024
+    # above the top rung -> pow2 fallback
+    assert p.bucket_for(20000) == 32768
+
+
+def test_policy_off():
+    p = shapes.ShapePolicy()
+    assert not p.enabled
+    b = C.host_to_device(pa.table({"a": pa.array([1, 2, 3], pa.int64())}))
+    out, pad = shapes.bucket_batch(b, policy=p)
+    assert out is b and pad == 0
+
+
+def test_configure_parses_conf():
+    s = tpu_session({"spark.rapids.tpu.kernel.bucketing": "ladder",
+                     "spark.rapids.tpu.kernel.bucketLadder": "2048,8192",
+                     "spark.rapids.tpu.kernel.maxPadFraction": 0.5})
+    del s
+    p = shapes.current_policy()
+    assert p.mode == "ladder"
+    assert p.ladder == (2048, 8192)
+    assert p.max_pad_fraction == 0.5
+
+
+# ---------------------------------------------------------------------------
+# bucket_batch: dead-row padding mechanics
+# ---------------------------------------------------------------------------
+
+def test_bucket_batch_pads_with_dead_rows():
+    tbl = pa.table({"a": pa.array(list(range(16)), pa.int64()),
+                    "s": pa.array([f"s{i}" for i in range(16)])})
+    b = C.host_to_device(tbl, bucket=16, min_bucket=16)
+    pol = shapes.ShapePolicy(mode="pow2", min_bucket=64)
+    before = shapes.snapshot()
+    out, pad = shapes.bucket_batch(b, policy=pol)
+    after = shapes.snapshot()
+    assert pad == 48 and out.capacity == 64
+    assert bool(np.asarray(out.sel)[16:].any()) is False  # dead tail
+    assert out.compacted == b.compacted
+    # counters moved: one miss, 48 pad rows, some pad bytes
+    assert after[1] - before[1] == 1
+    assert after[2] - before[2] == 48
+    assert after[3] > before[3]
+    # padded batch reads back as the same table
+    assert_tables_equal(C.device_to_host(b), C.device_to_host(out))
+
+
+def test_bucket_batch_hit_is_identity():
+    b = C.host_to_device(pa.table({"a": pa.array([1, 2, 3], pa.int64())}))
+    pol = shapes.ShapePolicy(mode="pow2", min_bucket=1024)
+    before = shapes.snapshot()
+    out, pad = shapes.bucket_batch(b, policy=pol)
+    assert out is b and pad == 0
+    assert shapes.snapshot()[0] - before[0] == 1  # one hit
+
+
+def test_bucket_batch_preserves_compacted_promise():
+    import jax.numpy as jnp
+    tbl = pa.table({"a": pa.array(list(range(16)), pa.int64())})
+    b = C.host_to_device(tbl, bucket=16, min_bucket=16)
+    b = C.compact(b.with_sel(jnp.asarray(np.arange(16) % 2 == 0) & b.sel))
+    assert b.compacted
+    out, pad = shapes.bucket_batch(
+        b, policy=shapes.ShapePolicy(mode="pow2", min_bucket=64))
+    assert pad and out.compacted
+    sel = np.asarray(out.sel)
+    live = int(sel.sum())
+    assert sel[:live].all() and not sel[live:].any()  # still front-packed
+
+
+def test_bucket_batch_passes_non_device_values():
+    out, pad = shapes.bucket_batch(
+        "not-a-batch", policy=shapes.ShapePolicy(mode="pow2"))
+    assert out == "not-a-batch" and pad == 0
+
+
+# ---------------------------------------------------------------------------
+# satellite 1: zero-batch / zero-row concat regression (q7's crash site)
+# ---------------------------------------------------------------------------
+
+def _schema():
+    return T.StructType((T.StructField("a", T.LongT, False),
+                         T.StructField("s", T.StringT, True)))
+
+
+def test_concat_compacted_fast_zero_batches():
+    from spark_rapids_tpu.exec.basic import _concat_compacted_fast
+    out = _concat_compacted_fast(_schema(), [])
+    assert out.num_rows_host() == 0
+    assert len(out.columns) == 2
+
+
+def test_concat_zero_row_compacted_batches():
+    """Three compacted batches with zero live rows each — the shape the
+    q7 streamed-broadcast join pumps when a partition's build side is
+    empty — must concat to an empty batch, not crash."""
+    import jax.numpy as jnp
+    from spark_rapids_tpu.exec.basic import concat_device_batches
+    tbl = pa.table({"a": pa.array([1, 2, 3, 4], pa.int64()),
+                    "s": pa.array(["x", "y", "z", "w"])})
+    batches = []
+    for _ in range(3):
+        b = C.host_to_device(tbl, bucket=4, min_bucket=4)
+        b = C.compact(b.with_sel(jnp.zeros(4, dtype=bool)))
+        assert b.compacted
+        batches.append(b)
+    out = concat_device_batches(batches[0].schema, batches)
+    assert out.num_rows_host() == 0
+
+
+def test_concat_mismatched_schema_raises_value_error():
+    """The q7 signature — a batch built against the wrong schema — must
+    surface as a diagnosable ValueError, not a bare IndexError."""
+    from spark_rapids_tpu.exec.basic import _concat_compacted_fast
+    good = C.host_to_device(
+        pa.table({"a": pa.array([1, 2], pa.int64()),
+                  "s": pa.array(["x", "y"])}))
+    bad = C.host_to_device(pa.table({"a": pa.array([3], pa.int64())}))
+    with pytest.raises(ValueError, match="does not match its declared"):
+        _concat_compacted_fast(_schema(), [good, bad, good, good])
+
+
+# ---------------------------------------------------------------------------
+# satellite 3: pad-mask correctness — padded vs unpadded bit-identical
+# ---------------------------------------------------------------------------
+
+# a ladder whose single rung swallows every small batch: padding is
+# FORCED on essentially every pumped batch
+PAD_CONF = {"spark.rapids.tpu.kernel.bucketing": "ladder",
+            "spark.rapids.tpu.kernel.bucketLadder": "8192",
+            "spark.rapids.tpu.kernel.maxPadFraction": 0.99}
+OFF_CONF = {"spark.rapids.tpu.kernel.bucketing": "off"}
+
+
+def _padded_vs_unpadded(df_builder, ignore_order=False,
+                        expect_padding=True):
+    before = shapes.snapshot()
+    padded = df_builder(tpu_session(PAD_CONF)).toArrow()
+    after = shapes.snapshot()
+    if expect_padding:
+        assert after[1] > before[1], "forced-padding conf never padded"
+    plain = df_builder(tpu_session(OFF_CONF)).toArrow()
+    # bit-identical: no approx_float escape hatch
+    assert_tables_equal(plain, padded, ignore_order=ignore_order)
+    return padded
+
+
+def test_padded_agg_null_heavy_skewed():
+    t = skewed_null_table(3000, seed=11, hot_mass=0.9, null_ratio=0.4)
+    _padded_vs_unpadded(
+        lambda s: s.createDataFrame(t).groupBy("k").agg(
+            F.sum(col("v")).alias("sv"),
+            F.count(col("s")).alias("cs"),
+            F.min(col("v")).alias("mn"),
+            F.max(col("s")).alias("mx")),
+        ignore_order=True)
+
+
+def test_padded_join_skewed_keys():
+    left = skewed_null_table(300, seed=5, hot_mass=0.5, null_ratio=0.3)
+    right = skewed_null_table(200, seed=9, hot_mass=0.5, null_ratio=0.3)
+    _padded_vs_unpadded(
+        lambda s: s.createDataFrame(left).join(
+            s.createDataFrame(right).withColumnRenamed("v", "v2")
+             .withColumnRenamed("s", "s2"),
+            on="k"),
+        ignore_order=True)
+
+
+def test_padded_sort_string_heavy():
+    t = dg.gen_table(
+        [dg.IntegerGen(min_val=0, max_val=9, null_ratio=0.2),
+         dg.StringGen(min_len=0, max_len=12, null_ratio=0.4),
+         dg.StringGen(min_len=1, max_len=4)],
+        1500, seed=3, names=["k", "s", "t"])
+    _padded_vs_unpadded(
+        lambda s: s.createDataFrame(t).orderBy("k", "s", "t"))
+
+
+def test_padded_window_null_heavy():
+    rng = np.random.default_rng(7)
+    t = pa.table({
+        "k": dg.IntegerGen(min_val=0, max_val=6,
+                           null_ratio=0.2).generate(rng, 900),
+        "o": dg.IntegerGen(min_val=-20, max_val=20).generate(rng, 900),
+        "v": dg.LongGen().generate(rng, 900),
+    })
+    w = Window.partitionBy("k").orderBy("o", "v")
+    _padded_vs_unpadded(
+        lambda s: s.createDataFrame(t).select(
+            "k", "o", "v",
+            F.row_number().over(w).alias("rn"),
+            F.rank().over(w).alias("rk")),
+        ignore_order=True)
+
+
+def test_padded_zero_row_query():
+    t = skewed_null_table(400, seed=2)
+    out = _padded_vs_unpadded(
+        lambda s: s.createDataFrame(t).filter(col("k") < -10**17)
+                   .groupBy("k").agg(F.sum(col("v")).alias("sv")),
+        ignore_order=True, expect_padding=False)
+    assert out.num_rows == 0
+
+
+def test_exact_bucket_boundary_is_a_hit():
+    """A capacity sitting exactly on a rung pads nothing — and the
+    results still match the bucketing-off run."""
+    t = dg.gen_table([dg.LongGen(nullable=False)], 1024, seed=6,
+                     names=["a"])
+    conf = {"spark.rapids.tpu.kernel.bucketing": "ladder",
+            "spark.rapids.tpu.kernel.bucketLadder": "1024,8192"}
+    before = shapes.snapshot()
+    padded = tpu_session(conf).createDataFrame(t) \
+        .orderBy("a").toArrow()
+    after = shapes.snapshot()
+    assert after[0] > before[0]          # hits moved
+    assert after[2] == before[2]         # zero pad rows
+    plain = tpu_session(OFF_CONF).createDataFrame(t) \
+        .orderBy("a").toArrow()
+    assert_tables_equal(plain, padded)
+
+
+# ---------------------------------------------------------------------------
+# stats plane: per-op padded_rows
+# ---------------------------------------------------------------------------
+
+def test_padded_rows_lands_in_stats():
+    t = skewed_null_table(1500, seed=3)
+    s = tpu_session(dict(PAD_CONF, **{
+        "spark.rapids.tpu.stats.enabled": True}))
+    s.createDataFrame(t).toArrow()
+    prof = s.last_query_profile()
+    padded = [r for r in prof["ops"] if r.get("padded_rows")]
+    assert padded, "no operator recorded padded_rows"
+    # scan emits a 2048-capacity batch -> padded to the 8192 rung
+    assert padded[0]["padded_rows"] == 8192 - 2048
+
+
+# ---------------------------------------------------------------------------
+# the point of it all: warm runs compile nothing
+# ---------------------------------------------------------------------------
+
+def _sweep(s, t):
+    return s.createDataFrame(t).groupBy("k").agg(
+        F.sum(col("v")).alias("sv")).orderBy("k").toArrow()
+
+
+def test_warm_second_run_compiles_nothing():
+    t = skewed_null_table(2000, seed=1)
+    s = tpu_session()  # bucketing defaults to pow2
+    first = _sweep(s, t)
+    c0 = KC.compile_snapshot()[0]
+    second = _sweep(s, t)
+    assert KC.compile_snapshot()[0] == c0, (
+        "warm identical sweep recompiled kernels")
+    assert_tables_equal(first, second)
+
+
+def test_session_warmup_report_and_idempotence():
+    s = tpu_session()
+    rep = s.warmup([lambda sess: sess.range(0, 2048)])
+    assert rep["plans"] == 1
+    assert rep["compiles"] >= 1
+    # warming the same plan again finds everything cached
+    rep2 = s.warmup([lambda sess: sess.range(0, 2048)])
+    assert rep2["compiles"] == 0
+
+
+def test_query_server_warmup_on_start():
+    from spark_rapids_tpu.runtime import scheduler as SCH
+    from spark_rapids_tpu.sql.server import QueryServer
+    SCH.reset_scheduler()
+    s = tpu_session()
+    srv = QueryServer(s, warmup_plans=[lambda sess: sess.range(0, 1024)])
+    try:
+        assert srv.warmup_report is not None
+        assert srv.warmup_report["plans"] == 1
+    finally:
+        srv.shutdown()
+    SCH.reset_scheduler()
+    s2 = tpu_session({"spark.rapids.tpu.kernel.warmupOnStart": False})
+    srv2 = QueryServer(s2, warmup_plans=[lambda sess: sess.range(0, 1024)])
+    try:
+        assert srv2.warmup_report is None
+    finally:
+        srv2.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# persistent compilation cache: manifest versioning
+# ---------------------------------------------------------------------------
+
+def test_sync_manifest_fresh_dir_writes_manifest(tmp_path):
+    d = str(tmp_path)
+    assert KC._sync_manifest(d) is False  # no manifest yet -> (re)stamp
+    mf = os.path.join(d, KC.MANIFEST_NAME)
+    assert os.path.exists(mf)
+    with open(mf) as f:
+        assert json.load(f) == KC._cache_versions()
+    # second sync: versions match, entries survive
+    entry = os.path.join(d, "xla_entry.bin")
+    with open(entry, "w") as f:
+        f.write("compiled")
+    assert KC._sync_manifest(d) is True
+    assert os.path.exists(entry)
+
+
+def test_sync_manifest_version_mismatch_clears_entries(tmp_path):
+    d = str(tmp_path)
+    KC._sync_manifest(d)
+    entry = os.path.join(d, "xla_entry.bin")
+    os.makedirs(os.path.join(d, "subdir"))
+    with open(entry, "w") as f:
+        f.write("compiled")
+    mf = os.path.join(d, KC.MANIFEST_NAME)
+    with open(mf) as f:
+        stamped = json.load(f)
+    stamped["jax"] = "0.0.0-stale"
+    with open(mf, "w") as f:
+        json.dump(stamped, f)
+    assert KC._sync_manifest(d) is False   # mismatch -> invalidate
+    assert not os.path.exists(entry)
+    assert not os.path.exists(os.path.join(d, "subdir"))
+    with open(mf) as f:
+        assert json.load(f) == KC._cache_versions()
+
+
+def test_persistent_cache_refuses_cpu_backend(tmp_path):
+    """XLA:CPU AOT entries crash the loader — the conf path must be a
+    no-op on the CPU backend (which is exactly what tier-1 runs on)."""
+    import jax
+    if jax.default_backend() != "cpu":
+        pytest.skip("TPU/GPU backend: persistent cache legitimately on")
+    got = KC.configure_persistent_cache(
+        tpu_session({"spark.rapids.tpu.kernel.cacheDir":
+                     str(tmp_path)}).conf.snapshot())
+    assert got is None
+    assert not os.listdir(str(tmp_path))
