@@ -363,6 +363,34 @@ SHUFFLE_MODE = (
     .create_with_default("MULTITHREADED")
 )
 
+EXCHANGE_MODE = (
+    conf("spark.rapids.tpu.exchange.mode")
+    .doc("ICI exchange transport: compiled (device-resident "
+         "prepare/boundary SPMD programs — shuffle is one collective "
+         "launch per stage seam), host (pin every exchange to the "
+         "host-shuffle transport, the collective domain's degrade "
+         "target), or auto (compiled when the mesh supports it). Only "
+         "consulted when spark.rapids.shuffle.mode=ICI.")
+    .category("shuffle")
+    .string()
+    .check(lambda v: v.lower() in ("compiled", "host", "auto"),
+           "one of compiled, host, auto")
+    .create_with_default("auto")
+)
+
+EXCHANGE_DONATE = (
+    conf("spark.rapids.tpu.exchange.donate")
+    .doc("Donate the sharded stage-input buffers to the compiled "
+         "exchange's boundary program, so the wire consumes them "
+         "instead of holding input and output co-resident in HBM. "
+         "Disable to keep inputs alive through the collective (e.g. "
+         "when debugging a mid-collective fault, at ~2x the exchange "
+         "working set).")
+    .category("shuffle")
+    .boolean()
+    .create_with_default(True)
+)
+
 SHUFFLE_THREADS = (
     conf("spark.rapids.shuffle.multiThreaded.writer.threads")
     .doc("Serializer thread pool size for MULTITHREADED shuffle.")
@@ -1250,6 +1278,10 @@ class RapidsConf:
     @property
     def shuffle_mode(self) -> str:
         return str(self.get(SHUFFLE_MODE)).upper()
+
+    @property
+    def exchange_mode(self) -> str:
+        return str(self.get(EXCHANGE_MODE)).lower()
 
     @property
     def ansi_enabled(self) -> bool:

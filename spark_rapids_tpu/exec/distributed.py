@@ -5,11 +5,23 @@
 point-to-point, the exchange runs ONE SPMD collective program over the
 device mesh (parallel/shuffle.py) and downstream operators then consume
 their partition's received rows locally, exactly like Spark reduce tasks
-after a shuffle fetch.  Stage shape on an N-device mesh:
+after a shuffle fetch.  Stage shape on an N-device mesh (the COMPILED exchange, the
+single-process default — ``spark.rapids.tpu.exchange.mode``):
 
   upstream partitions → gather+compact → row-shard over mesh
-    → {murmur3 pid → layout → all_to_all} (one jitted program)
+    → {murmur3 pid → rank → gather index table + counts}   (prepare)
+    → {slice → clip-gather → all_to_all → receive mask}    (boundary)
     → N output partitions, each device-local, capacity re-bucketed
+
+The *prepare* program runs once per accumulated stage input and emits
+both the routing table and the per-partition counts in one launch; the
+*boundary* program is the only launch on the stage seam — its input
+buffers are donated, and the host feeds it the transposed receive
+counts so no second collective runs.  Multi-executor mode keeps the
+two-phase count/shuffle agreement protocol (its rendezvous epochs are
+what make cross-process retry bit-identical); ``mode=host`` routes the
+exchange through the host-shuffle transport at plan time, which is
+also the degrade target for the ``collective`` failure domain.
 
 Activated by ``spark.rapids.shuffle.mode=ICI`` when the mesh has more
 than one device; the planner then splits aggregates into partial/final
@@ -41,6 +53,18 @@ _TM_COLLECTIVE_S = TM.REGISTRY.counter(
 _TM_ICI_BYTES = TM.REGISTRY.counter(
     "tpuq_ici_exchange_bytes_total",
     "bytes moved through ICI shuffle exchanges (global batch size)")
+_TM_ICI_EX_COLL_S = TM.REGISTRY.counter(
+    "tpuq_ici_exchange_collective_seconds_total",
+    "compiled-exchange boundary-program dispatch seconds")
+
+
+def exchange_opts(conf) -> dict:
+    """Conf-derived ICI-exchange constructor kwargs — every plan-time
+    construction site passes these through, so runtime behavior
+    (buffer donation) follows the session conf without each site
+    re-reading it."""
+    from spark_rapids_tpu import conf as C
+    return {"donate": bool(conf.get(C.EXCHANGE_DONATE))}
 
 
 def owned_partitions(plan) -> List[int]:
@@ -115,9 +139,8 @@ def _batch_from_shards(mesh, schema: T.StructType,
     shards (jax matches them to the global sharding by their committed
     devices); ``global_devices`` then sizes the global shape."""
     import jax
-    axis = mesh.axis_names[0]
-    sharding = jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec(axis))
+    from spark_rapids_tpu.parallel.mesh import named_sharding
+    sharding = named_sharding(mesh)
     d = global_devices or len(shards)
     flat = [jax.tree.flatten(s) for s in shards]
     treedef = flat[0][1]
@@ -160,13 +183,17 @@ class TpuIciShuffleExchangeExec(TpuExec):
 
     def __init__(self, child: TpuExec, keys: Sequence[Expression],
                  mesh=None, canon_int64: Sequence[bool] = (),
-                 min_bucket: int = 1024):
+                 min_bucket: int = 1024, donate: bool = True):
         super().__init__(child.schema, child)
         self.keys = list(keys)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.canon_int64 = tuple(canon_int64)
         self.min_bucket = min_bucket
+        self.donate = donate
         self._result: Optional[DeviceBatch] = None
+        # compiled path: per-partition received rows, known host-side
+        # from prepare's counts — execute() then needs no device sync
+        self._recv_counts: Optional[np.ndarray] = None
         self._empty = False
         # set when the collective degraded to the host-shuffle transport
         self._host_fallback = None
@@ -257,29 +284,49 @@ class TpuIciShuffleExchangeExec(TpuExec):
                 cached_kernel, fingerprint)
             base_key = self._base_key(schema)
             aux = self._aux_args(sharded)
-            with self.timer("partitionTime"):
-                count_fn = cached_kernel(
-                    ("ici_count",) + base_key, self._count_builder())
-                counts = np.asarray(count_fn(sharded, *aux))  # [d*d]
-                cap = round_up_pow2(max(int(counts.max()), 1), 8)
-            st = ST.current()
-            if st is not None:
-                # counts is per-source-device × per-partition: summing
-                # over sources gives the global partition sizes
-                st.record_partitions(
-                    self, counts.reshape(d, d).sum(axis=0), unit="rows")
-            # per-device collective working set: the [d*cap] layout and
-            # the [d*cap] received block
-            with mgr.transient(2 * d * cap * row_bytes):
-                t0 = time.perf_counter()
-                with self.timer("collectiveTime"):
-                    shuffle_fn = cached_kernel(
-                        ("ici_shuffle", cap) + base_key,
-                        self._shuffle_builder(cap))
-                    self._result = self._run_collective(
-                        shuffle_fn, sharded, aux)
-                _TM_COLLECTIVE_S.inc(time.perf_counter() - t0)
-                _TM_ICI_BYTES.inc(sharded.nbytes())
+            # the compiled exchange: ONE producer-side prepare launch
+            # (routing table + counts together), then ONE boundary
+            # launch on the stage seam — the all_to_all plus receive
+            # masking, with the input batch donated to the wire
+            with mgr.transient(4 * d * local_b):  # per-device idx table
+                with self.timer("partitionTime"):
+                    prep_fn = cached_kernel(
+                        ("ici_prepare",) + base_key,
+                        self._prepare_builder())
+                    idx, counts = prep_fn(sharded, *aux)
+                    counts_np = np.asarray(counts).reshape(d, d)
+                    cap = SH.exchange_cap(counts_np.max(), local_b)
+                st = ST.current()
+                if st is not None:
+                    # counts is per-source-device × per-partition:
+                    # summing over sources gives global partition sizes
+                    st.record_partitions(self, counts_np.sum(axis=0),
+                                         unit="rows")
+                # receive counts ride host→device: partition p's
+                # liveness needs counts FROM every source — a transpose
+                # on the host, not a second collective on the wire
+                from spark_rapids_tpu.parallel.mesh import named_sharding
+                crecv = jax.device_put(
+                    np.ascontiguousarray(counts_np.T.astype(np.int32)),
+                    named_sharding(self.mesh))
+                # per-device collective working set: the [d*cap]
+                # gathered leaves and the [d*cap] received block
+                with mgr.transient(2 * d * cap * row_bytes):
+                    nbytes = sharded.nbytes()  # before donation
+                    t0 = time.perf_counter()
+                    with self.timer("collectiveTime"):
+                        boundary_fn = cached_kernel(
+                            ("ici_boundary", cap, d, self.donate,
+                             fingerprint(schema)),
+                            self._boundary_builder(cap))
+                        self._result = self._run_collective(
+                            boundary_fn, sharded, (idx, crecv))
+                    dt = time.perf_counter() - t0
+                    _TM_COLLECTIVE_S.inc(dt)
+                    _TM_ICI_EX_COLL_S.inc(dt)
+                    _TM_ICI_BYTES.inc(nbytes)
+            if self._result is not None:
+                self._recv_counts = counts_np.sum(axis=0)
         return self._result
 
     # -- resilience: the ``collective`` failure domain ----------------------
@@ -329,7 +376,22 @@ class TpuIciShuffleExchangeExec(TpuExec):
         """Extra traced arguments for the count/shuffle programs."""
         return ()
 
+    def _prepare_builder(self):
+        """Compiled-path producer program (index table + counts)."""
+        return lambda: SH.build_prepare_program(
+            self.mesh, self.keys, self.nparts, self.canon_int64)
+
+    def _boundary_builder(self, cap: int):
+        """Compiled-path seam program — pid-agnostic, so the cache key
+        above deliberately drops the partitioning fingerprint: hash and
+        range exchanges with one schema share one boundary per cap."""
+        return lambda: SH.build_boundary_program(
+            self.mesh, self.nparts, cap, donate=self.donate)
+
     def _count_builder(self):
+        """Legacy two-phase count program (multi-executor path only —
+        its rendezvous epochs need per-shard counts a cross-process
+        count program could not make addressable)."""
         return lambda: SH.build_count_program(
             self.mesh, self.keys, self.nparts, self.canon_int64)
 
@@ -522,7 +584,14 @@ class TpuIciShuffleExchangeExec(TpuExec):
         # stage outputs stay device-resident for the next stage
         block = _local_shard(result, partition)
         block = compact(block)
-        n = block.num_rows_host()
+        if self._recv_counts is not None:
+            # compiled path: the receive count is already on the host
+            # (prepare's counts), so the seam→downstream handoff costs
+            # zero device syncs — the regroup fuses into the first
+            # downstream pump's dispatch chain
+            n = int(self._recv_counts[partition])
+        else:
+            n = block.num_rows_host()
         cap = round_up_pow2(max(n, 1), self.min_bucket)
         if cap < block.capacity:
             block = SH.slice_batch(block, 0, cap)
@@ -540,9 +609,11 @@ class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
     p+1's — a local per-partition sort then yields a TOTAL order.  The
     distribution mechanism for global Sort/Window-without-keys/TopN."""
 
-    def __init__(self, child: TpuExec, orders, mesh=None):
+    def __init__(self, child: TpuExec, orders, mesh=None,
+                 donate: bool = True):
         # keys only drive fingerprints/tagging; pids come from orders
-        super().__init__(child, [o.expr for o in orders], mesh=mesh)
+        super().__init__(child, [o.expr for o in orders], mesh=mesh,
+                         donate=donate)
         self.orders = list(orders)
         self._bounds: Optional[List[np.ndarray]] = None
 
@@ -610,6 +681,12 @@ class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
             self._bounds = self._sample_bounds(sharded)
         return (self._bounds,)
 
+    def _prepare_builder(self):
+        # the boundary program is pid-agnostic, so only prepare differs:
+        # range pids from the sampled boundary limbs (traced aux)
+        return lambda: SH.build_range_prepare_program(
+            self.mesh, self.orders, self.nparts)
+
     def _count_builder(self):
         return lambda: SH.build_range_count_program(
             self.mesh, self.orders, self.nparts)
@@ -627,8 +704,13 @@ class TpuIciRangeExchangeExec(TpuIciShuffleExchangeExec):
 
 
 def ici_active(conf) -> bool:
-    """ICI shuffle requested and a real mesh exists."""
+    """ICI shuffle requested, a real mesh exists, and the exchange is
+    not conf-pinned to the host transport (``exchange.mode=host`` keeps
+    ICI planning off entirely — exchanges then run the host-shuffle
+    transport and sort/window/aggregate skip the distributed split)."""
     if conf.shuffle_mode != "ICI":
+        return False
+    if conf.exchange_mode == "host":
         return False
     import jax
     return jax.device_count() > 1

@@ -14,7 +14,11 @@ via the shuffle manager.  Three transports, picked by
   (shuffle/exchange.py + the native tudo serializer), the
   works-everywhere default analog.
 * ICI — the SPMD ``lax.all_to_all`` collective over the device mesh
-  (exec/distributed.py + parallel/shuffle.py).
+  (exec/distributed.py + parallel/shuffle.py).  Within ICI,
+  ``spark.rapids.tpu.exchange.mode`` picks the transport: ``compiled``
+  / ``auto`` run the device-resident prepare/boundary programs;
+  ``host`` pins every exchange to the host-shuffle transport (the
+  degrade target) while keeping the rest of the plan single-device.
 """
 
 from __future__ import annotations
@@ -247,12 +251,18 @@ def _tag_exchange(meta):
 def _convert_exchange(cpu, ch, conf):
     from spark_rapids_tpu import conf as C
     from spark_rapids_tpu.exec.distributed import (
-        TpuIciShuffleExchangeExec, ici_active)
+        TpuIciShuffleExchangeExec, exchange_opts, ici_active)
     if ici_active(conf) and cpu.keys:
         import jax
         if cpu.nparts == jax.device_count():
-            return TpuIciShuffleExchangeExec(ch[0], cpu.keys)
-    if conf.shuffle_mode == "MULTITHREADED":
+            return TpuIciShuffleExchangeExec(ch[0], cpu.keys,
+                                             **exchange_opts(conf))
+    host_pinned = (conf.shuffle_mode == "ICI"
+                   and conf.exchange_mode == "host")
+    if conf.shuffle_mode == "MULTITHREADED" or host_pinned:
+        # exchange.mode=host under ICI: same plan shape, but the stage
+        # boundary runs the host-shuffle transport — the conf-selected
+        # fallback and the collective domain's degrade target
         from spark_rapids_tpu.shuffle.exchange import (
             TpuHostShuffleExchangeExec)
         exchange = TpuHostShuffleExchangeExec(

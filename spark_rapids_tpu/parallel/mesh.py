@@ -47,6 +47,18 @@ def make_mesh(n_devices: Optional[int] = None,
     return jax.sharding.Mesh(np.array(devs), (axis,))
 
 
+def named_sharding(mesh: jax.sharding.Mesh,
+                   spec: Optional[jax.sharding.PartitionSpec] = None
+                   ) -> jax.sharding.NamedSharding:
+    """Row-sharded ``NamedSharding`` over the mesh's shuffle axis — the
+    one placement every exchange array (batch leaves, index tables,
+    receive-count rows) uses.  ``spec`` overrides for replicated
+    operands (``PartitionSpec()``)."""
+    if spec is None:
+        spec = jax.sharding.PartitionSpec(mesh.axis_names[0])
+    return jax.sharding.NamedSharding(mesh, spec)
+
+
 def all_to_all_shuffle(mesh: jax.sharding.Mesh, parts: jax.Array
                        ) -> jax.Array:
     """The ICI shuffle exchange.

@@ -19,6 +19,26 @@ partition sizes: a cheap *count* program first measures the max rows any
 for the pow-2 bucket of that max (re-used across calls with the same
 bucket).  Worst-case skew (every row to one partition) stays correct —
 the bucket just grows.
+
+The COMPILED exchange (``spark.rapids.tpu.exchange.mode``) splits the
+stage seam differently — producer-side *prepare* vs seam-side
+*boundary* — so the collective program itself carries no partitioning
+work at all:
+
+* ``build_prepare_program`` — once per accumulated batch: murmur3 pids,
+  a sort-free stable within-partition rank (byte-packed uint64 chunked
+  cumsum — 8 partition counters ride one u64 lane, so ranking costs two
+  cumsums instead of a multi-operand ``lax.sort``), and ONE scatter that
+  inverts the ranks into a per-destination gather index table.  Emits
+  the [nparts·B] index table AND the per-partition counts in the same
+  launch — no separate count program, no second pass over the keys.
+* ``build_boundary_program`` — the only program on the stage seam:
+  slice the index table to the agreed cap, clip-mode gather every leaf,
+  one tiled ``lax.all_to_all`` over the mesh axis, receiver liveness
+  from host-fed receive counts.  Pid-agnostic (the index table already
+  encodes routing), so hash and range exchanges share one cached
+  program per (schema, cap) — and its input buffers are DONATED: the
+  sharded stage output is consumed by the wire, not copied across it.
 """
 
 from __future__ import annotations
@@ -42,6 +62,9 @@ from spark_rapids_tpu.runtime import telemetry as TM
 _TM_ICI_PROGRAMS = TM.REGISTRY.counter(
     "tpuq_ici_programs_built_total",
     "SPMD count/shuffle programs constructed (pre-compile)")
+_TM_ICI_EX_PROGRAMS = TM.REGISTRY.counter(
+    "tpuq_ici_exchange_programs_built_total",
+    "compiled-exchange SPMD programs constructed (prepare + boundary)")
 
 
 def _hash_f64_tpu_safe(data: jnp.ndarray, h: jnp.ndarray) -> jnp.ndarray:
@@ -266,13 +289,176 @@ def build_shuffle_program(mesh: jax.sharding.Mesh, keys, nparts: int,
                                  out_specs=spec))
 
 
+# ---------------------------------------------------------------------------
+# Compiled exchange: prepare (producer side) + boundary (stage seam)
+# ---------------------------------------------------------------------------
+
+# rows per ranking chunk: each destination's within-chunk count rides one
+# byte lane of a packed uint64, so a chunk may hold at most 255 rows
+_RANK_CHUNK = 128
+
+
+def exchange_cap(max_count: int, local_b: int) -> int:
+    """Wire-cell row capacity for a measured (device, partition) max.
+
+    NOT the pow-2 ladder the rest of the shape plane uses: every padded
+    row here is a row on the wire, and rounding 1.05× a bucket boundary
+    up to the next power of two would nearly double the collective's
+    bytes.  The exchange ladder steps at 1/32 of the enclosing pow-2
+    bucket (≤ ~3.2% pad), which still bounds distinct boundary-program
+    shapes to 32 per octave."""
+    mc = max(int(max_count), 1)
+    step = max(round_up_pow2(mc, 1) // 32, 8)
+    return min(-(-mc // step) * step, local_b)
+
+
+def _exchange_rank(pid: jnp.ndarray, sel: jnp.ndarray, nparts: int,
+                   b: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Stable within-partition rank of every live row + per-partition
+    live counts — sort-free.
+
+    Eight destinations pack into one uint64 (one byte lane each): an
+    intra-chunk inclusive cumsum of the packed one-hot encodings counts
+    all eight lanes at once, chunk totals unpack to int32 and a second
+    (tiny, [b/CH, lanes]) cumsum yields chunk base offsets.  Dead rows
+    encode as 0 — they advance no lane and get no rank.  Destinations
+    beyond 8 run as additional packed groups."""
+    ngroups = -(-nparts // 8)
+    ch = min(_RANK_CHUNK, b)
+    nch = b // ch
+    ranks, counts = [], []
+    for g in range(ngroups):
+        lanes = min(8, nparts - 8 * g)
+        lane = pid - 8 * g
+        in_g = sel & (lane >= 0) & (lane < 8)
+        lane_c = jnp.clip(lane, 0, 7).astype(jnp.uint64)
+        enc = jnp.where(in_g, jnp.uint64(1) << (jnp.uint64(8) * lane_c),
+                        jnp.uint64(0))
+        chunks = enc.reshape(nch, ch)
+        incl = jnp.cumsum(chunks, axis=1)
+        shifts = jnp.uint64(8) * jnp.arange(lanes, dtype=jnp.uint64)
+        tot = ((incl[:, -1][:, None] >> shifts[None, :])
+               & jnp.uint64(0xFF)).astype(jnp.int32)      # [nch, lanes]
+        base = jnp.cumsum(tot, axis=0) - tot              # chunk bases
+        excl = incl - chunks
+        lane_ch = lane_c.reshape(nch, ch)
+        within = ((excl >> (jnp.uint64(8) * lane_ch))
+                  & jnp.uint64(0xFF)).astype(jnp.int32)
+        cbase = jnp.take_along_axis(
+            base, jnp.clip(lane_ch.astype(jnp.int32), 0, lanes - 1),
+            axis=1)
+        ranks.append((within + cbase).reshape(b))
+        counts.append(base[-1] + tot[-1])
+    rank = ranks[0]
+    for g in range(1, ngroups):
+        rank = jnp.where(pid // 8 == g, ranks[g], rank)
+    return rank, jnp.concatenate(counts)[:nparts]
+
+
+def _prepare_index(batch: DeviceBatch, pid: jnp.ndarray, nparts: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(gather index table int32[nparts*B], live counts int32[nparts]).
+
+    Slot (p, r) holds the source row of partition p's r-th live row
+    (source order — the bit-identity contract), B beyond each count (a
+    clip-gather sentinel).  ONE scatter builds the table: live rows
+    write their slot, dead rows aim at distinct out-of-range slots and
+    drop, so the write set is provably unique."""
+    b = batch.capacity
+    rank, counts = _exchange_rank(pid, batch.sel, nparts, b)
+    iota = jnp.arange(b, dtype=jnp.int32)
+    slot = jnp.where(batch.sel, pid * b + rank, nparts * b + iota)
+    idx = jnp.full(nparts * b, b, jnp.int32).at[slot].set(
+        iota, mode="drop", unique_indices=True)
+    return idx, counts
+
+
+def build_prepare_program(mesh: jax.sharding.Mesh, keys, nparts: int,
+                          canon_int64=()):
+    """Producer-side compiled-exchange program: per device, the gather
+    index table + per-partition live counts, one launch, no sort."""
+    axis = mesh.axis_names[0]
+    pid_fn = make_pid_fn(keys, nparts, canon_int64)
+
+    def step(batch: DeviceBatch):
+        return _prepare_index(batch, pid_fn(batch), nparts)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    _TM_ICI_EX_PROGRAMS.inc()
+    # jit-exempt: mesh-bound shard_map SPMD program, cached per exchange
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(spec,),
+                             out_specs=(spec, spec)))
+
+
+def build_range_prepare_program(mesh: jax.sharding.Mesh, orders,
+                                nparts: int):
+    """RANGE flavor of the prepare program: boundary limbs ride as
+    traced, mesh-replicated arguments (data-dependent — never baked
+    into the cached executable)."""
+    axis = mesh.axis_names[0]
+    pid_fn = range_pid_fn(orders)
+
+    def step(batch: DeviceBatch, blimbs):
+        return _prepare_index(batch, pid_fn(batch, blimbs), nparts)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    rep = jax.sharding.PartitionSpec()
+    _TM_ICI_EX_PROGRAMS.inc()
+    # jit-exempt: mesh-bound shard_map SPMD program, cached per exchange
+    return jax.jit(shard_map(step, mesh=mesh, in_specs=(spec, rep),
+                             out_specs=(spec, spec)))
+
+
+def build_boundary_program(mesh: jax.sharding.Mesh, nparts: int,
+                           cap: int, donate: bool = True):
+    """The stage seam: ONE launch moves every leaf across the mesh.
+
+    Pid-agnostic — the prepare program's index table already encodes
+    routing, so hash and range exchanges share one cached boundary per
+    (schema, cap).  Per device: slice the index table to ``cap`` rows
+    per destination, clip-mode gather each leaf ([nparts·cap] cells,
+    the sentinel clips to a junk row hidden by the receive mask), one
+    tiled ``lax.all_to_all``, then liveness from the host-fed receive
+    counts (crecv[p][s] = rows partition p receives from source s —
+    known host-side from prepare's counts, so no extra collective).
+
+    ``donate`` hands the input batch's buffers to XLA: the stage output
+    backing the exchange is consumed by the wire instead of co-resident
+    with it.  Donated buffers are GONE after a dispatch that reached
+    XLA — the ``collective`` failure-domain injector fires BEFORE
+    dispatch, so transient-retry semantics hold; a real mid-collective
+    fault escalates past retry to the host-transport degrade, which
+    re-executes the child."""
+    axis = mesh.axis_names[0]
+
+    def step(batch: DeviceBatch, idx: jnp.ndarray, crecv: jnp.ndarray
+             ) -> DeviceBatch:
+        table = jax.lax.slice(idx.reshape(nparts, -1), (0, 0),
+                              (nparts, cap)).reshape(nparts * cap)
+
+        def move(x):
+            g = jnp.take(x, table, axis=0, mode="clip")
+            return jax.lax.all_to_all(g, axis, 0, 0, tiled=True)
+
+        cols = jax.tree.map(move, batch.columns)
+        recv = crecv.reshape(nparts)
+        live = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                < recv[:, None]).reshape(nparts * cap)
+        return DeviceBatch(batch.schema, cols, live)
+
+    spec = jax.sharding.PartitionSpec(axis)
+    prog = shard_map(step, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec)
+    _TM_ICI_EX_PROGRAMS.inc()
+    # jit-exempt: mesh-bound shard_map SPMD program, cached per exchange
+    return jax.jit(prog, donate_argnums=(0,) if donate else ())
+
+
 def shard_batch(mesh: jax.sharding.Mesh, batch: DeviceBatch) -> DeviceBatch:
     """Place a global batch row-sharded across the mesh (capacity must be
     divisible by the mesh size)."""
-    axis = mesh.axis_names[0]
-    sharding = jax.sharding.NamedSharding(
-        mesh, jax.sharding.PartitionSpec(axis))
-    return jax.device_put(batch, sharding)
+    from spark_rapids_tpu.parallel.mesh import named_sharding
+    return jax.device_put(batch, named_sharding(mesh))
 
 
 def split_to_spillables(batches, ids_fn, nbuckets: int, mgr, key: tuple,

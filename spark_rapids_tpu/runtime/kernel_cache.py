@@ -66,6 +66,18 @@ def fingerprint(v) -> object:
     return repr(v)
 
 
+def _jit_once(fn: Callable) -> Callable:
+    """jit ``fn`` unless the builder already did.
+
+    SPMD exchange programs come out of their builders pre-jitted with
+    ``donate_argnums`` — re-wrapping them would trace THROUGH the inner
+    pjit and silently drop the donation annotation (the outer jit's
+    donation set, empty, is the one that counts).  ``_cache_size`` is
+    the jit-wrapper attribute the compile detector below already keys
+    on, so its presence is the reliable already-jitted signal."""
+    return fn if hasattr(fn, "_cache_size") else jax.jit(fn)
+
+
 def _build_wrapper(key: tuple, builder: Callable[[], Callable]):
     """jit the built kernel through the ``compile`` failure domain.
 
@@ -74,11 +86,11 @@ def _build_wrapper(key: tuple, builder: Callable[[], Callable]):
     un-jitted builder output — eager per-op dispatch instead of one
     compiled executable."""
     if not R.active():
-        return jax.jit(builder())
+        return _jit_once(builder())
 
     def attempt():
         R.INJECTOR.on("compile")
-        return jax.jit(builder())
+        return _jit_once(builder())
 
     def degrade():
         return builder()

@@ -43,6 +43,15 @@ Rules (catalog in docs/static_analysis.md):
                       fingerprint cache, compile-storm telemetry, the
                       compile failure domain, and the persistent
                       on-disk cache (kernel.cacheDir)
+``exchange-purity``   host materialization (``device_get`` /
+                      ``np.asarray`` / ``.addressable_shards`` /
+                      ``num_rows_host``) inside the compiled
+                      exchange's ``build_*_program`` builders in
+                      parallel/shuffle.py | exec/distributed.py |
+                      exec/exchange.py — a stage seam must stay one
+                      device collective, host pulls reintroduce the
+                      round-trip the exchange plane was rebuilt to
+                      kill
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -198,6 +207,8 @@ def iter_modules(pkg_dir: Optional[str] = None) -> List[SourceModule]:
 def all_rules() -> List[Rule]:
     from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
     from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
+    from spark_rapids_tpu.utils.lint.exchange_purity import (
+        ExchangePurityRule)
     from spark_rapids_tpu.utils.lint.failure_domains import (
         FailureDomainRule)
     from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
@@ -208,7 +219,7 @@ def all_rules() -> List[Rule]:
         SchedulerBypassRule)
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
-            SchedulerBypassRule(), RawJitRule()]
+            SchedulerBypassRule(), RawJitRule(), ExchangePurityRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

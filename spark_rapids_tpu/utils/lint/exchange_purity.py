@@ -1,0 +1,83 @@
+"""``exchange-purity`` — compiled-exchange program builders stay device-pure.
+
+The compiled exchange's whole point is that a stage seam is ONE device
+collective: ``build_prepare_program`` / ``build_range_prepare_program``
+/ ``build_boundary_program`` (and the legacy count/shuffle builders)
+must construct SPMD programs without ever materializing data on the
+host.  A ``device_get`` / ``np.asarray`` / ``.addressable_shards`` pull
+inside a builder would either fail at trace time or silently reintroduce
+the host round-trip the exchange plane was rebuilt to kill — and it
+would do so on EVERY stage seam, which is exactly the 0.05 GB/s
+regression mode this PR's microbench guards against.
+
+Scope: function defs matching ``build_*_program`` (plus everything
+nested in them) inside the exchange plane's modules —
+``parallel/shuffle.py``, ``exec/distributed.py``, ``exec/exchange.py``.
+The generic ``host-sync-in-jit`` rule covers only jit-traced bodies;
+this rule also covers the builders' un-traced construction code, where
+a host pull is legal Python but still a seam-latency bug.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Optional, Set
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+SCOPE_FILES = (
+    "spark_rapids_tpu/parallel/shuffle.py",
+    "spark_rapids_tpu/exec/distributed.py",
+    "spark_rapids_tpu/exec/exchange.py",
+)
+BUILDER_RE = re.compile(r"^build_\w*_program$")
+
+SYNC_ATTRS = {"item", "block_until_ready", "addressable_shards",
+              "addressable_data"}
+NP_SYNC_FUNCS = {"asarray", "array", "ascontiguousarray"}
+HOST_FUNCS = {"device_get", "device_to_host", "num_rows_host"}
+
+
+class ExchangePurityRule(Rule):
+    name = "exchange-purity"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        if mod.rel not in SCOPE_FILES:
+            return ()
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and BUILDER_RE.match(node.name)):
+                for sub in ast.walk(node):
+                    msg = self._flag(sub)
+                    if msg and sub.lineno not in seen:
+                        seen.add(sub.lineno)
+                        out.append(Finding(
+                            self.name, mod.rel, sub.lineno,
+                            f"{msg} inside exchange program builder "
+                            f"`{node.name}` "
+                            f"(`{mod.snippet(sub.lineno)}`)"))
+        return out
+
+    def _flag(self, node) -> Optional[str]:
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("addressable_shards", "addressable_data"):
+                return f".{node.attr} host shard access"
+            return None
+        if not isinstance(node, ast.Call):
+            return None
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in ("item", "block_until_ready"):
+                return f".{f.attr}() host sync"
+            if (f.attr in NP_SYNC_FUNCS
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ("np", "numpy", "onp")):
+                return f"np.{f.attr} host materialization"
+            if f.attr in HOST_FUNCS:
+                return f".{f.attr}() host materialization"
+        elif isinstance(f, ast.Name) and f.id in HOST_FUNCS:
+            return f"{f.id}() host materialization"
+        return None

@@ -1,0 +1,189 @@
+"""Compiled exchange vs host-shuffle transport: bit-identity matrix.
+
+The compiled exchange (prepare + boundary SPMD programs) must deliver
+EXACTLY the rows, order and validity the host transport delivers — per
+receiving partition, across partition counts, skew shapes, null ratios
+and zero-row partitions.  Anything else would make
+``spark.rapids.tpu.exchange.mode`` an answer-changing switch.
+
+Contract note: row order per receiving partition is [source 0's rows,
+source 1's rows, ...] each in source order — identical to the host
+transport when the child has at most mesh-size partitions (one source
+per device), which is how these fixtures are built.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar import dtypes as T
+from spark_rapids_tpu.ops.expressions import BoundReference
+from spark_rapids_tpu.utils.datagen import (DoubleGen, SkewedLongGen,
+                                            gen_table, skewed_null_table)
+
+
+def _schema(table: pa.Table) -> T.StructType:
+    return T.StructType(tuple(
+        T.StructField(f.name, T.from_arrow(f.type)) for f in table.schema))
+
+
+def _tables():
+    n = 4000
+    skew_nulls = skewed_null_table(n, seed=3)
+    skew_gen = gen_table(
+        [SkewedLongGen(hot_keys=1, hot_mass=0.9, distinct=10_000,
+                       nullable=False),
+         DoubleGen(no_nans=True)], n, seed=7, names=["k", "v"])
+    rng = np.random.default_rng(9)
+    # constant key: every row hashes to ONE partition — all the other
+    # receiving partitions are zero-row
+    const_key = pa.table({"k": pa.array([7] * n, pa.int64()),
+                          "v": pa.array(rng.uniform(-10, 10, n))})
+    return {"skewed_null_table": skew_nulls, "skewed_long": skew_gen,
+            "constant_key": const_key}
+
+
+def _partitions(ex):
+    """Per-partition arrow tables, in partition order."""
+    from spark_rapids_tpu.columnar.column import device_to_host
+    out = []
+    for p in range(ex.num_partitions()):
+        got = [device_to_host(b) for b in ex.execute(p)]
+        out.append(pa.concat_tables(got) if got
+                   else ex_empty_table(ex.schema))
+    return out
+
+
+def ex_empty_table(schema: T.StructType):
+    return pa.table({f.name: pa.array([], T.to_arrow(f.dtype))
+                     for f in schema.fields})
+
+
+def _build_pair(table: pa.Table, d: int, donate: bool = True):
+    from spark_rapids_tpu.exec.basic import TpuScanExec
+    from spark_rapids_tpu.exec.distributed import TpuIciShuffleExchangeExec
+    from spark_rapids_tpu.parallel.mesh import make_mesh
+    from spark_rapids_tpu.shuffle.exchange import TpuHostShuffleExchangeExec
+    schema = _schema(table)
+    keys = [BoundReference(0, schema.fields[0].dtype)]
+    # child partitions == mesh size: one source per device, the layout
+    # under which compiled and host transports agree on row order
+    ici = TpuIciShuffleExchangeExec(
+        TpuScanExec(table, schema, num_partitions=d),
+        keys, mesh=make_mesh(d), donate=donate)
+    host = TpuHostShuffleExchangeExec(
+        TpuScanExec(table, schema, num_partitions=d), d, keys=keys)
+    return ici, host
+
+
+@pytest.mark.parametrize("d", [1, 2, 8])
+@pytest.mark.parametrize("name", ["skewed_null_table", "skewed_long",
+                                  "constant_key"])
+def test_compiled_exchange_bit_identical_to_host(name, d):
+    import jax
+    if d > jax.device_count():
+        pytest.skip(f"needs {d} devices")
+    table = _tables()[name]
+    ici, host = _build_pair(table, d)
+    got = _partitions(ici)
+    exp = _partitions(host)
+    assert len(got) == len(exp) == d
+    total = 0
+    for p, (a, b) in enumerate(zip(got, exp)):
+        assert a.schema.names == b.schema.names
+        assert a.num_rows == b.num_rows, (name, d, p)
+        assert a.equals(b), (
+            f"{name} d={d} partition {p}: compiled exchange diverged "
+            "from the host transport")
+        total += a.num_rows
+    assert total == table.num_rows
+    if name == "constant_key" and d > 1:
+        # the whole table landed on one partition; the rest are zero-row
+        assert sorted(t.num_rows for t in got)[:-1] == [0] * (d - 1)
+
+
+def test_compiled_exchange_without_donation_matches():
+    import jax
+    if jax.device_count() < 2:
+        pytest.skip("needs 2 devices")
+    table = _tables()["skewed_null_table"]
+    ici, host = _build_pair(table, 2, donate=False)
+    for a, b in zip(_partitions(ici), _partitions(host)):
+        assert a.equals(b)
+
+
+def test_exchange_rank_grouped_lanes():
+    """nparts > 8 exercises the multi-group packed-u64 ranking path."""
+    from spark_rapids_tpu.parallel.shuffle import _exchange_rank
+    b, nparts = 1024, 12
+    rng = np.random.default_rng(5)
+    pid_np = rng.integers(0, nparts, b)
+    sel_np = rng.random(b) < 0.8
+    import jax.numpy as jnp
+    rank, counts = _exchange_rank(
+        jnp.asarray(pid_np, jnp.int32), jnp.asarray(sel_np), nparts, b)
+    rank, counts = np.asarray(rank), np.asarray(counts)
+    exp_counts = np.bincount(pid_np[sel_np], minlength=nparts)
+    np.testing.assert_array_equal(counts, exp_counts)
+    seen = np.zeros(nparts, np.int64)
+    for i in range(b):
+        if sel_np[i]:
+            assert rank[i] == seen[pid_np[i]], i
+            seen[pid_np[i]] += 1
+
+
+def test_exchange_mode_conf_selects_transport():
+    """exchange.mode=host pins ICI plans to the host transport;
+    compiled (and auto) keep the device collective."""
+    rng = np.random.default_rng(2)
+    t = pa.table({"k": pa.array(rng.integers(0, 50, 2000)),
+                  "v": pa.array(rng.uniform(0, 1, 2000))})
+    from spark_rapids_tpu.plan.overrides import apply_overrides
+    from spark_rapids_tpu.plan.planner import plan_physical
+    from spark_rapids_tpu.sql.session import TpuSession
+
+    import jax
+
+    def tree_for(mode):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.shuffle.mode": "ICI",
+                        "spark.rapids.tpu.exchange.mode": mode})
+        # the ICI exchange only converts at nparts == mesh size
+        df = s.createDataFrame(t).repartition(jax.device_count(), "k")
+        rc = s.rapids_conf()
+        return apply_overrides(plan_physical(df._plan, rc),
+                               rc).plan.tree_string()
+
+    host_tree = tree_for("host")
+    assert "TpuHostShuffleExchange" in host_tree, host_tree
+    assert "TpuIciShuffleExchange" not in host_tree, host_tree
+    compiled_tree = tree_for("compiled")
+    assert "TpuIciShuffleExchange" in compiled_tree, compiled_tree
+    auto_tree = tree_for("auto")
+    assert "TpuIciShuffleExchange" in auto_tree, auto_tree
+
+
+def test_exchange_mode_host_matches_compiled_results():
+    """End to end through the DataFrame API: the two modes return the
+    same aggregate answer."""
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.session import TpuSession
+    t = skewed_null_table(3000, seed=1)
+
+    def run(mode):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.shuffle.mode": "ICI",
+                        "spark.rapids.tpu.exchange.mode": mode})
+        rows = (s.createDataFrame(t).groupBy("k")
+                .agg(F.sum("v").alias("sv"), F.count("*").alias("c"))
+                .toArrow().to_pylist())
+        import math
+
+        def norm(v):
+            if v is None:
+                return "null"
+            return "nan" if math.isnan(v) else round(v, 9)
+
+        return sorted((r["k"], r["c"], norm(r["sv"])) for r in rows)
+
+    assert run("compiled") == run("host")

@@ -188,6 +188,7 @@ def test_every_conf_key_is_consumed():
         "TEST_ALLOWED_NON_GPU": "allowed_non_gpu",
         "BATCH_ROWS": "batch_rows", "MIN_BUCKET_ROWS": "min_bucket_rows",
         "SHUFFLE_MODE": "shuffle_mode",
+        "EXCHANGE_MODE": "exchange_mode",
         "SHUFFLE_PARTITIONS": "shuffle_partitions",
         "ANSI_ENABLED": "ansi_enabled",
     }

@@ -641,6 +641,69 @@ def test_raw_jit_jit_exempt_requires_reason():
 
 
 # ---------------------------------------------------------------------------
+# exchange-purity
+# ---------------------------------------------------------------------------
+
+def test_exchange_purity_flags_host_pulls_in_builders():
+    from spark_rapids_tpu.utils.lint.exchange_purity import (
+        ExchangePurityRule)
+    m = _mod("spark_rapids_tpu/parallel/shuffle.py", """
+        import jax
+        import numpy as np
+
+        def build_boundary_program(mesh, nparts, cap):
+            def step(batch):
+                counts = np.asarray(batch.sel)
+                jax.device_get(batch.columns)
+                for s in batch.columns[0].data.addressable_shards:
+                    pass
+                return batch
+            return step
+        """)
+    out = _run([ExchangePurityRule()], m)
+    assert [f.rule for f in out] == ["exchange-purity"] * 3
+    assert "build_boundary_program" in out[0].message
+
+
+def test_exchange_purity_scope_and_clean_builders():
+    from spark_rapids_tpu.utils.lint.exchange_purity import (
+        ExchangePurityRule)
+    # host pulls OUTSIDE builders (and outside the scoped files) are the
+    # other rules' business, not this one's
+    clean = _mod("spark_rapids_tpu/exec/distributed.py", """
+        import numpy as np
+
+        def build_prepare_program(mesh, keys, nparts):
+            def step(batch):
+                return batch
+            return step
+
+        def materialize(counts):
+            return np.asarray(counts)
+        """)
+    elsewhere = _mod("spark_rapids_tpu/exec/agg.py", """
+        import numpy as np
+
+        def build_agg_program(x):
+            return np.asarray(x)
+        """)
+    assert _run([ExchangePurityRule()], clean, elsewhere) == []
+
+
+def test_exchange_purity_exemption():
+    from spark_rapids_tpu.utils.lint.exchange_purity import (
+        ExchangePurityRule)
+    m = _mod("spark_rapids_tpu/exec/exchange.py", """
+        import numpy as np
+
+        def build_shuffle_program(mesh):
+            # lint: exempt(exchange-purity): degrade-path diagnostics
+            return np.asarray(mesh)
+        """)
+    assert _run([ExchangePurityRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the real tree is clean
 # ---------------------------------------------------------------------------
 
