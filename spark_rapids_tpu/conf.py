@@ -1054,6 +1054,38 @@ KERNEL_MAX_PAD_FRACTION = (
     .create_with_default(0.75)
 )
 
+KERNEL_BACKEND = (
+    conf("spark.rapids.tpu.kernel.backend")
+    .doc("Kernel-plane backend for the fused hash-join / segmented-sort "
+         "/ hash-agg kernels: 'jnp' is the pure jax.numpy reference, "
+         "'fused' the single-program XLA hash/tiled-rank kernels, "
+         "'pallas' adds the Mosaic VPU hash kernel (TPU only), 'auto' "
+         "picks pallas on TPU and fused elsewhere (except sort, whose "
+         "tiled form only pays on TPU). Non-jnp backends degrade down "
+         "the pallas>fused>jnp ladder on detected 64-bit hash "
+         "collisions or unhashable keys, so results are always exact. "
+         "See docs/kernels.md.")
+    .category("kernel")
+    .string()
+    .check(lambda v: str(v).lower() in ("auto", "pallas", "fused", "jnp"),
+           "one of auto, pallas, fused, jnp")
+    .create_with_default("auto")
+)
+
+EXEC_PUMP_DEPTH = (
+    conf("spark.rapids.tpu.exec.pumpDepth")
+    .doc("Batches kept in flight by the double-buffered exec pump: each "
+         "operator's output iterator is pre-pulled up to this depth so "
+         "JAX async dispatch overlaps the producer's H2D/compute with "
+         "the consumer's compute/D2H. 1 disables prefetch. Bounded "
+         "small on purpose — holding all outputs alive costs ~60% "
+         "exchange bandwidth (utils/exchange_bench.py).")
+    .category("kernel")
+    .integer()
+    .check(lambda v: 1 <= int(v) <= 8, "in [1, 8]")
+    .create_with_default(2)
+)
+
 KERNEL_WARMUP_ON_START = (
     conf("spark.rapids.tpu.kernel.warmupOnStart")
     .doc("QueryServer construction pre-executes the warmup plans handed "

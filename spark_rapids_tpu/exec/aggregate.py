@@ -114,24 +114,44 @@ def segment_groupby(
     sel: jnp.ndarray,
     value_cols: Sequence[Tuple[DeviceColumn, str]],
     has_nans: bool = True,
-) -> Tuple[List[DeviceColumn], List[DeviceColumn], jnp.ndarray]:
+    backend: str = "jnp",
+) -> Tuple[List[DeviceColumn], List[DeviceColumn], jnp.ndarray,
+           Optional[jnp.ndarray]]:
     """Group rows by keys; reduce values by kind ('sum'|'min'|'max'|'first').
 
-    Returns (out_key_cols, out_value_cols, out_sel) — groups compacted to
-    the front, capacity unchanged (static shape).  Scatter-free: one
-    stable sort, segmented scans, and a second sort that compacts each
-    group's END row (which holds the full-segment scan result) to the
-    front in group order.
+    Returns (out_key_cols, out_value_cols, out_sel, ok) — groups
+    compacted to the front, capacity unchanged (static shape).
+    Scatter-free: one stable sort, segmented scans, and a second sort
+    that compacts each group's END row (which holds the full-segment
+    scan result) to the front in group order.
+
+    ``backend`` selects the group-layout kernel: the non-jnp rungs
+    (kernels.hash_agg) sort ONE 64-bit hash limb instead of the full
+    fused key encoding — group order becomes hash order (undefined in
+    Spark for a hash aggregate), content is identical.  ``ok`` follows
+    the kernel-plane dispatch protocol: None when the reference layout
+    ran; a device bool (False = 64-bit hash collision between distinct
+    keys, caller must fall back) from the fused rungs.
     """
     b = int(sel.shape[0])
-    limbs, _ = ORD.group_sort_limbs(list(key_cols), sel)
-    sorted_limbs, perm = ORD.sort_by_keys(limbs)
-
-    live_sorted = jnp.take(sel, perm)
-    diff = jnp.zeros((b,), jnp.bool_)
-    for l in sorted_limbs:
-        diff = diff | ORD.limb_neq(l, jnp.concatenate([l[:1], l[:-1]]))
-    boundary = diff.at[0].set(True)  # row 0 always starts a group
+    limbs, key_limbs = ORD.group_sort_limbs(list(key_cols), sel)
+    okf = None
+    res = None
+    if backend != "jnp":
+        from spark_rapids_tpu.kernels import hash_agg as KNA
+        res = KNA.group_layout_fused(
+            key_limbs, use_pallas=(backend == "pallas"))
+    if res is not None:
+        perm, sorted_limbs, boundary, okf = res
+        live_sorted = jnp.take(sel, perm)
+    else:
+        sorted_limbs, perm = ORD.sort_by_keys(limbs)
+        live_sorted = jnp.take(sel, perm)
+        diff = jnp.zeros((b,), jnp.bool_)
+        for l in sorted_limbs:
+            diff = diff | ORD.limb_neq(
+                l, jnp.concatenate([l[:1], l[:-1]]))
+        boundary = diff.at[0].set(True)  # row 0 always starts a group
     num_groups = jnp.sum((boundary & live_sorted).astype(jnp.int32))
 
     # group END rows hold the completed segment reductions
@@ -249,7 +269,7 @@ def segment_groupby(
                                      to_front(validity), None))
 
     out_sel = jnp.arange(b, dtype=jnp.int32) < num_groups
-    return out_keys, out_vals, out_sel
+    return out_keys, out_vals, out_sel, okf
 
 
 class _ScanBatcher:
@@ -722,26 +742,37 @@ class TpuHashAggregateExec(TpuExec):
                  pre_key=()) -> DeviceBatch:
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
+        from spark_rapids_tpu import kernels as KN
         grouping, fns = self.grouping, self.fns
         buffer_schema = self._buffer_schema()
         has_nans = self.has_nans
 
-        def build():
+        def build(backend):
             def run(b):
                 if pre is not None:
                     b = pre(b)
                 keys = [g.eval_tpu(b) for g in grouping]
                 vals = update_value_cols(fns, b)
-                ok, ov, sel = segment_groupby(keys, b.sel, vals,
-                                              has_nans=has_nans)
+                ok, ov, sel, okf = segment_groupby(
+                    keys, b.sel, vals, has_nans=has_nans,
+                    backend=backend)
                 return DeviceBatch(buffer_schema, tuple(ok + ov), sel,
-                                   compacted=True)
+                                   compacted=True), okf
             return run
 
-        fn = cached_kernel(
-            ("agg_partial", pre_key, has_nans, fingerprint(grouping),
-             fingerprint(fns)), build)
-        return fn(batch)
+        base_key = ("agg_partial", pre_key, has_nans,
+                    fingerprint(grouping), fingerprint(fns))
+        be = KN.resolve("agg")
+
+        def runner(backend):
+            # the jnp key stays the historical one so persistent cache
+            # entries from older builds keep hitting
+            key = (base_key if backend == "jnp"
+                   else base_key + (backend,))
+            fn = cached_kernel(key, lambda: build(backend))
+            return lambda: fn(batch)
+
+        return KN.dispatch("agg", be, runner, node=self)
 
     def _buffer_schema(self) -> T.StructType:
         fields = [T.StructField(f"k{i}", g.dtype)
@@ -824,8 +855,12 @@ class TpuHashAggregateExec(TpuExec):
                     keys = [g.eval_tpu(m) for g in grouping]
                     normal = [f for f in fns if not is_holistic_fn(f)]
                     vals = update_value_cols(normal, m)
-                    ok, ov, sel = segment_groupby(keys, m.sel, vals,
-                                                  has_nans=has_nans)
+                    # stays on the jnp layout: the sibling segment_*
+                    # helpers key-sort independently and the output
+                    # columns are zipped positionally — all layouts
+                    # must agree on group order
+                    ok, ov, sel, _ = segment_groupby(keys, m.sel, vals,
+                                                     has_nans=has_nans)
                     normal_res = iter(final_project(normal, ov))
                     cols = list(ok)
                     for f in fns:
@@ -1158,52 +1193,68 @@ class TpuHashAggregateExec(TpuExec):
         the partial-side local combine."""
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
+        from spark_rapids_tpu import kernels as KN
         grouping, fns = self.grouping, self.fns
         nk = len(grouping)
         buffer_schema = self._buffer_schema()
         has_nans = self.has_nans
 
-        def build():
+        def build(backend):
             def run(m):
                 keys = list(m.columns[:nk])
                 bufs = list(m.columns[nk:])
                 kinds = merge_kinds(fns)
-                ok, ov, sel = segment_groupby(
+                ok, ov, sel, okf = segment_groupby(
                     keys, m.sel, list(zip(bufs, kinds)),
-                    has_nans=has_nans)
+                    has_nans=has_nans, backend=backend)
                 return DeviceBatch(buffer_schema, tuple(ok + ov), sel,
-                                   compacted=True)
+                                   compacted=True), okf
             return run
 
-        fn = cached_kernel(
-            ("agg_merge_buffers", has_nans, fingerprint(grouping),
-             fingerprint(fns)), build)
-        return fn(merged)
+        base_key = ("agg_merge_buffers", has_nans,
+                    fingerprint(grouping), fingerprint(fns))
+        be = KN.resolve("agg")
+
+        def runner(backend):
+            key = (base_key if backend == "jnp"
+                   else base_key + (backend,))
+            fn = cached_kernel(key, lambda: build(backend))
+            return lambda: fn(merged)
+
+        return KN.dispatch("agg", be, runner, node=self)
 
     def _merge_final(self, merged: DeviceBatch) -> DeviceBatch:
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
+        from spark_rapids_tpu import kernels as KN
         grouping, fns, schema = self.grouping, self.fns, self.schema
         nk = len(grouping)
         has_nans = self.has_nans
 
-        def build():
+        def build(backend):
             def run(m):
                 keys = list(m.columns[:nk])
                 bufs = list(m.columns[nk:])
                 kinds = merge_kinds(fns)
-                ok, ov, sel = segment_groupby(
+                ok, ov, sel, okf = segment_groupby(
                     keys, m.sel, list(zip(bufs, kinds)),
-                    has_nans=has_nans)
+                    has_nans=has_nans, backend=backend)
                 results = final_project(fns, ov)
                 return DeviceBatch(schema, tuple(ok + results), sel,
-                                   compacted=True)
+                                   compacted=True), okf
             return run
 
-        fn = cached_kernel(
-            ("agg_merge", has_nans, fingerprint(grouping),
-             fingerprint(fns), fingerprint(schema)), build)
-        return fn(merged)
+        base_key = ("agg_merge", has_nans, fingerprint(grouping),
+                    fingerprint(fns), fingerprint(schema))
+        be = KN.resolve("agg")
+
+        def runner(backend):
+            key = (base_key if backend == "jnp"
+                   else base_key + (backend,))
+            fn = cached_kernel(key, lambda: build(backend))
+            return lambda: fn(merged)
+
+        return KN.dispatch("agg", be, runner, node=self)
 
     def _reduce_batch(self, batch: DeviceBatch, pre=None, pre_key=(),
                       final: bool = False) -> DeviceBatch:

@@ -14,11 +14,13 @@ reference's operator pipelining, SURVEY.md §2.3).
 
 from __future__ import annotations
 
+import collections
 import functools
 import threading
 import time
 from typing import Dict, Iterator, Tuple
 
+from spark_rapids_tpu import kernels
 from spark_rapids_tpu.columnar import dtypes as T
 from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import shapes
@@ -148,6 +150,29 @@ def _shape_pump(node: "ExecNode", it: Iterator) -> Iterator:
         yield batch
 
 
+def _prefetch_pump(it: Iterator, depth: int) -> Iterator:
+    """Double-buffered pump (kernel plane): keep up to ``depth``
+    batches in flight ahead of the consumer.
+
+    JAX dispatch is async — pulling batch N+1 from the producer while
+    the consumer still holds batch N enqueues N+1's transfers and
+    kernels behind N's, so H2D copy, compute, and D2H readback overlap
+    across consecutive batches instead of serializing on each host
+    sync.  Only the in-flight window (``depth`` batches) is kept
+    alive; ``spark.rapids.tpu.exec.pumpDepth`` = 1 disables it."""
+    buf: collections.deque = collections.deque()
+    exhausted = False
+    while True:
+        while not exhausted and len(buf) < depth:
+            try:
+                buf.append(next(it))
+            except StopIteration:  # PEP 479: never leaks out of a gen
+                exhausted = True
+        if not buf:
+            return
+        yield buf.popleft()
+
+
 def _stats_pump(st, node: "ExecNode", it: Iterator) -> Iterator:
     """Record every yielded batch on the query's OpStatsCollector —
     rows/batches/bytes out per node, the observation side of the stats
@@ -165,6 +190,12 @@ def _wrap_execute(fn):
     @functools.wraps(fn)
     def execute(self, partition: int) -> Iterator:
         it = fn(self, partition)
+        depth = kernels.current_policy().pump_depth
+        if depth > 1 and isinstance(self, TpuExec):
+            # innermost of all: the producer runs ahead of every
+            # downstream pump so its async dispatches overlap the
+            # consumer's work
+            it = _prefetch_pump(it, depth)
         if shapes.current_policy().enabled and isinstance(self, TpuExec):
             # innermost: downstream pumps (and consumers) see the
             # bucketed batch

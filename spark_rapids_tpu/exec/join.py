@@ -758,9 +758,17 @@ class TpuSortMergeJoinExec(TpuExec):
     def _match_ranges(self, lb, rb):
         """Sort right side; binary-search match ranges for left rows.
 
-        One cached jitted kernel per (keys, schemas) pair."""
+        One cached jitted kernel per (keys, schemas, backend) triple.
+        The fused/pallas rungs route through kernels.hash_join (one
+        hash limb sorted + one single-limb bisection) and fall back to
+        the exact lexicographic reference on a detected 64-bit
+        collision; the (m, lo, perm, l_null) contract is unchanged —
+        within a match range both layouts enumerate the same right rows
+        in the same (original-index) order, so _merge_join's output is
+        byte-identical."""
         from spark_rapids_tpu.runtime.kernel_cache import (
             cached_kernel, fingerprint)
+        from spark_rapids_tpu import kernels as KN
         left_keys, right_keys = self.left_keys, self.right_keys
         # shared static string width per key pair: canonical layouts on
         # the two sides must match even when batch paddings differ
@@ -768,13 +776,25 @@ class TpuSortMergeJoinExec(TpuExec):
             max(_key_str_width(lb, le), _key_str_width(rb, re))
             for le, re in zip(left_keys, right_keys))
 
-        def build():
+        def build(backend):
             def run(lb, rb):
                 r_parts, r_null = _key_parts(rb, right_keys, widths)
                 r_excl = (~rb.sel) | r_null
+                l_parts, l_null = _key_parts(lb, left_keys, widths)
+                l_live = lb.sel & ~l_null
+                if backend != "jnp":
+                    from spark_rapids_tpu.kernels import hash_join as KNJ
+                    res = KNJ.match_fused(
+                        ORD.fuse_parts(l_parts), ORD.fuse_parts(r_parts),
+                        r_excl, use_pallas=(backend == "pallas"))
+                    if res is not None:
+                        m, lo, perm, okf = res
+                        m = jnp.where(l_live, m, 0)
+                        return (m, lo, perm, l_null), okf
+                    # unhashable keys (raw-f64 limb): reference runs
+                    # inside this rung; ok=None ⇒ dispatch counts "jnp"
                 sorted_limbs, perm = ORD.sort_by_keys(ORD.fuse_parts(
                     [ORD._flag_part(r_excl)] + r_parts))
-                l_parts, l_null = _key_parts(lb, left_keys, widths)
                 # canonical encoding ⇒ identical part widths on both
                 # sides ⇒ identical fused limb layout, compare 1:1
                 q_zero = ORD._flag_part(
@@ -782,17 +802,24 @@ class TpuSortMergeJoinExec(TpuExec):
                 q_limbs = ORD.fuse_parts([q_zero] + l_parts)
                 lo = _lex_search(sorted_limbs, q_limbs, "left")
                 hi = _lex_search(sorted_limbs, q_limbs, "right")
-                m = hi - lo
-                l_live = lb.sel & ~l_null
-                m = jnp.where(l_live, m, 0)
-                return m, lo, perm, l_null
+                m = jnp.where(l_live, hi - lo, 0)
+                return (m, lo, perm, l_null), None
             return run
 
-        fn = cached_kernel(
-            ("join_match", widths, fingerprint(left_keys),
-             fingerprint(right_keys),
-             fingerprint(lb.schema), fingerprint(rb.schema)), build)
-        return fn(lb, rb)
+        base_key = ("join_match", widths, fingerprint(left_keys),
+                    fingerprint(right_keys),
+                    fingerprint(lb.schema), fingerprint(rb.schema))
+        be = KN.resolve("join")
+
+        def runner(backend):
+            # the jnp key stays the historical one so persistent cache
+            # entries from older builds keep hitting
+            key = (base_key if backend == "jnp"
+                   else base_key + (backend,))
+            fn = cached_kernel(key, lambda: build(backend))
+            return lambda: fn(lb, rb)
+
+        return KN.dispatch("join", be, runner, node=self)
 
     def _merge_join(self, lb, rb, jt):
         m, lo, perm, l_null = self._match_ranges(lb, rb)

@@ -229,27 +229,36 @@ def _range_ids(batch: DeviceBatch, orders: Sequence[SortOrder],
     return range_pid_fn(orders)(batch, bounds)
 
 
-def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder]
-               ) -> DeviceBatch:
+def sort_batch(batch: DeviceBatch, orders: Sequence[SortOrder],
+               node=None) -> DeviceBatch:
     """Stable sort of live rows by the given orders; dead rows to the end.
 
-    One cached jitted kernel per (orders, schema) — compiles once per
-    bucket and stays hot across queries."""
+    One cached jitted kernel per (orders, schema, backend) — compiles
+    once per bucket and stays hot across queries.  The kernel plane's
+    segmented sort (bucket-local rank merge) rides the non-jnp
+    backends; it is exact, so the backend choice is static — no
+    run-time fallback rung."""
+    from spark_rapids_tpu import kernels as KN
     from spark_rapids_tpu.runtime.kernel_cache import (
         cached_kernel, fingerprint)
+    be = KN.resolve("sort", supports_pallas=False)
+    key = ("sort", fingerprint(list(orders)), fingerprint(batch.schema))
     fn = cached_kernel(
-        ("sort", fingerprint(list(orders)), fingerprint(batch.schema)),
-        lambda: (lambda b: _sort_batch_impl(b, orders)))
-    return fn(batch)
+        key if be == "jnp" else key + (be,),
+        lambda: (lambda b: _sort_batch_impl(b, orders, backend=be)))
+    out = fn(batch)
+    KN.count("sort", be, node)
+    return out
 
 
-def _sort_batch_impl(batch: DeviceBatch, orders: Sequence[SortOrder]
-                     ) -> DeviceBatch:
+def _sort_batch_impl(batch: DeviceBatch, orders: Sequence[SortOrder],
+                     backend: str = "jnp") -> DeviceBatch:
+    from spark_rapids_tpu.kernels import segmented_sort as KNS
     parts = [ORD._flag_part(~batch.sel)]
     for o in orders:
         c = o.expr.eval_tpu(batch)
         parts.extend(ORD.column_order_parts(c, o.ascending, o.nulls_first))
-    _, perm = ORD.sort_by_keys(ORD.fuse_parts(parts))
+    _, perm = KNS.sort_perm(ORD.fuse_parts(parts), backend=backend)
     cols = tuple(c.gather(perm) for c in batch.columns)
     sel = jnp.take(batch.sel, perm)
     return DeviceBatch(batch.schema, cols, sel)

@@ -516,7 +516,9 @@ def _compute_range_bounds(batch, order: "L.SortOrder", perm, pb, peer_b,
 def _window_impl(batch: DeviceBatch, pby: Sequence[Expression],
                  orders: Sequence[L.SortOrder],
                  fns: Sequence[L.WindowFunctionSpec],
-                 out_schema: T.StructType) -> DeviceBatch:
+                 out_schema: T.StructType,
+                 backend: str = "jnp") -> DeviceBatch:
+    from spark_rapids_tpu.kernels import segmented_sort as KNS
     b = batch.capacity
     pparts = ([ORD._flag_part(~batch.sel)]
               + ORD.batch_group_parts([e.eval_tpu(batch) for e in pby]))
@@ -527,7 +529,7 @@ def _window_impl(batch: DeviceBatch, pby: Sequence[Expression],
     limbs_p = ORD.fuse_parts(pparts)
     limbs_o = ORD.fuse_parts(oparts)
     n_lp = len(limbs_p)
-    sorted_limbs, perm = ORD.sort_by_keys(limbs_p + limbs_o)
+    sorted_limbs, perm = KNS.sort_perm(limbs_p + limbs_o, backend=backend)
     live_s = jnp.take(batch.sel, perm)
 
     pb = _limb_diff(sorted_limbs[:n_lp]).at[0].set(True)
@@ -594,17 +596,25 @@ class TpuWindowExec(TpuExec):
                    for b in child.execute(p)]
         if not batches:
             return
+        from spark_rapids_tpu import kernels as KN
+        be = KN.resolve("sort", supports_pallas=False)
         with self.timer():
             merged = concat_device_batches(child.schema, batches)
             pby, orders, fns, schema = (self.partition_by, self.order_by,
                                         self.fns, self.schema)
+            # the jnp key stays the historical one so persistent cache
+            # entries from older builds keep hitting
+            key = ("window", fingerprint(pby), fingerprint(orders),
+                   fingerprint(fns), fingerprint(schema))
+            if be != "jnp":
+                key = key + (be,)
             fn = cached_kernel(
-                ("window", fingerprint(pby), fingerprint(orders),
-                 fingerprint(fns), fingerprint(schema)),
+                key,
                 lambda: (lambda bt: _window_impl(bt, pby, orders, fns,
-                                                 schema)))
+                                                 schema, backend=be)))
             with get_manager().transient(2 * merged.nbytes()):
                 out = fn(merged)
+            KN.count("sort", be, self)
         self.metric("numOutputBatches").add(1)
         yield out
 

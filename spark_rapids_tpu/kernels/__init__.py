@@ -1,0 +1,169 @@
+"""Kernel plane — backend selection and dispatch for the fused kernels.
+
+[REF: the reference picks between libcudf CUDA kernels and a JIT'd
+ fallback per operator; this plane is the TPU analog, conf-selected.]
+
+Three backends per kernel (hash join, segmented sort, hash agg),
+``spark.rapids.tpu.kernel.backend``:
+
+* ``jnp``    — the pure jax.numpy reference (bit-exact baseline);
+* ``fused``  — single-program XLA kernels built on the hash-grouped /
+  tiled-rank layouts (kernels/hash_layout.py) — no scatter, no extra
+  host round-trips;
+* ``pallas`` — fused structure with the hash mixing loop as a Mosaic
+  VPU kernel (kernels/pallas_backend.py); TPU only;
+* ``auto``   — pallas on TPU; off-TPU, fused for join/agg (measured
+  faster on the CPU harness too) but jnp for sort, whose tiled form
+  only pays where sort operand count dominates (see resolve()).
+
+Degrade ladder: ``pallas → fused → jnp``.  Rungs descend on a
+detected 64-bit hash collision (the kernels are exact-or-fallback —
+see hash_layout), via the ``ok`` scalar every non-jnp kernel returns,
+or when the rung declares itself ineligible at trace time (``ok`` is
+None: unhashable keys ran the reference inside the rung).  Execution
+failures are NOT a ladder concern: every rung runs through
+``cached_kernel``'s execute chokepoint, which already retries
+transients, trips the per-op breaker, and host-degrades per the PR 3
+policy — an error that escapes that machinery is domain-tagged and
+must surface, not silently produce a different rung's answer.
+Fallbacks count ``tpuq_kernel_fallback_total``; every accepted
+dispatch counts
+``tpuq_kernel_dispatch_total{backend}`` with the backend that actually
+produced the result, which is also recorded on the op's stats row
+(``kernel_backend`` in ``df.explain("analyze")``).
+
+The module-global policy mirrors runtime/shapes.py: the session
+snapshots conf once at init (sql/session.py) and hot paths read one
+attribute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Optional
+
+from spark_rapids_tpu.runtime import telemetry as TM
+
+BACKENDS = ("auto", "pallas", "fused", "jnp")
+
+_LADDERS = {
+    "pallas": ("pallas", "fused", "jnp"),
+    "fused": ("fused", "jnp"),
+    "jnp": ("jnp",),
+}
+
+_TM_DISPATCH = TM.REGISTRY.labeled_counter(
+    "tpuq_kernel_dispatch_total",
+    "kernel-plane dispatches by the backend that produced the result",
+    label="backend")
+_TM_FALLBACK = TM.REGISTRY.labeled_counter(
+    "tpuq_kernel_fallback_total",
+    "kernel dispatches that descended the backend ladder (hash "
+    "collision, unhashable keys, or a failed rung)",
+    label="kernel")
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelPolicy:
+    """One immutable kernel-plane policy (the conf snapshot, parsed)."""
+
+    backend: str = "auto"   # spark.rapids.tpu.kernel.backend
+    pump_depth: int = 2     # spark.rapids.tpu.exec.pumpDepth
+
+
+_POLICY = KernelPolicy()
+_LOCK = threading.Lock()
+
+
+def configure(conf) -> KernelPolicy:
+    """Install the policy from a RapidsConf snapshot (session init)."""
+    from spark_rapids_tpu import conf as C
+    pol = KernelPolicy(
+        backend=str(conf.get(C.KERNEL_BACKEND)).lower(),
+        pump_depth=int(conf.get(C.EXEC_PUMP_DEPTH)))
+    global _POLICY
+    with _LOCK:
+        _POLICY = pol
+    return pol
+
+
+def current_policy() -> KernelPolicy:
+    return _POLICY
+
+
+def resolve(kernel: str, supports_pallas: bool = True) -> str:
+    """Conf backend → the concrete rung this dispatch starts from.
+
+    ``auto`` means pallas on TPU, fused elsewhere; an explicit
+    ``pallas`` off-TPU (or for a kernel with no pallas rung yet)
+    degrades statically to fused — the run-time ladder handles only
+    run-time failures.
+    """
+    be = _POLICY.backend
+    if be == "auto":
+        from spark_rapids_tpu.kernels import pallas_backend as PB
+        if PB.available():
+            be = "pallas"
+        elif kernel == "sort":
+            # the tiled sort trades extra rank-merge arithmetic for
+            # fewer sort operands — a win on TPU where operand count
+            # dominates compile AND run cost, a measured ~12x loss on
+            # the CPU harness (KERNEL_BENCH @128k) — so auto takes it
+            # only on the real chip; explicit `fused` still forces it
+            be = "jnp"
+        else:
+            be = "fused"
+    if be == "pallas":
+        from spark_rapids_tpu.kernels import pallas_backend as PB
+        if not supports_pallas or not PB.available():
+            be = "fused"
+    return be
+
+
+def count(kernel: str, backend: str, node=None) -> None:
+    """Record one accepted dispatch: telemetry + the op's stats row."""
+    _TM_DISPATCH.inc(backend)
+    if node is not None:
+        from spark_rapids_tpu.runtime import stats
+        st = stats.current()
+        if st is not None:
+            st.node_stats(node).set_kernel_backend(backend)
+
+
+def dispatch(kernel: str, backend: str,
+             runner: Callable[[str], Callable], node=None):
+    """Run one kernel down the degrade ladder; returns its payload.
+
+    ``runner(be)`` returns a zero-arg callable producing
+    ``(payload, ok)``: ``ok`` is a device bool scalar from the non-jnp
+    rungs (False = hash collision → descend), or None when the rung
+    itself ran the reference path (unhashable keys) or IS the jnp
+    reference.  The one host sync here (``bool(ok)``) is the fused
+    kernels' price of exactness; it reads a scalar that is ready as
+    soon as the layout phase finishes, not after the full result.
+
+    Exceptions propagate: each rung already executes under
+    ``cached_kernel``'s retry/breaker/degrade chokepoint, so anything
+    that escapes it is a domain-tagged failure the query must see —
+    swallowing it here would let an injected/terminal device fault
+    masquerade as a successful fallback.
+    """
+    for be in _LADDERS[backend]:
+        call = runner(be)
+        if be == "jnp":
+            payload, _ = call()
+            count(kernel, "jnp", node)
+            return payload
+        payload, okf = call()
+        if okf is None:
+            # the rung declared itself ineligible at trace time and
+            # ran the reference computation inside its own kernel
+            count(kernel, "jnp", node)
+            return payload
+        if not bool(okf):
+            _TM_FALLBACK.inc(kernel)
+            continue
+        count(kernel, be, node)
+        return payload
+    raise AssertionError(f"kernel ladder for {backend!r} has no floor")

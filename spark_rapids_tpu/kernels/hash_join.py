@@ -1,0 +1,84 @@
+"""Fused build+probe hash join — match ranges over ONE hash limb.
+
+The jnp reference (exec.join._match_ranges) stably sorts the build
+side by the full (exclusion-flag + key-limbs) encoding and runs TWO
+lexicographic bisections (lower + upper bound) over all of those limbs
+per probe row.  The fused kernel collapses both costs:
+
+* build: sort ONE uint64 limb — the 63-bit key hash with the exclusion
+  flag in the top bit, so excluded (dead/null) rows sort after every
+  probe value and can never be landed on;
+* probe: ONE single-limb lower-bound bisection; the upper bound is
+  free — a segmented count over the build side pre-computes every hash
+  run's length, and the probe just gathers it at the run start;
+* exactness: the probed run start's FULL key limbs are gathered and
+  compared against the probe row (a hash-only miss yields m = 0, never
+  a wrong match), and a build-side adjacent-pair scan detects the one
+  case that can't be repaired locally — two distinct live keys sharing
+  a 64-bit hash — surfacing ``ok = False`` for the dispatcher's exact
+  fallback (see hash_layout.hash_group_layout's argument for why
+  adjacency detection is complete).
+
+Bit-identity: within one hash run the stable sort keeps build rows in
+original-index order — the same order the reference's key-sorted perm
+gives inside a key group — so (m, lo, perm) drive exec.join._merge_join
+to byte-identical materialized output.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from spark_rapids_tpu.kernels import hash_layout as HL
+from spark_rapids_tpu.ops import ordering as ORD
+
+# numpy scalar: module import stays safe before jax_enable_x64 flips on
+_TOP = np.uint64(1 << 63)
+
+
+def match_fused(l_limbs: List[jnp.ndarray], r_limbs: List[jnp.ndarray],
+                r_excl: jnp.ndarray, use_pallas: bool = False
+                ) -> Optional[Tuple[jnp.ndarray, jnp.ndarray,
+                                    jnp.ndarray, jnp.ndarray]]:
+    """(m, lo, perm, ok) under exec.join._match_ranges' contract, or
+    None when the key limbs are unhashable (raw-f64 limb — the caller
+    stays on the exact reference; static per kernel instance).
+
+    ``l_limbs``/``r_limbs`` are the fused key limbs WITHOUT the
+    exclusion flag (it rides the hash limb's top bit here); left-side
+    liveness masking stays with the caller, as in the reference.
+    """
+    if not HL.limbs_hashable(l_limbs + r_limbs):
+        return None
+    n = int(r_excl.shape[0])
+    h_r = HL.hash_limbs(r_limbs, use_pallas=use_pallas) >> jnp.uint64(1)
+    build_limb = jnp.where(r_excl, h_r | _TOP, h_r)
+    sorted_hs, perm = ORD.sort_by_keys([build_limb])
+    sorted_h = sorted_hs[0]
+    rl_s = [jnp.take(l, perm) for l in r_limbs]
+
+    # hash-run structure on the build side (run start + run length)
+    run_start = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_h[1:] != sorted_h[:-1]])
+    rlen = HL.run_lengths(run_start)
+
+    # probe: one single-limb bisection, counts gathered at the run start
+    h_q = HL.hash_limbs(l_limbs, use_pallas=use_pallas) >> jnp.uint64(1)
+    lo = HL.lower_bound(sorted_h, h_q)
+    loc = jnp.clip(lo, 0, n - 1)
+    hit = (jnp.take(sorted_h, loc) == h_q) & (lo < n)
+    # exact verification: run-start key must equal the probe key
+    for rl, ll in zip(rl_s, l_limbs):
+        hit = hit & (jnp.take(rl, loc) == ll)
+    m = jnp.where(hit, jnp.take(rlen, loc), 0)
+
+    # 64-bit collision between two distinct LIVE keys → exact fallback
+    excl_s = jnp.take(r_excl, perm)
+    key_neq = HL._adjacent_neq(rl_s)
+    live_pair = jnp.concatenate(
+        [jnp.zeros((1,), jnp.bool_), (~excl_s[1:]) & (~excl_s[:-1])])
+    ok = ~jnp.any((~run_start) & key_neq & live_pair)
+    return m, lo, perm, ok

@@ -1,0 +1,110 @@
+"""Segmented sort — bucket-local key ranking, merged by rank.
+
+The jnp reference (``ops.ordering.sort_by_keys``) is ONE global
+``lax.sort`` over (limbs…, iota): correct, but its compile and run cost
+grow with operand count × full batch length.  The fused backend
+exploits the shape plane's static row buckets: a bucket splits into a
+fixed number of contiguous TILES, each tile sorts locally (one 2-D
+``lax.sort`` along the tile axis — the per-tile sorts are one fused
+device op, not a loop), and every row's GLOBAL rank is recovered by
+counting, per foreign tile, how many of its rows precede this row —
+a branchless single-limb-at-a-time bisection per tile, not a second
+multi-operand global sort.  One final two-operand sort inverts the
+rank permutation (scatter-free: XLA scatter serializes on TPU).
+
+Stability (and therefore bit-identity with the reference) falls out of
+the merge rule: tiles are contiguous ascending index ranges, so a tied
+row in an earlier tile ALWAYS precedes one in a later tile — earlier
+tiles count ties (upper bound), later tiles don't (lower bound), and
+within a tile the local sort is iota-stabilized.  The resulting rank is
+exactly the row's position under the reference's stable sort, so the
+returned permutation is identical bit for bit.
+
+f64 limbs (DoubleType sort keys ride a raw-float limb) are compared
+with plain </==, which matches the ``lax.sort`` comparator for the
+values the encoding admits (NaNs are canonicalized out of the raw limb
+upstream; ±0.0 compare equal in both).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# tiles per bucket: enough locality to shrink the per-sort problem,
+# few enough that the t² rank-count passes stay a small static unroll
+_TILES = 8
+# below this the tiling bookkeeping costs more than the sort
+_MIN_ROWS = 4 * _TILES
+
+
+def _pick_tiles(n: int) -> int:
+    """Largest power-of-two tile count ≤ _TILES dividing n (1 = don't
+    tile).  Static: capacities are pow2 buckets or sums of them."""
+    if n < _MIN_ROWS:
+        return 1
+    t = _TILES
+    while t > 1 and n % t:
+        t >>= 1
+    return t
+
+
+def _tile_count(table: List[jnp.ndarray], queries: List[jnp.ndarray],
+                le: jnp.ndarray) -> jnp.ndarray:
+    """Rows of one sorted tile preceding each query row.
+
+    Lexicographic fixed-step bisection over the tile's limbs;
+    ``le[q]`` switches that query to upper-bound counting (ties in
+    earlier tiles precede — the stable-merge rule).
+    """
+    import math
+    s = int(table[0].shape[0])
+    steps = max(1, int(math.ceil(math.log2(max(s, 2)))) + 1)
+    lo = jnp.zeros(queries[0].shape, jnp.int32)
+    hi = jnp.full(queries[0].shape, s, jnp.int32)
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        midc = jnp.clip(mid, 0, s - 1)
+        lt = jnp.zeros(queries[0].shape, jnp.bool_)
+        eq = jnp.ones(queries[0].shape, jnp.bool_)
+        for tl, ql in zip(table, queries):
+            v = jnp.take(tl, midc)
+            lt = lt | (eq & (v < ql))
+            eq = eq & (v == ql)
+        go_right = lt | (eq & le)
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+    return lo
+
+
+def sort_perm(limbs: List[jnp.ndarray], backend: str = "jnp"
+              ) -> Tuple[List[jnp.ndarray], jnp.ndarray]:
+    """Drop-in ``ops.ordering.sort_by_keys``: (sorted limbs, perm).
+
+    ``backend``: "jnp" → the reference global sort; "fused"/"pallas" →
+    tiled rank merge (pallas has no sort-specific kernel yet, so both
+    name the tiled path).  The choice is static per kernel instance —
+    no runtime fallback is needed because the tiled path is exact.
+    """
+    from spark_rapids_tpu.ops import ordering as ORD
+    n = int(limbs[0].shape[0])
+    t = _pick_tiles(n) if backend != "jnp" else 1
+    if t == 1:
+        return ORD.sort_by_keys(limbs)
+    s = n // t
+    gi = jnp.arange(n, dtype=jnp.int32).reshape(t, s)
+    ops = tuple(l.reshape(t, s) for l in limbs) + (gi,)
+    res = jax.lax.sort(ops, dimension=1, num_keys=len(limbs) + 1)
+    tiled = [r for r in res[:-1]]          # [t, s] tile-sorted limbs
+    gis = res[-1].reshape(-1)              # original index, tile order
+    flat = [r.reshape(-1) for r in tiled]  # queries: every row, tile order
+    qtile = jnp.arange(n, dtype=jnp.int32) // s
+    rank = jnp.arange(n, dtype=jnp.int32) % s  # position in own tile
+    for u in range(t):
+        cnt = _tile_count([r[u] for r in tiled], flat, le=qtile > u)
+        rank = rank + jnp.where(qtile == u, 0, cnt)
+    # ranks are a bijection on [0, n): invert with one 2-operand sort
+    _, perm = jax.lax.sort((rank, gis), num_keys=1)
+    return [jnp.take(l, perm) for l in limbs], perm

@@ -61,6 +61,9 @@ STATS_FIELDS = {
     "self_s": "operator self-time from the trace rollup (traced runs)",
     "total_s": "operator total time from the trace rollup (traced runs)",
     "fused": "operator was fused into its consumer's kernel (stays zero)",
+    "kernel_backend": "kernel-plane backend that produced this "
+                      "operator's results (jnp/fused/pallas; 'mixed' "
+                      "when dispatches disagreed across batches)",
 }
 
 _HIST_CAP = 1 << 30
@@ -133,13 +136,14 @@ class NodeStats:
 
     __slots__ = ("rows", "batches", "bytes", "hist", "nulls", "observed",
                  "partitions", "partition_unit", "executors", "padded",
-                 "_lock")
+                 "kernel_backend", "_lock")
 
     def __init__(self):
         self.rows = 0
         self.batches = 0
         self.bytes = 0
         self.padded = 0
+        self.kernel_backend: Optional[str] = None
         self.hist: Dict[str, int] = {}
         # col name -> [null count, rows observed]
         self.nulls: Dict[str, List[int]] = {}
@@ -167,6 +171,14 @@ class NodeStats:
     def add_padded(self, n: int) -> None:
         with self._lock:
             self.padded += int(n)
+
+    def set_kernel_backend(self, backend: str) -> None:
+        with self._lock:
+            if self.kernel_backend is None:
+                self.kernel_backend = backend
+            elif self.kernel_backend != backend:
+                # per-batch fallbacks can land different rungs on one op
+                self.kernel_backend = "mixed"
 
     def set_partitions(self, counts: Sequence[int], unit: str,
                        executors: int = 1) -> None:
@@ -310,6 +322,8 @@ class OpStatsCollector:
             }
             if ns.padded:
                 rec["padded_rows"] = ns.padded
+            if ns.kernel_backend is not None:
+                rec["kernel_backend"] = ns.kernel_backend
             fused = getattr(node, "metrics", {}).get("fusedIntoConsumer")
             if fused is not None and fused.value:
                 rec["fused"] = True

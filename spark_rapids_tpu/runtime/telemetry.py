@@ -366,7 +366,8 @@ def ensure_producers() -> None:
                 "runtime.lockdep", "runtime.shapes",
                 "shuffle.manager", "shuffle.exchange",
                 "parallel.executor", "parallel.shuffle",
-                "parallel.rendezvous", "exec.distributed"):
+                "parallel.rendezvous", "exec.distributed",
+                "kernels"):
         try:
             importlib.import_module(f"spark_rapids_tpu.{mod}")
         except Exception as e:  # never fail a report over one producer

@@ -1219,6 +1219,8 @@ class DataFrame:
                     ann += f" executors={rec['executors']}"
             if rec.get("fused"):
                 ann += " fused"
+            if rec.get("kernel_backend"):
+                ann += f" kernel={rec['kernel_backend']}"
             lines.append("  " * depth
                          + ("*" if node.is_tpu else "")
                          + node.node_string() + f"  [{ann}]")

@@ -163,6 +163,10 @@ class TpuSession:
         # (spark.rapids.tpu.kernel.bucketing/bucketLadder/maxPadFraction)
         from spark_rapids_tpu.runtime import shapes
         shapes.configure(self.conf.snapshot())
+        # kernel plane: fused-kernel backend + double-buffered pump
+        # (spark.rapids.tpu.kernel.backend, spark.rapids.tpu.exec.pumpDepth)
+        from spark_rapids_tpu import kernels
+        kernels.configure(self.conf.snapshot())
         # persistent (on-disk) XLA compilation cache
         # (spark.rapids.tpu.kernel.cacheDir; no-op on the CPU backend)
         from spark_rapids_tpu.runtime import kernel_cache

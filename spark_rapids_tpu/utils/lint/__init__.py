@@ -52,6 +52,12 @@ Rules (catalog in docs/static_analysis.md):
                       device collective, host pulls reintroduce the
                       round-trip the exchange plane was rebuilt to
                       kill
+``kernel-purity``     the same host-materialization flags inside ANY
+                      function of the kernel plane (kernels/ minus the
+                      dispatcher in __init__.py, whose one ``bool(ok)``
+                      sync is the exactness protocol) — kernel bodies
+                      are traced device code; a host pull there
+                      serializes the async pump on every batch
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -212,6 +218,7 @@ def all_rules() -> List[Rule]:
     from spark_rapids_tpu.utils.lint.failure_domains import (
         FailureDomainRule)
     from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
+    from spark_rapids_tpu.utils.lint.kernel_purity import KernelPurityRule
     from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
     from spark_rapids_tpu.utils.lint.op_stats import OpStatsRule
     from spark_rapids_tpu.utils.lint.raw_jit import RawJitRule
@@ -219,7 +226,8 @@ def all_rules() -> List[Rule]:
         SchedulerBypassRule)
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
-            SchedulerBypassRule(), RawJitRule(), ExchangePurityRule()]
+            SchedulerBypassRule(), RawJitRule(), ExchangePurityRule(),
+            KernelPurityRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

@@ -1,0 +1,51 @@
+"""``kernel-purity`` — the kernel plane's device code stays device-pure.
+
+Everything under ``spark_rapids_tpu/kernels/`` except the dispatcher
+(``__init__.py``, whose one ``bool(ok)`` host sync is the exactness
+protocol by design) is DEVICE code traced inside ``cached_kernel``
+builders: hash mixing, hash-grouped layout, tiled segmented sort, the
+fused join probe.  A ``np.asarray`` / ``.item()`` / ``device_get`` pull
+there either fails at trace time or — worse — silently serializes the
+async pipeline the kernel plane exists to keep full, on EVERY batch of
+every join/sort/agg.  Same shape as ``exchange-purity``, scoped to the
+kernel modules; the flag tables are shared so the two rules can't
+drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+from spark_rapids_tpu.utils.lint.exchange_purity import (
+    ExchangePurityRule)
+
+SCOPE_PREFIX = "spark_rapids_tpu/kernels/"
+EXEMPT_FILES = ("spark_rapids_tpu/kernels/__init__.py",)
+
+
+class KernelPurityRule(Rule):
+    name = "kernel-purity"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        if (not mod.rel.startswith(SCOPE_PREFIX)
+                or mod.rel in EXEMPT_FILES):
+            return ()
+        flag = ExchangePurityRule()._flag
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                msg = flag(sub)
+                if msg and sub.lineno not in seen:
+                    seen.add(sub.lineno)
+                    out.append(Finding(
+                        self.name, mod.rel, sub.lineno,
+                        f"{msg} inside kernel-plane function "
+                        f"`{node.name}` "
+                        f"(`{mod.snippet(sub.lineno)}`)"))
+        return out

@@ -704,6 +704,62 @@ def test_exchange_purity_exemption():
 
 
 # ---------------------------------------------------------------------------
+# kernel-purity
+# ---------------------------------------------------------------------------
+
+def test_kernel_purity_flags_host_pulls():
+    from spark_rapids_tpu.utils.lint.kernel_purity import KernelPurityRule
+    m = _mod("spark_rapids_tpu/kernels/hash_layout.py", """
+        import jax
+        import numpy as np
+
+        def hash_limbs(limbs):
+            n = np.asarray(limbs[0])
+            jax.device_get(limbs)
+            limbs[0].item()
+            return limbs
+        """)
+    out = _run([KernelPurityRule()], m)
+    assert [f.rule for f in out] == ["kernel-purity"] * 3
+    assert "hash_limbs" in out[0].message
+
+
+def test_kernel_purity_scope_and_clean_kernels():
+    from spark_rapids_tpu.utils.lint.kernel_purity import KernelPurityRule
+    clean = _mod("spark_rapids_tpu/kernels/segmented_sort.py", """
+        import jax.numpy as jnp
+
+        def sort_perm(limbs, backend="jnp"):
+            return limbs, jnp.argsort(limbs[0])
+        """)
+    # the dispatcher's host sync on `ok` is the protocol — out of scope
+    dispatcher = _mod("spark_rapids_tpu/kernels/__init__.py", """
+        def dispatch(kernel, backend, runner):
+            payload, okf = runner(backend)()
+            return payload if bool(okf.item()) else None
+        """)
+    elsewhere = _mod("spark_rapids_tpu/exec/agg.py", """
+        import numpy as np
+
+        def reduce_host(x):
+            return np.asarray(x)
+        """)
+    assert _run([KernelPurityRule()], clean, dispatcher, elsewhere) == []
+
+
+def test_kernel_purity_exemption():
+    from spark_rapids_tpu.utils.lint.kernel_purity import KernelPurityRule
+    m = _mod("spark_rapids_tpu/kernels/hash_join.py", """
+        import numpy as np
+
+        def match_fused(l_limbs, r_limbs):
+            # lint: exempt(kernel-purity): debug dump behind a flag
+            return np.asarray(l_limbs)
+        """)
+    assert _run([KernelPurityRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the real tree is clean
 # ---------------------------------------------------------------------------
 
