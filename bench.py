@@ -1011,6 +1011,102 @@ def kernel_bench(mark) -> dict:
     return out
 
 
+def adaptive_bench(mark) -> dict:
+    """ADAPTIVE_BENCH: the adaptive plane's skew-split decision on a
+    pathologically skewed shuffled join (docs/adaptive.md), healing vs
+    not healing the SAME plan shape.
+
+    The stream side puts 60% of its rows on ONE hot key
+    (``SkewedLongGen``), and the build side's hash partitions exceed the
+    join row cap too — so without the split the hot reduce partition
+    cannot take the streamed-group rescue and falls into
+    ``_sub_partition_join``, whose key-hash re-split provably cannot
+    spread a single hot key: it recurses to its depth cap and then
+    joins in-core at a one-off OVERSIZED bucket.  That partition is the
+    straggler: it compiles sort/search kernels no other partition (and
+    no other query) will ever reuse.  With the plane on, the replanner
+    reads the exchange's recorded per-partition counts and splits the
+    hot partition into rank-interleaved sub-reads, each joined against
+    the (shared, gathered-once) build partition at canonical buckets.
+
+    Both runs enable the adaptive plane and zero the broadcast
+    threshold (killing the static fast-path and the measured flip
+    alike), differing ONLY in ``skewSplit.enabled`` — same shuffled
+    plan, the delta isolates the split.  ``cold_s`` is the first
+    materialization (compiles included): the honest one-shot e2e, and
+    where the straggler's oversized compiles land.  ``warm_s``
+    (best-of-2 after that) prices pure runtime: on hosts where an
+    oversized in-core sort is cheap the unsplit path can win warm —
+    both numbers are recorded, the headline ``speedup`` is cold.
+    Outputs are asserted row-equal so no speedup is quoted over a
+    wrong answer."""
+    from spark_rapids_tpu.sql.session import TpuSession
+    from spark_rapids_tpu.utils.datagen import SkewedLongGen, gen_table
+
+    n_stream, n_build = 1 << 18, 40_000
+    stream = gen_table(
+        [SkewedLongGen(hot_mass=0.6, distinct=n_build, nullable=False)],
+        n_stream, seed=7, names=["k"])
+    stream = stream.append_column(
+        "v", pa.array(np.arange(n_stream, dtype=np.int64)))
+    build = pa.table({
+        "k": np.arange(n_build, dtype=np.int64),
+        "w": np.arange(n_build, dtype=np.int64) * 3})
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.stats.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": 0,
+            # 2 reduce partitions: the build side's ~20k-row partitions
+            # exceed the 16k row cap, which is what disqualifies the
+            # unsplit hot partition from the streamed-group rescue
+            "spark.sql.shuffle.partitions": 2,
+            "spark.rapids.tpu.join.targetRows": 1 << 14,
+            "spark.rapids.tpu.batchRows": 1 << 16,
+            "spark.rapids.tpu.adaptive.enabled": True,
+            "spark.rapids.tpu.adaptive.skewThreshold": 1.5,
+            "spark.rapids.tpu.adaptive.maxSplitsPerPartition": 16}
+
+    def run(split):
+        conf = dict(base)
+        conf["spark.rapids.tpu.adaptive.skewSplit.enabled"] = split
+        s = TpuSession(conf)
+        df = s.createDataFrame(stream).join(
+            s.createDataFrame(build), on="k", how="inner")
+        t0 = time.perf_counter()
+        df.toArrow()  # cold: compiles included — the one-shot e2e
+        cold = time.perf_counter() - t0
+        warm, out = timed(lambda: df.toArrow(), reps=2)
+        prof = getattr(df, "_last_profile", None) or {}
+        return cold, warm, out, prof.get("adaptive_decisions") or []
+
+    # split first: the runs share every non-straggler kernel through the
+    # in-process cache, so running unsplit SECOND hands it those compiles
+    # for free and its remaining cold delta is purely the oversized
+    # one-off buckets — the conservative ordering for the split's win
+    c_on, w_on, out_on, decisions = run(split=True)
+    mark(f"adaptive split:   cold {c_on:.3f}s warm {w_on:.3f}s over "
+         f"{out_on.num_rows} rows")
+    c_off, w_off, out_off, _ = run(split=False)
+    mark(f"adaptive unsplit: cold {c_off:.3f}s warm {w_off:.3f}s, "
+         f"decisions={decisions}")
+    splits = [d for d in decisions if d.get("kind") == "skew-split"]
+    res = {"rows": out_on.num_rows,
+           "hot_mass": 0.6,
+           "cold_off_s": round(c_off, 3),
+           "cold_on_s": round(c_on, 3),
+           "speedup": round(c_off / c_on, 3),
+           "warm_off_s": round(w_off, 3),
+           "warm_on_s": round(w_on, 3),
+           "warm_speedup": round(w_off / w_on, 3),
+           "rows_equal": _rows_equal(out_on, out_off),
+           "skew_factor": splits[0]["skew_factor"] if splits else None,
+           "splits": [k for d in splits for k in d.get("splits", ())],
+           "decisions": decisions}
+    if not res["rows_equal"]:
+        mark("adaptive_bench: SPLIT/UNSPLIT OUTPUTS DIFFER — "
+             "speedup is void")
+    return res
+
+
 def _ici_bench_main() -> None:
     """Measure the compiled exchange's boundary program (the device
     collective the engine dispatches at every stage seam) over the
@@ -1164,7 +1260,11 @@ TPCH_BUILDERS = {
     "q18": q18, "q19": q19, "q20": q20, "q21": q21, "q22": q22,
 }
 TPCH_SF1_CONF = {"spark.rapids.sql.enabled": True,
-                 "spark.rapids.tpu.batchRows": 1 << 16}
+                 "spark.rapids.tpu.batchRows": 1 << 16,
+                 # stats-driven replanning rides the SF1 ladder: its
+                 # decisions land in each query's TPCH_SF1_STATS record
+                 # so profile.py diff can flag strategy flips run-over-run
+                 "spark.rapids.tpu.adaptive.enabled": True}
 TPCH_SF1_CONF.update(json.loads(os.environ.get(
     "TPUQ_BENCH_CONF_JSON", "{}")))
 
@@ -1300,7 +1400,12 @@ def _sf1_query_main(name: str) -> None:
                  # effective kernel rung for this run's joins/aggs
                  # (docs/kernels.md): "auto" resolves per platform, so
                  # the record pins what actually ran
-                 "kernel_backend": KN.resolve("join")}))
+                 "kernel_backend": KN.resolve("join"),
+                 # adaptive-plane decisions (strategy, skew splits,
+                 # retargets) with their triggering stats — profile.py
+                 # diff flags flips between bench runs
+                 "adaptive_decisions":
+                     prof.get("adaptive_decisions") or []}))
     except Exception as e:  # diagnostics must never fail the run
         print(f"TPCH_SF1_STATS_ERR={e}")
 
@@ -1569,6 +1674,7 @@ def main():
         "tpch_sf1_compile": compile_recs,
         "tpch_sf1_concurrency": None,
         "kernel_bench": None,
+        "adaptive_bench": None,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
         "host_memcpy_gb_per_s": round(host_memcpy_gb_per_s(), 2),
@@ -1594,6 +1700,12 @@ def main():
     except Exception as e:  # a microbench failure must not kill the run
         result["kernel_bench"] = {"error": str(e)}
         mark(f"kernel_bench failed: {e}")
+    emit()
+    try:
+        result["adaptive_bench"] = adaptive_bench(mark)
+    except Exception as e:  # a microbench failure must not kill the run
+        result["adaptive_bench"] = {"error": str(e)}
+        mark(f"adaptive_bench failed: {e}")
     emit()
     result.update(ici_bench(mark))
     emit()

@@ -634,6 +634,99 @@ ADVISORY_PARTITION_SIZE = (
     .create_with_default(64 << 20)
 )
 
+ADAPTIVE_PLANE_ENABLED = (
+    conf("spark.rapids.tpu.adaptive.enabled")
+    .doc("Master switch for the adaptive execution plane "
+         "(spark_rapids_tpu/adaptive/): a cost model + replanner that "
+         "spends the stats plane's recorded rows/bytes/partition sizes "
+         "to rewrite the physical plan at stage boundaries — broadcast "
+         "vs shuffled join strategy, skewed-partition splitting, and "
+         "dynamic batch retargeting.  Each decision has its own "
+         "sub-gate below; every decision taken is counted in "
+         "tpuq_adaptive_decisions_total{kind} and rendered in EXPLAIN "
+         "ANALYZE as adaptive=...")
+    .category("aqe")
+    .boolean()
+    .create_with_default(False)
+)
+
+ADAPTIVE_JOIN_STRATEGY = (
+    conf("spark.rapids.tpu.adaptive.joinStrategy.enabled")
+    .doc("Adaptive join strategy selection: pick broadcast vs "
+         "shuffled-hash per join from OBSERVED build-side cardinality — "
+         "profile-store history for warm queries (adaptive.historyPath), "
+         "upstream pump counts for cold ones — instead of the static "
+         "planner estimate.  A build side that fits "
+         "spark.sql.autoBroadcastJoinThreshold eliminates the exchange "
+         "entirely.  Requires adaptive.enabled.")
+    .category("aqe")
+    .boolean()
+    .create_with_default(True)
+)
+
+ADAPTIVE_SKEW_SPLIT = (
+    conf("spark.rapids.tpu.adaptive.skewSplit.enabled")
+    .doc("Adaptive skew splitting: when a shuffle exchange's recorded "
+         "partition sizes show a skew factor above "
+         "adaptive.skewThreshold, split the hot stream-side "
+         "partition(s) into rank-interleaved sub-partitions and "
+         "replicate the build side's matching partition, so one "
+         "straggler stops serializing the stage.  Unlike hash "
+         "sub-partitioning this spreads a SINGLE hot key.  Requires "
+         "adaptive.enabled; inner/left/left_semi/left_anti joins only.")
+    .category("aqe")
+    .boolean()
+    .create_with_default(True)
+)
+
+ADAPTIVE_SKEW_THRESHOLD = (
+    conf("spark.rapids.tpu.adaptive.skewThreshold")
+    .doc("Skew factor (hottest partition / mean) above which adaptive "
+         "skew splitting triggers.  0 inherits "
+         "spark.rapids.tpu.stats.skewThreshold so the replanner splits "
+         "exactly the partitions the stats plane flags as SKEWED.")
+    .category("aqe")
+    .double()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(0.0)
+)
+
+ADAPTIVE_MAX_SPLITS = (
+    conf("spark.rapids.tpu.adaptive.maxSplitsPerPartition")
+    .doc("Upper bound on the number of rank-interleaved sub-partitions "
+         "one hot partition may be split into — caps task fan-out (and "
+         "build-side replication cost) no matter how hot the key is.")
+    .category("aqe")
+    .integer()
+    .check(lambda v: v >= 2, "at least 2")
+    .create_with_default(8)
+)
+
+ADAPTIVE_BATCH_RETARGET = (
+    conf("spark.rapids.tpu.adaptive.batchRetarget.enabled")
+    .doc("Dynamic batch retargeting: the AQE shuffle read plans its "
+         "coalesce/split row target from the OBSERVED bytes/row of the "
+         "exchange input (stats plane) instead of the static schema "
+         "estimate, then snaps it to the shape plane's bucket ladder — "
+         "variable-width columns stop under/over-filling read batches "
+         "mid-query.  Requires adaptive.enabled.")
+    .category("aqe")
+    .boolean()
+    .create_with_default(True)
+)
+
+ADAPTIVE_HISTORY_PATH = (
+    conf("spark.rapids.tpu.adaptive.historyPath")
+    .doc("JSONL profile store consulted for warm-query join decisions: "
+         "the most recent recorded build-side bytes for a join's stable "
+         "plan signature decides broadcast vs shuffled WITHOUT "
+         "re-measuring.  Empty inherits spark.rapids.tpu.stats.storePath "
+         "(decisions recorded there feed the next run automatically).")
+    .category("aqe")
+    .string()
+    .create_with_default("")
+)
+
 DPP_ENABLED = (
     conf("spark.sql.optimizer.dynamicPartitionPruning.enabled")
     .doc("Dynamic partition pruning: joins on a hive-partition column "

@@ -38,7 +38,7 @@ class TpuAQEShuffleReadExec(TpuExec):
     """
 
     def __init__(self, child: TpuExec, target_bytes: int, row_bytes: int,
-                 allow_split: bool = False):
+                 allow_split: bool = False, retarget=None):
         super().__init__(child.schema, child)
         self.target_bytes = max(int(target_bytes), 1)
         self.row_bytes = max(int(row_bytes), 1)
@@ -48,6 +48,10 @@ class TpuAQEShuffleReadExec(TpuExec):
         # exactly Spark's restriction of skew-splitting to join readers
         # that re-duplicate the other side.
         self.allow_split = allow_split
+        # AdaptivePolicy (or None): replan the row target from the
+        # OBSERVED bytes/row of the exchange input instead of the
+        # static schema estimate (adaptive batch retargeting)
+        self.retarget = retarget
         self._specs: Optional[List[tuple]] = None
         self._lock = threading.Lock()
 
@@ -74,6 +78,26 @@ class TpuAQEShuffleReadExec(TpuExec):
             counts = [int(c) for c in sizes]
             target = (max(self.target_bytes // self.row_bytes, 1)
                       if unit == "rows" else self.target_bytes)
+            if self.retarget is not None and unit == "rows":
+                # adaptive batch retargeting: by the time counts exist
+                # the exchange input has fully pumped, so the stats
+                # plane holds its observed rows/bytes — replan the
+                # coalesce target from reality when the static schema
+                # estimate was off (variable-width columns)
+                obs = (st.observed(self.children[0].children[0])
+                       if st is not None and self.children[0].children
+                       else None)
+                if obs is not None:
+                    from spark_rapids_tpu import adaptive as AD
+                    from spark_rapids_tpu.adaptive import replanner
+                    planned = replanner.retarget_read_rows(
+                        self.retarget, self.target_bytes,
+                        self.row_bytes, obs[0], obs[1])
+                    if planned is not None:
+                        target, detail = planned
+                        self.metric("retargetedReads").add(1)
+                        AD.record_decision(self, "batch-retarget",
+                                           **detail)
             specs: List[tuple] = []
             i, n = 0, len(counts)
             while i < n:

@@ -273,10 +273,13 @@ def _convert_exchange(cpu, ch, conf):
         # CACHE_ONLY: in-process device-resident exchange (sel-mask views)
         exchange = TpuShuffleExchangeExec(ch[0], cpu.nparts, cpu.keys)
     if conf.get(C.ADAPTIVE_ENABLED):
+        from spark_rapids_tpu import adaptive as AD
         from spark_rapids_tpu.exec.aqe import TpuAQEShuffleReadExec
         from spark_rapids_tpu.plan.overrides import _estimated_row_bytes
+        pol = AD.policy_from_conf(conf)
         return TpuAQEShuffleReadExec(
             exchange, conf.get(C.ADVISORY_PARTITION_SIZE),
             _estimated_row_bytes(cpu.schema),
-            allow_split=cpu.keys is None)
+            allow_split=cpu.keys is None,
+            retarget=pol if pol.wants_retarget else None)
     return exchange

@@ -440,7 +440,8 @@ class TpuSortMergeJoinExec(TpuExec):
                  partitioned: bool = False, using: bool = True,
                  broadcast: Optional[str] = None,
                  sub_partition_rows: int = 1 << 18,
-                 out_batch_rows: Optional[int] = None):
+                 out_batch_rows: Optional[int] = None,
+                 skew_split=None):
         super().__init__(schema, left, right)
         self.join_type = join_type
         self.left_keys = list(left_keys)
@@ -459,6 +460,33 @@ class TpuSortMergeJoinExec(TpuExec):
         # join outputs re-batch to this bucket (spark.rapids.tpu.batchRows)
         # so downstream kernels never compile at the expanded bucket size
         self.out_batch_rows = out_batch_rows
+        # AdaptivePolicy (or None): on a partitioned join, heal stream
+        # skew by splitting hot exchange partitions into rank-interleaved
+        # slices with the build partition replicated per slice
+        self.skew_split = skew_split
+        import threading
+        self._split_lock = threading.Lock()
+        self._split_specs: Optional[List[Tuple[int, int, int]]] = None
+        self._split_planned = False
+        # build partitions replicated across a hot partition's slices
+        # gather ONCE and share (k slices would otherwise re-gather +
+        # re-compact the same build partition k times)
+        self._split_build_cache: dict = {}
+
+    def __getstate__(self):
+        # lore dumps pickle the exec skeleton (utils/lore.py): drop the
+        # lock and the per-run split state, rebuilt on unpickle
+        d = self.__dict__.copy()
+        d["_split_lock"] = None
+        d["_split_specs"] = None
+        d["_split_planned"] = False
+        d["_split_build_cache"] = {}
+        return d
+
+    def __setstate__(self, d):
+        import threading
+        self.__dict__.update(d)
+        self._split_lock = threading.Lock()
 
     def node_string(self):
         part = " partitioned" if self.partitioned else ""
@@ -472,8 +500,55 @@ class TpuSortMergeJoinExec(TpuExec):
         if self.broadcast == "left":
             return self.children[1].num_partitions()
         if self.partitioned:
+            specs = self._skew_specs()
+            if specs is not None:
+                return len(specs)
             return self.children[0].num_partitions()
         return 1
+
+    def _skew_specs(self) -> Optional[List[Tuple[int, int, int]]]:
+        """Adaptive skew-healing read plan for a partitioned join, or
+        None for the 1:1 partition mapping.
+
+        One ``(p, j, k)`` spec per output partition: slice j of k over
+        stream-side exchange partition p (k == 1 reads the partition
+        whole).  Hot partitions — per the exchange's RECORDED partition
+        counts and the adaptive policy's skew threshold — split into
+        rank-interleaved slices (exchange.execute_split), each joined
+        against the build side's whole matching partition; every stream
+        row still sees the full set of its key's build rows, the same
+        correctness argument as ``_broadcast_streamed``, so this spreads
+        a SINGLE hot key across slices — the one case the hash-split
+        path (``_sub_partition_join``) provably cannot."""
+        pol = self.skew_split
+        if pol is None or not self.partitioned:
+            return None
+        lex = self.children[0]
+        if not (hasattr(lex, "execute_split")
+                and hasattr(lex, "aqe_partition_stats")):
+            return None
+        with self._split_lock:
+            if self._split_planned:
+                return self._split_specs
+            self._split_planned = True
+            from spark_rapids_tpu import adaptive as AD
+            from spark_rapids_tpu.adaptive import replanner
+            from spark_rapids_tpu.runtime import stats as stats_mod
+            st = stats_mod.current()
+            rec = st.partition_counts(lex) if st is not None else None
+            unit, counts = (rec if rec is not None
+                            else lex.aqe_partition_stats())
+            if unit != "rows":
+                return None
+            planned = replanner.plan_skew_reads(pol, self.join_type,
+                                                counts)
+            if planned is None:
+                return None
+            specs, detail = planned
+            self.metric("skewSplitJoins").add(len(detail["partitions"]))
+            AD.record_decision(self, "skew-split", **detail)
+            self._split_specs = specs
+            return specs
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         from spark_rapids_tpu.runtime.memory import RetryOOM, get_manager
@@ -481,17 +556,39 @@ class TpuSortMergeJoinExec(TpuExec):
         if jt == "right":
             yield from self._execute_swapped(partition)
             return
+        l_list = r_list = None
         if self.broadcast == "right":
             lpart, rpart = partition, None
         elif self.broadcast == "left":
             lpart, rpart = None, partition
         elif self.partitioned:
             lpart = rpart = partition
+            specs = self._skew_specs()
+            if specs is not None:
+                p, j, k = specs[partition]
+                lpart = rpart = p
+                if k > 1:
+                    # hot partition: rank-interleaved stream slice
+                    # joined against the replicated build partition
+                    with self.timer("gatherTime"):
+                        l_list = [compact(b) for b in
+                                  self.children[0].execute_split(p, j, k)]
+                        with self._split_lock:
+                            r_cached = self._split_build_cache.get(p)
+                            if r_cached is None:
+                                r_cached = _gather_list(
+                                    self.children[1], rpart)
+                                self._split_build_cache[p] = r_cached
+                        # shallow copy: the sub-partition path drains
+                        # its input lists in place; the cache must keep
+                        # its references for the next slice
+                        r_list = list(r_cached)
         else:
             lpart = rpart = None
-        with self.timer("gatherTime"):
-            l_list = _gather_list(self.children[0], lpart)
-            r_list = _gather_list(self.children[1], rpart)
+        if l_list is None:
+            with self.timer("gatherTime"):
+                l_list = _gather_list(self.children[0], lpart)
+                r_list = _gather_list(self.children[1], rpart)
         nokey = jt == "cross" or not self.left_keys
         mgr = get_manager()
         total = (sum(b.nbytes() for b in l_list)
@@ -1136,6 +1233,11 @@ class TpuAdaptiveJoinExec(TpuExec):
                     partitioned=True, using=self.using,
                     sub_partition_rows=self.sub_partition_rows,
                     out_batch_rows=self.out_batch_rows)
+            self._inner._decision_owner = self
+            from spark_rapids_tpu import adaptive as AD
+            AD.record_decision(self, self._mode, build_bytes=rbytes,
+                               threshold=self.threshold,
+                               source="measured")
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:
         self._decide()
@@ -1148,6 +1250,151 @@ class TpuAdaptiveJoinExec(TpuExec):
         n_lp = self._inner.num_partitions()
         for lp in range(partition, n_lp, d):
             yield from self._inner.execute(lp)
+
+
+class TpuAdaptiveLocalJoinExec(TpuExec):
+    """Single-process adaptive join — the adaptive plane's join
+    strategy + skew-split decisions applied at a stage boundary.
+
+    The planner could not prove the build side small (the static
+    broadcast in ``_convert_join`` would have fired), so the strategy
+    defers to runtime:
+
+    * **warm** — the profile store already holds a measured build-side
+      size for this join's subtree signature (``adaptive.historyPath``):
+      decide from history, execute nothing early;
+    * **cold** — materialize the build side once off its own pump,
+      decide from its measured LIVE bytes, and replay the batches into
+      whichever plan wins (nothing executes twice — the
+      ``TpuAdaptiveJoinExec`` stage-boundary protocol, minus the mesh).
+
+    Broadcast eliminates the exchange entirely; shuffled co-partitions
+    both sides through hash exchanges and hands the adaptive policy to
+    the partitioned join so recorded partition skew splits hot stream
+    partitions (``TpuSortMergeJoinExec._skew_specs``)."""
+
+    def __init__(self, join_type: str, left_keys, right_keys, condition,
+                 schema, left: TpuExec, right: TpuExec, policy,
+                 nparts: int, hash_ok: bool, using: bool,
+                 sub_partition_rows: int, out_batch_rows):
+        super().__init__(schema, left, right)
+        self.join_type = join_type
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.condition = condition
+        self.policy = policy
+        self.nparts = int(nparts)
+        # mixed-width int key pairs hash differently per side through
+        # the plain (canon-less) hash exchange — those plans may still
+        # flip to broadcast but never to shuffled
+        self.hash_ok = bool(hash_ok)
+        self.using = using
+        self.sub_partition_rows = sub_partition_rows
+        self.out_batch_rows = out_batch_rows
+        import threading
+        self._lock = threading.Lock()
+        self._inner: Optional[TpuSortMergeJoinExec] = None
+        self._mode: Optional[str] = None
+
+    def __getstate__(self):
+        # lore dumps pickle the exec skeleton (utils/lore.py): drop the
+        # lock and the runtime decision, re-decided on unpickle
+        d = self.__dict__.copy()
+        d["_lock"] = None
+        d["_inner"] = None
+        d["_mode"] = None
+        return d
+
+    def __setstate__(self, d):
+        import threading
+        self.__dict__.update(d)
+        self._lock = threading.Lock()
+
+    def node_string(self):
+        mode = self._mode or "undecided"
+        return (f"TpuAdaptiveLocalJoin [{self.join_type} runtime={mode} "
+                f"thresh={self.policy.broadcast_threshold}]")
+
+    def num_partitions(self) -> int:
+        self._decide()
+        return self._inner.num_partitions()
+
+    def _decide(self):
+        with self._lock:
+            if self._inner is not None:
+                return
+            from spark_rapids_tpu import adaptive as AD
+            from spark_rapids_tpu.adaptive import cost_model, replanner
+            pol = self.policy
+            sig = cost_model.subtree_signature(self.children[1])
+            r_list = None
+            decided = replanner.decide_join_from_history(pol, sig)
+            if (decided is None and pol.wants_join
+                    and pol.broadcast_threshold > 0):
+                # cold query: measure the build side off its own pump.
+                # LIVE bytes, not bucket capacity (a filtered side
+                # keeps its scan bucket but holds few live rows)
+                from spark_rapids_tpu.exec.basic import (
+                    _overlapped_live_counts)
+                with self.timer("measureTime"):
+                    r_list = _gather_list(self.children[1])
+                    counts = _overlapped_live_counts(r_list)
+                rbytes = sum(
+                    n * max(1, b.nbytes() // max(b.capacity, 1))
+                    for n, b in zip(counts, r_list))
+                decided = replanner.decide_join_from_measurement(
+                    pol, sig, rbytes)
+            if decided is None:
+                # join strategy gated off: keep the shuffled plan
+                # shape (skew splitting is the remaining decision)
+                decided = ("shuffled",
+                           {"threshold": pol.broadcast_threshold,
+                            "build_sig": sig, "source": "conf"})
+            strategy, detail = decided
+            build = (_ReplayExec(self.children[1].schema, r_list)
+                     if r_list is not None else self.children[1])
+            if strategy == "broadcast":
+                self.metric("adaptiveBroadcastJoins").add(1)
+                inner = TpuSortMergeJoinExec(
+                    self.join_type, self.left_keys, self.right_keys,
+                    self.condition, self.schema, self.children[0],
+                    TpuBroadcastExchangeExec(build), using=self.using,
+                    broadcast="right",
+                    sub_partition_rows=self.sub_partition_rows,
+                    out_batch_rows=self.out_batch_rows)
+            elif self.hash_ok:
+                self.metric("adaptiveShuffledJoins").add(1)
+                from spark_rapids_tpu.exec.exchange import (
+                    TpuShuffleExchangeExec)
+                lex = TpuShuffleExchangeExec(self.children[0],
+                                             self.nparts, self.left_keys)
+                rex = TpuShuffleExchangeExec(build, self.nparts,
+                                             self.right_keys)
+                inner = TpuSortMergeJoinExec(
+                    self.join_type, self.left_keys, self.right_keys,
+                    self.condition, self.schema, lex, rex,
+                    partitioned=True, using=self.using,
+                    sub_partition_rows=self.sub_partition_rows,
+                    out_batch_rows=self.out_batch_rows,
+                    skew_split=pol if pol.wants_skew else None)
+            else:
+                self.metric("adaptiveShuffledJoins").add(1)
+                inner = TpuSortMergeJoinExec(
+                    self.join_type, self.left_keys, self.right_keys,
+                    self.condition, self.schema, self.children[0],
+                    build, using=self.using,
+                    sub_partition_rows=self.sub_partition_rows,
+                    out_batch_rows=self.out_batch_rows)
+            # runtime-built subtree is invisible to the plan walk:
+            # decisions made inside it surface on this node
+            inner._decision_owner = self
+            self._mode = strategy
+            self._inner = inner
+            AD.record_decision(self, strategy, **detail)
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        self._decide()
+        yield from self._inner.execute(partition)
 
 
 def _tag_join(meta):
@@ -1250,6 +1497,21 @@ def _convert_join(cpu, ch, conf):
                                     cpu.schema, lex, rex,
                                     partitioned=True, using=cpu.using,
                                     **bounds)
+    if (not multiproc and cpu.left_keys
+            and jt in ("inner", "left", "left_semi", "left_anti")):
+        # single-process adaptive plane: defer broadcast-vs-shuffled to
+        # observed build cardinality and heal recorded partition skew
+        from spark_rapids_tpu import adaptive as AD
+        pol = AD.policy_from_conf(conf)
+        if pol.enabled and (pol.wants_join or pol.wants_skew):
+            hash_ok = all(
+                type(le.dtype) is type(re.dtype)
+                for le, re in zip(cpu.left_keys, cpu.right_keys))
+            return TpuAdaptiveLocalJoinExec(
+                jt, cpu.left_keys, cpu.right_keys, cpu.condition,
+                cpu.schema, ch[0], ch[1], pol,
+                conf.get(C.SHUFFLE_PARTITIONS), hash_ok, cpu.using,
+                bounds["sub_partition_rows"], bounds["out_batch_rows"])
     return TpuSortMergeJoinExec(cpu.join_type, cpu.left_keys,
                                 cpu.right_keys, cpu.condition, cpu.schema,
                                 ch[0], ch[1], using=cpu.using, **bounds)

@@ -152,6 +152,21 @@ def bucket_batch(batch, policy: Optional[ShapePolicy] = None):
     return out, pad
 
 
+def retarget_bucket(rows: int) -> int:
+    """Canonical bucket for an adaptive row target (adaptive plane's
+    dynamic batch retargeting): when bucketing is on, snap the target
+    to the ladder so retargeted reads coalesce onto compile-cached
+    batch shapes instead of minting fresh (op, schema, bucket) keys;
+    with the plane off, pow-2 round-up keeps the target on the native
+    capacities producers already emit."""
+    from spark_rapids_tpu.columnar.column import round_up_pow2
+    rows = max(int(rows), 1)
+    pol = _POLICY
+    if pol.enabled:
+        return pol.bucket_for(rows)
+    return round_up_pow2(rows)
+
+
 def snapshot() -> Tuple[int, int, int, int]:
     """(hits, misses, pad_rows, pad_bytes) — bench cold/warm deltas."""
     return (_TM_HITS.value, _TM_MISSES.value,

@@ -64,6 +64,8 @@ STATS_FIELDS = {
     "kernel_backend": "kernel-plane backend that produced this "
                       "operator's results (jnp/fused/pallas; 'mixed' "
                       "when dispatches disagreed across batches)",
+    "adaptive": "adaptive-plane decisions applied at this operator "
+                "(kind + triggering stat + chosen action)",
 }
 
 _HIST_CAP = 1 << 30
@@ -136,7 +138,7 @@ class NodeStats:
 
     __slots__ = ("rows", "batches", "bytes", "hist", "nulls", "observed",
                  "partitions", "partition_unit", "executors", "padded",
-                 "kernel_backend", "_lock")
+                 "kernel_backend", "decisions", "_lock")
 
     def __init__(self):
         self.rows = 0
@@ -151,6 +153,8 @@ class NodeStats:
         self.partitions: Optional[List[int]] = None
         self.partition_unit = "rows"
         self.executors = 1
+        # adaptive-plane decisions applied at this node, in order
+        self.decisions: List[Dict[str, Any]] = []
         self._lock = threading.Lock()
 
     def add_batch(self, n: int, nbytes: int,
@@ -186,6 +190,10 @@ class NodeStats:
             self.partitions = [int(c) for c in counts]
             self.partition_unit = unit
             self.executors = executors
+
+    def add_decision(self, kind: str, detail: Dict[str, Any]) -> None:
+        with self._lock:
+            self.decisions.append({"kind": kind, **detail})
 
 
 class OpStatsCollector:
@@ -277,6 +285,14 @@ class OpStatsCollector:
         cluster-merged when ``executors`` > 1)."""
         self.node_stats(node).set_partitions(counts, unit, executors)
 
+    def record_decision(self, node, kind: str,
+                        detail: Dict[str, Any]) -> None:
+        """One adaptive-plane decision applied at ``node`` (the
+        adaptive plane calls this through
+        ``adaptive.record_decision``, which also bumps the telemetry
+        counter)."""
+        self.node_stats(node).add_decision(kind, detail)
+
     # -- AQE read side ------------------------------------------------------
     def partition_counts(self, node
                          ) -> Optional[Tuple[str, List[int]]]:
@@ -287,6 +303,16 @@ class OpStatsCollector:
         if ns is None or ns.partitions is None:
             return None
         return ns.partition_unit, list(ns.partitions)
+
+    def observed(self, node) -> Optional[Tuple[int, int]]:
+        """``(rows, bytes)`` observed leaving ``node`` so far, or None
+        when the node never pumped — the batch-retargeting input (the
+        adaptive read replans from RECORDED observations, never a
+        fresh device sync)."""
+        ns = self._nodes.get(id(node))
+        if ns is None:
+            return None
+        return ns.rows, ns.bytes
 
     # -- reporting ----------------------------------------------------------
     def report(self, plan, rollup: Optional[dict] = None,
@@ -351,6 +377,8 @@ class OpStatsCollector:
                     "skewed": rec["skewed"],
                     "executors": ns.executors,
                 })
+            if ns.decisions:
+                rec["adaptive"] = [dict(d) for d in ns.decisions]
             if rollup:
                 r = rollup.get(node.name)
                 if r is not None:
@@ -370,6 +398,11 @@ class OpStatsCollector:
             "ops": ops,
             "exchanges": exchanges,
         }
+        decisions = [{"op": rec["op"], "sig": rec["sig"],
+                      "path": rec["path"], **d}
+                     for rec in ops for d in rec.get("adaptive", ())]
+        if decisions:
+            out["adaptive_decisions"] = decisions
         if wall_s is not None:
             out["wall_s"] = wall_s
         return out

@@ -363,7 +363,7 @@ def ensure_producers() -> None:
     for mod in ("runtime.cancel", "runtime.memory", "runtime.semaphore",
                 "runtime.scheduler",
                 "runtime.kernel_cache", "runtime.resilience",
-                "runtime.lockdep", "runtime.shapes",
+                "runtime.lockdep", "runtime.shapes", "adaptive",
                 "shuffle.manager", "shuffle.exchange",
                 "parallel.executor", "parallel.shuffle",
                 "parallel.rendezvous", "exec.distributed",

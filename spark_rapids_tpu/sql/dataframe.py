@@ -969,6 +969,9 @@ class DataFrame:
             entry["op_stats"] = profile["ops"]
             if profile["exchanges"]:
                 entry["exchange_stats"] = profile["exchanges"]
+            if profile.get("adaptive_decisions"):
+                entry["adaptive_decisions"] = (
+                    profile["adaptive_decisions"])
             self._last_profile = profile
             self.session._last_profile = profile
             store = str(conf.get(C.STATS_STORE_PATH))
@@ -1221,6 +1224,20 @@ class DataFrame:
                 ann += " fused"
             if rec.get("kernel_backend"):
                 ann += f" kernel={rec['kernel_backend']}"
+            if rec.get("adaptive"):
+                labels = []
+                for d in rec["adaptive"]:
+                    kind = d.get("kind")
+                    if kind == "skew-split":
+                        labels.extend(
+                            f"skew-split({k})"
+                            for k in d.get("splits", ()) or ("?",))
+                    elif kind == "batch-retarget":
+                        labels.append(
+                            f"batch-retarget({d.get('target_rows')})")
+                    else:
+                        labels.append(str(kind))
+                ann += " adaptive=" + ",".join(labels)
             lines.append("  " * depth
                          + ("*" if node.is_tpu else "")
                          + node.node_string() + f"  [{ann}]")

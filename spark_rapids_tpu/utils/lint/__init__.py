@@ -58,6 +58,12 @@ Rules (catalog in docs/static_analysis.md):
                       sync is the exactness protocol) — kernel bodies
                       are traced device code; a host pull there
                       serializes the async pump on every batch
+``adaptive-purity``   the same host-materialization flags inside ANY
+                      function of the adaptive plane (adaptive/) —
+                      replanner decisions must come from recorded
+                      stats, history, or conf, never a fresh device
+                      sync in the planning path; measurement lives in
+                      the exec layer, which hands the numbers in
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -211,6 +217,8 @@ def iter_modules(pkg_dir: Optional[str] = None) -> List[SourceModule]:
 
 
 def all_rules() -> List[Rule]:
+    from spark_rapids_tpu.utils.lint.adaptive_purity import (
+        AdaptivePurityRule)
     from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
     from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
     from spark_rapids_tpu.utils.lint.exchange_purity import (
@@ -227,7 +235,7 @@ def all_rules() -> List[Rule]:
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
             SchedulerBypassRule(), RawJitRule(), ExchangePurityRule(),
-            KernelPurityRule()]
+            KernelPurityRule(), AdaptivePurityRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

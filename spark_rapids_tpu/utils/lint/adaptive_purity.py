@@ -1,0 +1,52 @@
+"""``adaptive-purity`` — replanner decisions come from recorded stats.
+
+The adaptive plane (``spark_rapids_tpu/adaptive/``) is the PLANNING
+path: its cost model and replanner run at stage boundaries, often
+under exec-node locks, and decide from stats the pumps already
+recorded, profile-store history, and conf.  A ``.block_until_ready()``
+/ ``.item()`` / ``np.asarray`` host pull there is a fresh device sync
+smuggled into planning — it serializes the async pipeline at exactly
+the point the plane exists to keep cheap, and it makes decisions
+depend on device state instead of the recorded stats they claim to
+explain.  Measurement that must touch the device (gathering a build
+side, counting partition rows) belongs in the exec layer, which hands
+the numbers in.  Same shape as ``kernel-purity``; the flag tables are
+shared with ``exchange-purity`` so the three rules can't drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+from spark_rapids_tpu.utils.lint.exchange_purity import (
+    ExchangePurityRule)
+
+SCOPE_PREFIX = "spark_rapids_tpu/adaptive/"
+
+
+class AdaptivePurityRule(Rule):
+    name = "adaptive-purity"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        if not mod.rel.startswith(SCOPE_PREFIX):
+            return ()
+        flag = ExchangePurityRule()._flag
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            for sub in ast.walk(node):
+                msg = flag(sub)
+                if msg and sub.lineno not in seen:
+                    seen.add(sub.lineno)
+                    out.append(Finding(
+                        self.name, mod.rel, sub.lineno,
+                        f"{msg} inside adaptive-plane function "
+                        f"`{node.name}` — replanner decisions must "
+                        f"come from recorded stats or conf "
+                        f"(`{mod.snippet(sub.lineno)}`)"))
+        return out

@@ -20,14 +20,19 @@ per file:
 Usage::
 
     python -m spark_rapids_tpu.utils.profile top    <input> [--n N]
+        [--adaptive]
     python -m spark_rapids_tpu.utils.profile skew   <input>
     python -m spark_rapids_tpu.utils.profile storms <input>
     python -m spark_rapids_tpu.utils.profile diff   <a> <b>
         [--threshold R] [--min-self-s S]
 
-``diff`` compares per-op self-times of two runs (keys matched by plan
-signature when both sides have one) and exits nonzero when any op
-regressed by >= the threshold ratio — the bench gate's verdict.
+``top --adaptive`` additionally lists each query's adaptive-plane
+decisions (broadcast/shuffled/skew-split/batch-retarget) with the
+triggering stat.  ``diff`` compares per-op self-times of two runs
+(keys matched by plan signature when both sides have one) and exits
+nonzero when any op regressed by >= the threshold ratio — the bench
+gate's verdict; joins whose adaptive strategy flipped between the two
+inputs are flagged as ``DECISION FLIP`` (informational).
 """
 
 from __future__ import annotations
@@ -131,7 +136,8 @@ def load_runs(path: str) -> List[dict]:
             runs.append({"label": q, "ops": ops,
                          "exchanges": (st.get("exchanges") or []),
                          "compiles": (crec or {}).get("cold_compiles"),
-                         "compile_rec": crec, "wall_s": None})
+                         "compile_rec": crec, "wall_s": None,
+                         "decisions": st.get("adaptive_decisions") or []})
         return runs
     for r in records:
         if kind == "profile-store":
@@ -142,7 +148,8 @@ def load_runs(path: str) -> List[dict]:
                          "ops": ops,
                          "exchanges": r.get("exchanges") or [],
                          "compiles": None,
-                         "wall_s": r.get("wall_s")})
+                         "wall_s": r.get("wall_s"),
+                         "decisions": r.get("adaptive_decisions") or []})
             continue
         # event log: prefer the stats plane's op_stats, fall back to
         # the trace rollup alone
@@ -163,7 +170,8 @@ def load_runs(path: str) -> List[dict]:
                      "exchanges": r.get("exchange_stats") or [],
                      "compiles": compiles,
                      "wall_s": r.get("wall_s"),
-                     "health": r.get("health") or []})
+                     "health": r.get("health") or [],
+                     "decisions": r.get("adaptive_decisions") or []})
     return runs
 
 
@@ -214,6 +222,70 @@ def report_top(runs: List[dict], n: int) -> List[str]:
                 extra += f" bytes={v['bytes_out']}"
         lines.append(f"  {key}: self={v['self_s']:.6f}s "
                      f"total={v['total_s']:.6f}s{extra}")
+    return lines
+
+
+def _fmt_decision(d: dict) -> str:
+    """One adaptive decision with its triggering stat, one line."""
+    kind = d.get("kind")
+    where = f"{d.get('op')}[{d.get('sig', '')}]"
+    if kind in ("broadcast", "shuffled"):
+        return (f"{where}: {kind} (build_bytes={d.get('build_bytes')} "
+                f"threshold={d.get('threshold')} "
+                f"source={d.get('source')})")
+    if kind == "skew-split":
+        return (f"{where}: skew-split (partitions={d.get('partitions')} "
+                f"splits={d.get('splits')} rows={d.get('rows')} "
+                f"skew={d.get('skew_factor')} "
+                f"threshold={d.get('threshold')})")
+    if kind == "batch-retarget":
+        return (f"{where}: batch-retarget "
+                f"(target_rows={d.get('target_rows')} "
+                f"observed_row_bytes={d.get('observed_row_bytes')} "
+                f"static_row_bytes={d.get('static_row_bytes')})")
+    return f"{where}: {kind} ({d})"
+
+
+def report_adaptive(runs: List[dict]) -> List[str]:
+    lines = [f"adaptive decisions over {len(runs)} run(s):"]
+    found = False
+    for run in runs:
+        for d in run.get("decisions") or []:
+            found = True
+            lines.append(f"  {run['label']} {_fmt_decision(d)}")
+    if not found:
+        lines.append("  (no adaptive decisions in this input — run "
+                     "with spark.rapids.tpu.adaptive.enabled)")
+    return lines
+
+
+def _join_decisions(runs: List[dict]) -> Dict[str, str]:
+    """Latest join-strategy decision per join identity (build-side
+    subtree signature when recorded, else op signature + path) — the
+    diff side's flip detector input."""
+    out: Dict[str, str] = {}
+    for run in runs:
+        for d in run.get("decisions") or []:
+            if d.get("kind") not in ("broadcast", "shuffled"):
+                continue
+            key = (d.get("build_sig")
+                   or f"{d.get('op')}[{d.get('sig', '')}]/"
+                      f"{d.get('path', '')}")
+            out[key] = d["kind"]
+    return out
+
+
+def report_decision_flips(a_runs: List[dict], b_runs: List[dict]
+                          ) -> List[str]:
+    """Joins whose adaptive strategy flipped between two runs —
+    informational in diff output (a flip explains a self-time shift;
+    it is not itself a regression)."""
+    a_dec, b_dec = _join_decisions(a_runs), _join_decisions(b_runs)
+    lines: List[str] = []
+    for key in sorted(set(a_dec) & set(b_dec)):
+        if a_dec[key] != b_dec[key]:
+            lines.append(f"  DECISION FLIP {key}: "
+                         f"{a_dec[key]} -> {b_dec[key]}")
     return lines
 
 
@@ -333,6 +405,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         sp.add_argument("input")
         if name == "top":
             sp.add_argument("--n", type=int, default=10)
+            sp.add_argument("--adaptive", action="store_true",
+                            help="also list per-query adaptive-plane "
+                                 "decisions with the triggering stat")
     dp = sub.add_parser("diff", help="regression diff: b vs baseline a "
                                      "(nonzero exit on regression)")
     dp.add_argument("a", help="baseline input")
@@ -352,7 +427,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             raise SystemExit(EXIT_BAD_INPUT)
 
     if args.cmd == "top":
-        print("\n".join(report_top(load(args.input), args.n)))
+        runs = load(args.input)
+        print("\n".join(report_top(runs, args.n)))
+        if args.adaptive:
+            print("\n".join(report_adaptive(runs)))
         return EXIT_OK
     if args.cmd == "skew":
         print("\n".join(report_skew(load(args.input))))
@@ -360,9 +438,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.cmd == "storms":
         print("\n".join(report_storms(load(args.input))))
         return EXIT_OK
-    lines, regressions = diff_runs(load(args.a), load(args.b),
+    a_runs, b_runs = load(args.a), load(args.b)
+    lines, regressions = diff_runs(a_runs, b_runs,
                                    threshold=args.threshold,
                                    min_self_s=args.min_self_s)
+    lines.extend(report_decision_flips(a_runs, b_runs))
     print("\n".join(lines))
     return EXIT_REGRESSION if regressions else EXIT_OK
 

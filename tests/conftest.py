@@ -82,13 +82,17 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(config, items):
-    # The kernel backend-identity matrix is the newest and most
-    # compile-heavy module in the suite.  Tier-1 runs under a hard
-    # wall-clock budget (see ROADMAP.md), so keep the long-established
-    # regression signal in front and let the matrix run last — a
-    # harness-level timeout then cuts into the newest tests first
-    # instead of displacing the seed suite past the horizon.
-    items.sort(key=lambda it: it.fspath.basename == "test_kernels.py")
+    # The kernel backend-identity matrix and the adaptive-plane
+    # bit-identity matrix are the newest and most compile-heavy modules
+    # in the suite (test_adaptive would otherwise run FIRST
+    # alphabetically).  Tier-1 runs under a hard wall-clock budget (see
+    # ROADMAP.md), so keep the long-established regression signal in
+    # front and let the newest matrices run last — a harness-level
+    # timeout then cuts into the newest tests first instead of
+    # displacing the seed suite past the horizon.
+    items.sort(key=lambda it: (
+        it.fspath.basename in ("test_adaptive.py", "test_kernels.py"),
+        it.fspath.basename == "test_kernels.py"))
 
 
 @pytest.hookimpl(hookwrapper=True)

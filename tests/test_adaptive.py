@@ -1,0 +1,511 @@
+"""Adaptive execution plane: cost model, replanner, bit-identity.
+
+The decision matrix (broadcast flip, skew split, batch retarget) must
+never change ANSWERS — every integration test here runs the same query
+with the plane on, off, and on the CPU oracle, and compares sorted
+tables exactly.  [REF: Spark AQE semantics — replanning is a physical
+rewrite, never a logical one]
+"""
+
+import json
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from spark_rapids_tpu import adaptive as AD
+from spark_rapids_tpu.adaptive import cost_model, replanner
+from spark_rapids_tpu.runtime import stats
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.datagen import (
+    SkewedLongGen, StringGen, gen_table, skewed_null_table)
+from spark_rapids_tpu.utils.harness import cpu_session, tpu_session
+
+
+def _find(node, name):
+    if type(node).__name__ == name:
+        return node
+    for c in node.children:
+        r = _find(c, name)
+        if r is not None:
+            return r
+    return None
+
+
+def _canon(t: pa.Table) -> pa.Table:
+    """Row-order-free canonical form: sort by every column."""
+    t = t.combine_chunks()
+    idx = pc.sort_indices(
+        t, sort_keys=[(n, "ascending") for n in t.column_names])
+    return t.take(idx)
+
+
+def _assert_identical(a: pa.Table, b: pa.Table, what: str):
+    assert _canon(a).equals(_canon(b)), f"{what}: tables differ"
+
+
+# -- cost model (pure units) -------------------------------------------------
+
+def test_choose_join_strategy_threshold():
+    assert cost_model.choose_join_strategy(100, 1000) == "broadcast"
+    assert cost_model.choose_join_strategy(1000, 1000) == "broadcast"
+    assert cost_model.choose_join_strategy(1001, 1000) == "shuffled"
+    # threshold 0/-1 = broadcast disabled entirely
+    assert cost_model.choose_join_strategy(0, 0) == "shuffled"
+    assert cost_model.choose_join_strategy(1, -1) == "shuffled"
+
+
+def test_plan_skew_splits_hot_partition():
+    counts = [100, 100, 100, 5000]
+    splits = cost_model.plan_skew_splits(
+        counts, skew_threshold=2.0, target_rows=1000, max_splits=8)
+    assert splits == {3: 5}  # ceil(5000/1000)
+
+
+def test_plan_skew_splits_clamps_to_max():
+    splits = cost_model.plan_skew_splits(
+        [10, 10_000], skew_threshold=1.5, target_rows=100, max_splits=4)
+    assert splits == {1: 4}
+
+
+def test_plan_skew_splits_ignores_small_and_uniform():
+    # lopsided but tiny: not worth replicating the build side
+    assert cost_model.plan_skew_splits(
+        [1, 50], skew_threshold=2.0, target_rows=100, max_splits=8) == {}
+    # heavy but uniform: nothing exceeds threshold x mean
+    assert cost_model.plan_skew_splits(
+        [5000, 5000], skew_threshold=2.0, target_rows=100,
+        max_splits=8) == {}
+    assert cost_model.plan_skew_splits(
+        [], skew_threshold=2.0, target_rows=100, max_splits=8) == {}
+
+
+def test_retarget_rows_ratio_gate():
+    # static estimate within 1.25x of reality: leave the target alone
+    assert cost_model.retarget_rows(1 << 20, 1000, 10_000, 10) is None
+    # observed rows 10x fatter than estimated: shrink the row target
+    got = cost_model.retarget_rows(1 << 20, 1000, 100_000, 10)
+    assert got == (1 << 20) // 100
+    # thinner than estimated: grow it
+    got = cost_model.retarget_rows(1 << 20, 1000, 2_000, 10)
+    assert got == (1 << 20) // 2
+    assert cost_model.retarget_rows(1 << 20, 0, 0, 10) is None
+
+
+def test_subtree_signature_stable_and_discriminating():
+    class _Node:
+        def __init__(self, name, fields, children=()):
+            self._n, self._f = name, fields
+            self.children = list(children)
+
+        @property
+        def name(self):
+            return self._n
+
+        @property
+        def schema(self):
+            fields = self._f
+
+            class _S:
+                def field_names(self):
+                    return list(fields)
+            return _S()
+
+    a = _Node("Scan", ["k", "v"])
+    b = _Node("Filter", ["k", "v"], [a])
+    assert (cost_model.subtree_signature(b)
+            == cost_model.subtree_signature(
+                _Node("Filter", ["k", "v"], [_Node("Scan", ["k", "v"])])))
+    assert (cost_model.subtree_signature(b)
+            != cost_model.subtree_signature(
+                _Node("Filter", ["k", "w"], [a])))
+    assert (cost_model.subtree_signature(a)
+            != cost_model.subtree_signature(b))
+
+
+def test_history_build_bytes_most_recent_wins(tmp_path):
+    store = str(tmp_path / "store.jsonl")
+    stats.append_profile(store, {"adaptive_decisions": [
+        {"kind": "shuffled", "build_sig": "aaa", "build_bytes": 999}]})
+    stats.append_profile(store, {"adaptive_decisions": [
+        {"kind": "broadcast", "build_sig": "aaa", "build_bytes": 7},
+        {"kind": "broadcast", "build_sig": "bbb", "build_bytes": 11}]})
+    assert cost_model.history_build_bytes(store, "aaa") == 7
+    assert cost_model.history_build_bytes(store, "bbb") == 11
+    assert cost_model.history_build_bytes(store, "zzz") is None
+    assert cost_model.history_build_bytes("", "aaa") is None
+    assert cost_model.history_build_bytes(
+        str(tmp_path / "missing.jsonl"), "aaa") is None
+
+
+# -- replanner (pure units) --------------------------------------------------
+
+def _pol(**kw):
+    base = dict(enabled=True, skew_threshold=2.0, max_splits=8,
+                target_rows=1000, broadcast_threshold=1 << 20)
+    base.update(kw)
+    return AD.AdaptivePolicy(**base)
+
+
+def test_plan_skew_reads_specs_cover_every_partition():
+    specs, detail = replanner.plan_skew_reads(
+        _pol(), "inner", [100, 100, 5000, 100])
+    # partitions 0,1,3 read whole; partition 2 in 5 slices
+    assert specs == ([(0, 0, 1), (1, 0, 1)]
+                     + [(2, j, 5) for j in range(5)]
+                     + [(3, 0, 1)])
+    assert detail["partitions"] == [2]
+    assert detail["splits"] == [5]
+    assert detail["rows"] == [5000]
+    assert detail["skew_factor"] > 3
+
+
+def test_plan_skew_reads_gates():
+    # full outer join: a stream row's NULL-extension depends on every
+    # slice — not streamable, never split
+    assert replanner.plan_skew_reads(_pol(), "full",
+                                     [100, 5000]) is None
+    assert replanner.plan_skew_reads(_pol(skew_split=False), "inner",
+                                     [100, 5000]) is None
+    assert replanner.plan_skew_reads(_pol(enabled=False), "inner",
+                                     [100, 5000]) is None
+    assert replanner.plan_skew_reads(_pol(), "inner",
+                                     [100, 100]) is None
+
+
+def test_decide_join_from_history_roundtrip(tmp_path):
+    store = str(tmp_path / "store.jsonl")
+    stats.append_profile(store, {"adaptive_decisions": [
+        {"kind": "broadcast", "build_sig": "sig1", "build_bytes": 64}]})
+    pol = _pol(history_path=store)
+    strategy, detail = replanner.decide_join_from_history(pol, "sig1")
+    assert strategy == "broadcast"
+    assert detail["source"] == "history"
+    assert detail["build_bytes"] == 64
+    # huge recorded build side: history says shuffled
+    stats.append_profile(store, {"adaptive_decisions": [
+        {"kind": "broadcast", "build_sig": "sig1",
+         "build_bytes": 1 << 30}]})
+    strategy, _ = replanner.decide_join_from_history(pol, "sig1")
+    assert strategy == "shuffled"
+    assert replanner.decide_join_from_history(pol, "nosuch") is None
+    assert replanner.decide_join_from_history(
+        _pol(join_strategy=False, history_path=store), "sig1") is None
+
+
+def test_retarget_read_rows_snaps_to_bucket():
+    pol = _pol()
+    got = replanner.retarget_read_rows(
+        pol, target_bytes=1 << 20, static_row_bytes=10,
+        observed_rows=1000, observed_bytes=100_000)
+    assert got is not None
+    target, detail = got
+    assert target & (target - 1) == 0  # a pow-2 bucket
+    assert detail["observed_row_bytes"] == 100.0
+    assert replanner.retarget_read_rows(
+        _pol(batch_retarget=False), 1 << 20, 10, 1000, 100_000) is None
+
+
+def test_policy_from_conf_defaults_and_inheritance(tmp_path):
+    s = tpu_session()
+    pol = AD.policy_from_conf(s.rapids_conf())
+    assert pol.enabled is False  # off by default
+    assert not pol.wants_join and not pol.wants_skew
+    assert not pol.wants_retarget
+    store = str(tmp_path / "profiles.jsonl")
+    s2 = tpu_session({
+        "spark.rapids.tpu.adaptive.enabled": True,
+        "spark.rapids.tpu.stats.skewThreshold": 3.5,
+        "spark.rapids.tpu.stats.storePath": store})
+    pol2 = AD.policy_from_conf(s2.rapids_conf())
+    assert pol2.enabled and pol2.wants_join and pol2.wants_skew
+    # skewThreshold 0 inherits the stats plane's bar; historyPath ""
+    # inherits the stats store
+    assert pol2.skew_threshold == 3.5
+    assert pol2.history_path == store
+    s3 = tpu_session({
+        "spark.rapids.tpu.adaptive.enabled": True,
+        "spark.rapids.tpu.adaptive.skewThreshold": 1.5,
+        "spark.rapids.tpu.adaptive.historyPath": "/elsewhere.jsonl"})
+    pol3 = AD.policy_from_conf(s3.rapids_conf())
+    assert pol3.skew_threshold == 1.5
+    assert pol3.history_path == "/elsewhere.jsonl"
+
+
+# -- bit-identity matrix -----------------------------------------------------
+
+_SKEW_CONF = {
+    "spark.rapids.tpu.stats.enabled": True,
+    # threshold 0 kills the static broadcast fast-path AND the adaptive
+    # measurement: the plan must go shuffled so skew splitting engages
+    "spark.sql.autoBroadcastJoinThreshold": 0,
+    "spark.rapids.tpu.join.targetRows": 2048,
+    "spark.rapids.tpu.batchRows": 8192,
+}
+
+
+def _skew_tables():
+    n = 20_000
+    stream = gen_table(
+        [SkewedLongGen(hot_mass=0.6, distinct=2048, nullable=False)],
+        n, seed=11, names=["k"])
+    stream = stream.append_column(
+        "v", pa.array(np.arange(n, dtype=np.int64)))
+    build = pa.table({"k": np.arange(2048, dtype=np.int64),
+                      "b": np.arange(2048, dtype=np.int64) * 3})
+    return stream, build
+
+
+def _join(s, stream, build, how="inner"):
+    return s.createDataFrame(stream).join(
+        s.createDataFrame(build), on="k", how=how)
+
+
+def test_skew_split_bit_identity():
+    stream, build = _skew_tables()
+    on = dict(_SKEW_CONF)
+    on["spark.rapids.tpu.adaptive.enabled"] = True
+    df_on = _join(tpu_session(on), stream, build)
+    t_on = df_on.toArrow()
+    t_off = _join(tpu_session(_SKEW_CONF), stream, build).toArrow()
+    t_cpu = _join(cpu_session(), stream, build).toArrow()
+    _assert_identical(t_on, t_off, "adaptive on vs off")
+    _assert_identical(t_on, t_cpu, "adaptive on vs cpu")
+    prof = df_on.session.last_query_profile()
+    kinds = {d["kind"] for d in prof["adaptive_decisions"]}
+    assert "skew-split" in kinds, prof["adaptive_decisions"]
+    node = _find(df_on._last_plan, "TpuAdaptiveLocalJoinExec")
+    assert node is not None and node._mode == "shuffled"
+
+
+# ~22s of one-off compiles (left join + null-heavy doubles/strings at
+# small buckets); the inner-join case above keeps the split path in
+# tier-1 and this nastier variant rides tier 2
+@pytest.mark.slow
+def test_skew_split_left_join_skewed_null_table():
+    # null-heavy left join over the canonical nasty table: null stream
+    # keys match nothing but must survive the split exactly once
+    stream = skewed_null_table(12_000, seed=5, hot_mass=0.6)
+    build = pa.table({"k": np.arange(0, 4096, dtype=np.int64),
+                      "b": np.arange(4096, dtype=np.int64)})
+    on = dict(_SKEW_CONF)
+    on["spark.rapids.tpu.adaptive.enabled"] = True
+
+    def q(s):
+        return _join(s, stream, build, how="left").select(
+            "k", "v", "b")
+
+    t_on = q(tpu_session(on)).toArrow()
+    t_off = q(tpu_session(_SKEW_CONF)).toArrow()
+    t_cpu = q(cpu_session()).toArrow()
+    _assert_identical(t_on, t_off, "left-join adaptive on vs off")
+    _assert_identical(t_on, t_cpu, "left-join adaptive on vs cpu")
+
+
+def test_broadcast_flip_mid_query():
+    # plan-time can't prove the build side small: the size estimate is
+    # an upper bound that ignores the filter, so the whole-table ~33KB
+    # exceeds the 4KB threshold — the adaptive join measures the ~100
+    # live rows mid-query and flips the shuffled plan to broadcast
+    stream, build = _skew_tables()
+    conf = {"spark.rapids.tpu.stats.enabled": True,
+            "spark.rapids.tpu.adaptive.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": 4096,
+            "spark.rapids.tpu.batchRows": 8192}
+
+    def q(s):
+        b = s.createDataFrame(build).filter(col("k") < 100)
+        return s.createDataFrame(stream).join(b, on="k", how="inner")
+
+    df_on = q(tpu_session(conf))
+    t_on = df_on.toArrow()
+    t_cpu = q(cpu_session()).toArrow()
+    _assert_identical(t_on, t_cpu, "broadcast flip vs cpu")
+    node = _find(df_on._last_plan, "TpuAdaptiveLocalJoinExec")
+    assert node is not None
+    assert node._mode == "broadcast"
+    assert "runtime=broadcast" in node.node_string()
+    assert node.metrics["adaptiveBroadcastJoins"].value == 1
+    dec = [d for d in df_on.session.last_query_profile()
+           ["adaptive_decisions"] if d["kind"] == "broadcast"]
+    assert dec and dec[0]["source"] == "measured"
+    assert dec[0]["build_bytes"] <= dec[0]["threshold"]
+
+
+def test_zero_row_build_side():
+    stream, build = _skew_tables()
+    conf = {"spark.rapids.tpu.stats.enabled": True,
+            "spark.rapids.tpu.adaptive.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": 4096,
+            "spark.rapids.tpu.batchRows": 8192}
+
+    def q(s):
+        b = s.createDataFrame(build).filter(col("k") < 0)  # empty
+        return s.createDataFrame(stream).join(b, on="k", how="inner")
+
+    df_on = q(tpu_session(conf))
+    t_on = df_on.toArrow()
+    assert t_on.num_rows == 0
+    t_cpu = q(cpu_session()).toArrow()
+    _assert_identical(t_on, t_cpu, "zero-row build vs cpu")
+    node = _find(df_on._last_plan, "TpuAdaptiveLocalJoinExec")
+    assert node is not None and node._mode == "broadcast"
+
+
+def test_history_warm_path_and_forced_flip(tmp_path):
+    stream, build = _skew_tables()
+    store = str(tmp_path / "profiles.jsonl")
+    conf = {"spark.rapids.tpu.stats.enabled": True,
+            "spark.rapids.tpu.stats.storePath": store,
+            "spark.rapids.tpu.adaptive.enabled": True,
+            "spark.sql.autoBroadcastJoinThreshold": 4096,
+            "spark.rapids.tpu.batchRows": 8192}
+
+    def q(s):
+        b = s.createDataFrame(build).filter(col("k") < 100)
+        return s.createDataFrame(stream).join(b, on="k", how="inner")
+
+    # cold: measured broadcast, decision recorded into the store
+    df1 = q(tpu_session(conf))
+    t1 = df1.toArrow()
+    d1 = [d for d in df1.session.last_query_profile()
+          ["adaptive_decisions"] if d["kind"] == "broadcast"]
+    assert d1 and d1[0]["source"] == "measured"
+    sig = d1[0]["build_sig"]
+
+    # warm: same query shape in a new session decides from history —
+    # no build-side measurement this time
+    df2 = q(tpu_session(conf))
+    t2 = df2.toArrow()
+    d2 = [d for d in df2.session.last_query_profile()
+          ["adaptive_decisions"] if d["kind"] == "broadcast"]
+    assert d2 and d2[0]["source"] == "history"
+    assert d2[0]["build_sig"] == sig
+    _assert_identical(t1, t2, "cold vs warm")
+
+    # forced flip: poison the history with a huge recorded build side —
+    # the same query now plans shuffled, answers must not move
+    stats.append_profile(store, {"adaptive_decisions": [
+        {"kind": "shuffled", "build_sig": sig,
+         "build_bytes": 1 << 30}]})
+    df3 = q(tpu_session(conf))
+    t3 = df3.toArrow()
+    d3 = [d for d in df3.session.last_query_profile()
+          ["adaptive_decisions"] if d["kind"] in ("broadcast",
+                                                  "shuffled")]
+    assert d3 and d3[0]["kind"] == "shuffled"
+    assert d3[0]["source"] == "history"
+    _assert_identical(t1, t3, "broadcast vs forced-shuffled")
+
+
+def test_batch_retarget_bit_identity():
+    # fat string rows: the static 40-byte/string planning guess is far
+    # off the observed width, so the AQE read retargets its coalesce
+    n = 6000
+    t = gen_table(
+        [SkewedLongGen(hot_mass=0.3, distinct=64, nullable=False),
+         StringGen(min_len=120, max_len=120, null_ratio=0.0)],
+        n, seed=3, names=["k", "s"])
+    base = {"spark.sql.adaptive.enabled": True,
+            "spark.sql.adaptive.advisoryPartitionSizeInBytes": 64 << 10,
+            "spark.rapids.tpu.stats.enabled": True,
+            # retarget consumes ROW counts: needs the device-resident
+            # exchange (the host path records partition BYTES)
+            "spark.rapids.shuffle.mode": "CACHE_ONLY",
+            "spark.rapids.tpu.batchRows": 8192}
+    on = dict(base)
+    on["spark.rapids.tpu.adaptive.enabled"] = True
+
+    def q(s):
+        return s.createDataFrame(t).repartition(16, "k")
+
+    df_on = q(tpu_session(on))
+    t_on = df_on.toArrow()
+    t_off = q(tpu_session(base)).toArrow()
+    t_cpu = q(cpu_session()).toArrow()
+    _assert_identical(t_on, t_off, "retarget on vs off")
+    _assert_identical(t_on, t_cpu, "retarget on vs cpu")
+    aqe = _find(df_on._last_plan, "TpuAQEShuffleReadExec")
+    assert aqe is not None
+    assert aqe.metrics["retargetedReads"].value == 1
+    dec = [d for d in df_on.session.last_query_profile()
+           ["adaptive_decisions"] if d["kind"] == "batch-retarget"]
+    assert dec, "no batch-retarget decision recorded"
+    assert dec[0]["observed_row_bytes"] > dec[0]["static_row_bytes"]
+
+
+def test_explain_analyze_shows_decisions(capsys):
+    stream, build = _skew_tables()
+    on = dict(_SKEW_CONF)
+    on["spark.rapids.tpu.adaptive.enabled"] = True
+    df = _join(tpu_session(on), stream, build)
+    df.toArrow()
+    df.explain("analyze")
+    out = capsys.readouterr().out
+    assert "adaptive=" in out
+    assert "skew-split(" in out
+
+
+def test_adaptive_decisions_counter_ticks():
+    from spark_rapids_tpu.runtime import telemetry as TM
+    stream, build = _skew_tables()
+    on = dict(_SKEW_CONF)
+    on["spark.rapids.tpu.adaptive.enabled"] = True
+    key = 'tpuq_adaptive_decisions_total{kind="skew-split"}'
+    before = TM.REGISTRY.snapshot().get(key, 0)
+    _join(tpu_session(on), stream, build).toArrow()
+    assert TM.REGISTRY.snapshot().get(key, 0) > before
+
+
+# -- profiler CLI ------------------------------------------------------------
+
+def _store_record(qid, decisions):
+    return {"record": "profile", "query_id": qid, "wall_s": 0.5,
+            "ops": [{"op": "TpuAdaptiveLocalJoinExec", "sig": "s1",
+                     "path": "0.0", "self_s": 0.1, "total_s": 0.2,
+                     "rows": 10, "bytes": 100}],
+            "exchanges": [], "adaptive_decisions": decisions}
+
+
+def test_profile_top_adaptive_lists_decisions(tmp_path, capsys):
+    from spark_rapids_tpu.utils import profile as P
+    store = tmp_path / "a.jsonl"
+    store.write_text(json.dumps(_store_record(1, [
+        {"kind": "broadcast", "op": "TpuAdaptiveLocalJoinExec",
+         "sig": "s1", "build_sig": "bs1", "build_bytes": 64,
+         "threshold": 1 << 20, "source": "measured"},
+        {"kind": "skew-split", "op": "TpuSortMergeJoinExec",
+         "sig": "s2", "partitions": [3], "splits": [5],
+         "rows": [5000], "skew_factor": 4.2, "threshold": 2.0},
+    ])) + "\n")
+    rc = P.main(["top", str(store), "--adaptive"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "adaptive decisions" in out
+    assert "broadcast (build_bytes=64" in out
+    assert "skew-split (partitions=[3]" in out
+    # without --adaptive the report stays quiet about decisions
+    P.main(["top", str(store)])
+    assert "adaptive decisions" not in capsys.readouterr().out
+
+
+def test_profile_diff_flags_decision_flips(tmp_path, capsys):
+    from spark_rapids_tpu.utils import profile as P
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.write_text(json.dumps(_store_record(1, [
+        {"kind": "broadcast", "op": "TpuAdaptiveLocalJoinExec",
+         "sig": "s1", "build_sig": "bs1", "build_bytes": 64,
+         "threshold": 1 << 20, "source": "measured"}])) + "\n")
+    b.write_text(json.dumps(_store_record(2, [
+        {"kind": "shuffled", "op": "TpuAdaptiveLocalJoinExec",
+         "sig": "s1", "build_sig": "bs1", "build_bytes": 1 << 30,
+         "threshold": 1 << 20, "source": "measured"}])) + "\n")
+    rc = P.main(["diff", str(a), str(b)])
+    out = capsys.readouterr().out
+    assert "DECISION FLIP bs1: broadcast -> shuffled" in out
+    assert rc == 0  # informational, not a regression
+    # no flip when both sides agree
+    rc = P.main(["diff", str(a), str(a)])
+    assert "DECISION FLIP" not in capsys.readouterr().out
+    assert rc == 0

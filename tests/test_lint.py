@@ -951,3 +951,60 @@ def test_lockdep_conf_gate():
     finally:
         if not was:
             lockdep.disable()
+
+
+# ---------------------------------------------------------------------------
+# adaptive-purity
+# ---------------------------------------------------------------------------
+
+def test_adaptive_purity_flags_host_pulls_in_plane():
+    from spark_rapids_tpu.utils.lint.adaptive_purity import (
+        AdaptivePurityRule)
+    m = _mod("spark_rapids_tpu/adaptive/cost_model.py", """
+        import jax
+        import numpy as np
+
+        def choose_join_strategy(build, threshold):
+            live = np.asarray(build.sel).sum()
+            jax.device_get(build.columns)
+            build.columns[0].data.block_until_ready()
+            return "broadcast" if live <= threshold else "shuffled"
+        """)
+    out = _run([AdaptivePurityRule()], m)
+    assert [f.rule for f in out] == ["adaptive-purity"] * 3
+    assert "choose_join_strategy" in out[0].message
+    assert "recorded stats or conf" in out[0].message
+
+
+def test_adaptive_purity_scope_and_clean_plane():
+    from spark_rapids_tpu.utils.lint.adaptive_purity import (
+        AdaptivePurityRule)
+    # pure arithmetic over recorded counts: exactly what the plane is for
+    clean = _mod("spark_rapids_tpu/adaptive/replanner.py", """
+        import math
+
+        def plan_skew_reads(pol, counts):
+            mean = sum(counts) / max(len(counts), 1)
+            return [c for c in counts if c > pol.skew_threshold * mean]
+        """)
+    # host pulls OUTSIDE the plane are the exec-layer rules' business
+    elsewhere = _mod("spark_rapids_tpu/exec/join.py", """
+        import numpy as np
+
+        def measure_build(batches):
+            return int(np.asarray(batches[0].sel).sum())
+        """)
+    assert _run([AdaptivePurityRule()], clean, elsewhere) == []
+
+
+def test_adaptive_purity_exemption():
+    from spark_rapids_tpu.utils.lint.adaptive_purity import (
+        AdaptivePurityRule)
+    m = _mod("spark_rapids_tpu/adaptive/cost_model.py", """
+        import numpy as np
+
+        def debug_dump(counts):
+            # lint: exempt(adaptive-purity): offline debug helper
+            return np.asarray(counts)
+        """)
+    assert _run([AdaptivePurityRule()], m) == []
