@@ -1322,6 +1322,67 @@ SCHED_SHED_SEM_SATURATION = (
 )
 
 
+# ---------------------------------------------------------------------------
+# Result-cache plane (spark_rapids_tpu/cache/, docs/result_cache.md)
+# ---------------------------------------------------------------------------
+
+CACHE_ENABLED = (
+    conf("spark.rapids.tpu.cache.enabled")
+    .doc("Serve repeated queries from the host-resident result cache. "
+         "A hit is keyed by sha1(physical-plan fingerprint + "
+         "result-affecting confs + input fingerprints) and bypasses "
+         "the scheduler and device semaphore entirely; the query log "
+         "still records the query with entry['cache'].status='hit'.")
+    .category("cache")
+    .boolean()
+    .create_with_default(False)
+)
+
+CACHE_MAX_BYTES = (
+    conf("spark.rapids.tpu.cache.maxBytes")
+    .doc("Byte budget for resident cached results (Arrow bytes). "
+         "Least-recently-used entries are evicted to stay under it; a "
+         "single result larger than the budget is never cached.")
+    .category("cache")
+    .bytes()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(256 * 1024 * 1024)
+)
+
+CACHE_TTL_MS = (
+    conf("spark.rapids.tpu.cache.ttlMs")
+    .doc("Time-to-live for cached results in milliseconds; an entry "
+         "older than this counts as an eviction at lookup. 0 disables "
+         "TTL (entries live until evicted or invalidated).")
+    .category("cache")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(600_000)
+)
+
+CACHE_MIN_RUNTIME_MS = (
+    conf("spark.rapids.tpu.cache.minRuntimeMs")
+    .doc("Only cache results whose cold execution took at least this "
+         "many milliseconds — sub-millisecond queries churn the byte "
+         "budget for no device savings.")
+    .category("cache")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(0)
+)
+
+CACHE_SUBPLAN_ENABLED = (
+    conf("spark.rapids.tpu.cache.subplan.enabled")
+    .doc("Also cache materialized shuffle-exchange outputs under "
+         "subtree signatures, so partially-overlapping queries reuse "
+         "shared stages even when their full result keys differ. "
+         "Entries share the cache.maxBytes budget.")
+    .category("cache")
+    .boolean()
+    .create_with_default(False)
+)
+
+
 class RapidsConf:
     """Immutable-ish view over a raw key->value dict, validated at init.
 
@@ -1360,6 +1421,13 @@ class RapidsConf:
 
     def get_raw(self, key: str, default=None):
         return self._values.get(key, default)
+
+    def raw_prefix(self, prefix: str) -> Dict[str, Any]:
+        """All dynamically-registered raw keys under a prefix (e.g. the
+        per-tenant scheduler overrides) — result-key derivation folds
+        these in so tenant conf differences key separately."""
+        return {k: v for k, v in self._values.items()
+                if k.startswith(prefix)}
 
     def is_op_enabled(self, kind: str, name: str, default: bool = True) -> bool:
         """Per-op kill switch, e.g. spark.rapids.sql.expression.Substring."""

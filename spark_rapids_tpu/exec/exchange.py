@@ -38,6 +38,52 @@ from spark_rapids_tpu.ops.expressions import Expression
 from spark_rapids_tpu.runtime import stats
 
 
+def _subplan_probe(exec_node):
+    """(store, subtree ResultKey) when the result-cache plane's subplan
+    mode applies to this exchange, else (None, None).  Keyed by the
+    detailed subtree fingerprint ⊕ the configured session's conf
+    fingerprint ⊕ the physical leaves' input fingerprints, so
+    partially-overlapping queries reuse a shared stage."""
+    from spark_rapids_tpu import cache as cache_mod
+    store = cache_mod.subplan_store()
+    if store is None:
+        return None, None
+    try:
+        return store, cache_mod.subplan_key(exec_node,
+                                            store.subplan_conf_fp)
+    except Exception:
+        return None, None
+
+
+def _dehydrate_pairs(pairs):
+    """(DeviceBatch, pid) pairs -> host-resident payload.  Rows are
+    compacted on pull, so the stored pid array is the sel-compacted
+    prefix — alignment with the rehydrated batch's live rows."""
+    from spark_rapids_tpu.columnar.column import device_to_host
+    payload = []
+    nbytes = 0
+    for b, pid in pairs:
+        tbl = device_to_host(b)
+        pids = np.asarray(pid)[np.asarray(b.sel)].astype(np.int32)
+        payload.append((tbl, pids))
+        nbytes += tbl.nbytes + pids.nbytes
+    return payload, nbytes
+
+
+def _rehydrate_pairs(payload):
+    """Host payload -> (DeviceBatch, pid) pairs shaped exactly like a
+    fresh materialization: batch capacity is the padded power-of-two,
+    pid padded with -1 (dead rows never match a partition)."""
+    from spark_rapids_tpu.columnar.column import host_to_device
+    pairs = []
+    for tbl, pids in payload:
+        batch = host_to_device(tbl)
+        pid = np.full(batch.capacity, -1, np.int32)
+        pid[:len(pids)] = pids
+        pairs.append((batch, jnp.asarray(pid)))
+    return pairs
+
+
 class CpuShuffleExchangeExec(CpuExec):
     def __init__(self, child: CpuExec, num_partitions: int,
                  keys: Optional[Sequence[Expression]] = None):
@@ -61,6 +107,16 @@ class CpuShuffleExchangeExec(CpuExec):
     def _materialize_locked(self):
         if self._materialized is not None:
             return self._materialized
+        store, skey = _subplan_probe(self)
+        if store is not None and skey is not None:
+            ent = store.lookup(skey.key)
+            if ent is not None:
+                self._materialized = [
+                    [H.from_arrow_table(t) for t in part]
+                    for part in ent.value]
+                return self._materialized
+        import time as _time
+        t0 = _time.perf_counter()
         child = self.children[0]
         out: List[List[H.HostBatch]] = [[] for _ in range(self.nparts)]
         row_counter = 0
@@ -101,6 +157,13 @@ class CpuShuffleExchangeExec(CpuExec):
             st.record_partitions(
                 self, [sum(b.num_rows for b in bl) for bl in out],
                 unit="rows")
+        if store is not None and skey is not None:
+            store.note_miss(sub=True)
+            payload = [[H.to_arrow_table(b) for b in part]
+                       for part in out]
+            nbytes = sum(t.nbytes for part in payload for t in part)
+            store.put(skey, payload, nbytes,
+                      _time.perf_counter() - t0, kind="subplan")
         return out
 
     def execute(self, partition: int) -> Iterator[H.HostBatch]:
@@ -167,6 +230,14 @@ class TpuShuffleExchangeExec(TpuExec):
     def _materialize_locked(self):
         if self._materialized is not None:
             return self._materialized
+        store, skey = _subplan_probe(self)
+        if store is not None and skey is not None:
+            ent = store.lookup(skey.key)
+            if ent is not None:
+                self._materialized = _rehydrate_pairs(ent.value)
+                return self._materialized
+        import time as _time
+        t0 = _time.perf_counter()
         child = self.children[0]
         pairs = []  # (batch, pid array)
         row_base = 0
@@ -179,6 +250,11 @@ class TpuShuffleExchangeExec(TpuExec):
                         # (a device sync); hash partitioning does not
                         row_base += int(jnp.sum(b.sel.astype(jnp.int32)))
         self._materialized = pairs
+        if store is not None and skey is not None:
+            store.note_miss(sub=True)
+            payload, nbytes = _dehydrate_pairs(pairs)
+            store.put(skey, payload, nbytes,
+                      _time.perf_counter() - t0, kind="subplan")
         return pairs
 
     def execute(self, partition: int) -> Iterator[DeviceBatch]:

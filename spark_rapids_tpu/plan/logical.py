@@ -44,6 +44,12 @@ class InMemoryRelation(LogicalPlan):
     table: pa.Table
     schema: T.StructType
     num_partitions: int = 1
+    # result-cache input identity: content fingerprint (assigned only
+    # inside cache/fingerprints.py / the session catalog — enforced by
+    # the cache-safety lint rule) and the catalog name this relation
+    # was registered under, if any.
+    fingerprint: Optional[str] = None
+    source: Optional[str] = None
 
     @property
     def name(self):

@@ -782,7 +782,8 @@ class DataFrame:
 
     def toArrow(self, timeout_ms: Optional[float] = None,
                 query_id: Optional[int] = None,
-                cancel_token=None) -> pa.Table:
+                cancel_token=None,
+                tenant: Optional[str] = None) -> pa.Table:
         """Execute and return the result as an Arrow table.
 
         ``timeout_ms`` puts an in-process deadline on THIS execution
@@ -796,8 +797,18 @@ class DataFrame:
         plumbing: the server mints the id and registers the token at
         *submit* time (so the query is cancellable while still queued
         for a run slot), then the admitted worker passes both here and
-        the execution adopts them instead of minting fresh ones."""
+        the execution adopts them instead of minting fresh ones.
+        ``tenant`` folds the tenant's conf overrides into the result
+        key so tenants never share a cache slot.
+
+        With ``spark.rapids.tpu.cache.enabled``, the result cache is
+        consulted first: a hit hands back the resident Arrow table —
+        no partition pump, no device semaphore — while still running
+        the full query-window machinery, so the event-log entry
+        carries ``cache.status="hit"`` with its usual telemetry/
+        semaphore/stats attribution."""
         import contextlib
+        import time as _time
         from spark_rapids_tpu import conf as C
         from spark_rapids_tpu.runtime import cancel as cancel_mod
         from spark_rapids_tpu.runtime import stats as stats_mod
@@ -806,6 +817,17 @@ class DataFrame:
         conf = self.session.rapids_conf()
         plan = self._execute_plan()
         self._last_plan = plan
+        cache_store = ckey = None
+        if conf.get(C.CACHE_ENABLED):
+            from spark_rapids_tpu import cache as cache_mod
+            cache_store = cache_mod.get_cache(conf)
+            try:
+                ckey = cache_mod.result_key(self._plan, plan, conf,
+                                            tenant=tenant)
+            except Exception:
+                # unkeyable inputs (e.g. a vanished scan file) —
+                # execute uncached
+                cache_store = None
         qid = query_id if query_id is not None else trace.next_query_id()
         qwin = telemetry.begin_query(qid)
         from spark_rapids_tpu.runtime import resilience
@@ -837,15 +859,55 @@ class DataFrame:
                 if tracer is not None else contextlib.nullcontext())
         error = None
         cancelled = None
+        cache_info = None
+        flight = None
         try:
             with profile, root:
-                tables = self._pump_partitions(plan, conf)
-                if not tables:
-                    out = self._reassemble_structs(pa.table(
-                        {f.name: pa.array([], type=T.to_arrow(f.dtype))
-                         for f in self.schema.fields}))
+                served = None
+                if cache_store is not None:
+                    served = cache_store.lookup(ckey.key)
+                    if served is None:
+                        role, fl = cache_store.join_flight(ckey.key)
+                        if role == "leader":
+                            flight = fl
+                        else:
+                            # another execution of this exact key is in
+                            # progress — wait for it, then re-probe;
+                            # compute ourselves if it failed or skipped
+                            while not fl.done.wait(0.05):
+                                cancel_mod.check()
+                            served = cache_store.lookup(ckey.key)
+                            if served is not None:
+                                cache_info = {"coalesced": True}
+                if served is not None:
+                    out = served.value
+                    cache_info = {
+                        "status": "hit", "key": served.key,
+                        "signature": served.sig,
+                        "bytes": served.nbytes,
+                        "saved_s": round(served.runtime_s, 6),
+                        "age_s": round(
+                            _time.monotonic() - served.created, 6),
+                        **(cache_info or {})}
                 else:
-                    out = self._reassemble_structs(pa.concat_tables(tables))
+                    t_exec = _time.perf_counter()
+                    tables = self._pump_partitions(plan, conf)
+                    if not tables:
+                        out = self._reassemble_structs(pa.table(
+                            {f.name: pa.array([], type=T.to_arrow(f.dtype))
+                             for f in self.schema.fields}))
+                    else:
+                        out = self._reassemble_structs(
+                            pa.concat_tables(tables))
+                    if cache_store is not None:
+                        runtime_s = _time.perf_counter() - t_exec
+                        cache_store.note_miss()
+                        stored = cache_store.put(
+                            ckey, out, out.nbytes, runtime_s)
+                        cache_info = {
+                            "key": ckey.key, "signature": ckey.sig,
+                            "bytes": out.nbytes,
+                            "runtime_s": round(runtime_s, 6), **stored}
         except cancel_mod.QueryCancelled as e:
             cancelled = e
             error = f"{type(e).__name__}: {e}"
@@ -862,17 +924,22 @@ class DataFrame:
             error = f"{type(e).__name__}: {e}"
             raise
         finally:
+            if flight is not None:
+                # wake single-flight followers even on failure — they
+                # re-probe and compute for themselves
+                cache_store.finish_flight(ckey.key, flight)
             trace.end_query(tracer)
             stats_mod.end_query(collector)
             cancel_mod.finish_query(cwin)
             self._record_query(qid, tracer, conf, profile_dir, error,
                                qwin, rwin, cancelled=cancelled,
-                               ctoken=cwin, collector=collector)
+                               ctoken=cwin, collector=collector,
+                               cache_info=cache_info)
         return out
 
     def _record_query(self, qid, tracer, conf, profile_dir, error,
                       qwin=None, rwin=None, cancelled=None, ctoken=None,
-                      collector=None):
+                      collector=None, cache_info=None):
         """One event-log entry per execution: plan tree, device/fallback
         report, all metrics at their levels, span rollup, artifact
         cross-links — the reference's driver-log plan-conversion report,
@@ -892,6 +959,10 @@ class DataFrame:
         }
         if error:
             entry["error"] = error
+        if cache_info is not None:
+            # result-cache outcome: status hit|stored|uncached, with
+            # key/signature/bytes and saved_s (hit) or runtime_s (miss)
+            entry["cache"] = cache_info
         if cancelled is not None:
             cinfo = {"reason": cancelled.reason}
             if ctoken is not None:

@@ -124,6 +124,18 @@ class QueryServer:
             qid, timeout_ms=eff,
             poll_ms=float(conf.get(C.CANCEL_POLL_MS)))
         cancel.register(token)
+        # result-cache admission check: a DataFrame submission whose
+        # result key is already resident is served on THIS thread —
+        # it never enters the scheduler, holds no run slot, and
+        # touches no device state.  (Callable submissions build their
+        # plan on the worker, so their cache probe happens inside
+        # toArrow instead — a hit still releases the run slot in
+        # microseconds.)
+        if not callable(query):
+            hit = self._try_serve_cached(query, qid, token, tenant,
+                                         priority, conf)
+            if hit is not None:
+                return hit
         sched = get_scheduler(conf)
         try:
             ticket = sched.submit(qid, tenant=tenant, priority=priority,
@@ -143,6 +155,49 @@ class QueryServer:
         worker.start()
         return handle
 
+    def _try_serve_cached(self, df, qid: int, token, tenant: str,
+                          priority: int, conf) -> Optional[QueryHandle]:
+        """Serve a submission from the result cache without admission.
+
+        Probes non-destructively (``peek``); on a resident key, runs
+        ``toArrow`` synchronously — the probe guarantees it resolves as
+        a hit short of a racing eviction, in which case the query
+        computes here without a run slot but still under the device
+        semaphore.  Returns None on miss (normal admission proceeds).
+        """
+        from spark_rapids_tpu import cache as cache_mod
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import cancel
+        if not conf.get(C.CACHE_ENABLED):
+            return None
+        store = cache_mod.get_cache(conf)
+        try:
+            plan = df._execute_plan()
+            ckey = cache_mod.result_key(df._plan, plan, conf,
+                                        tenant=tenant)
+        except Exception:
+            return None
+        if store.peek(ckey.key) is None:
+            return None
+        handle = QueryHandle(qid, tenant, priority, token, ticket=None)
+        try:
+            handle.state = RUNNING
+            handle.result = df.toArrow(query_id=qid, cancel_token=token,
+                                       tenant=tenant)
+            handle.state = OK
+        except cancel.QueryCancelled as e:
+            handle.error = e
+            handle.state = CANCELLED
+        except BaseException as e:
+            handle.error = e
+            handle.state = ERROR
+        finally:
+            handle.queue_wait_s = 0.0
+            handle.wall_s = time.monotonic() - handle.submitted_at
+            cancel.unregister(token)
+            handle.done.set()
+        return handle
+
     def _run(self, handle: QueryHandle, query) -> None:
         from spark_rapids_tpu.runtime import cancel
         sched = peek_scheduler()
@@ -152,7 +207,8 @@ class QueryServer:
             handle.state = RUNNING
             df = query() if callable(query) else query
             handle.result = df.toArrow(query_id=handle.query_id,
-                                       cancel_token=handle.token)
+                                       cancel_token=handle.token,
+                                       tenant=handle.tenant)
             handle.state = OK
         except cancel.QueryCancelled as e:
             handle.error = e

@@ -171,6 +171,14 @@ class TpuSession:
         # (spark.rapids.tpu.kernel.cacheDir; no-op on the CPU backend)
         from spark_rapids_tpu.runtime import kernel_cache
         kernel_cache.configure_persistent_cache(self.conf.snapshot())
+        # result-cache plane (spark.rapids.tpu.cache.*): host-resident
+        # plan-signature result cache served ahead of the scheduler
+        from spark_rapids_tpu import cache as cache_mod
+        cache_mod.configure(self.conf.snapshot())
+        # name -> (table, fingerprint): registered-table catalog backing
+        # registerTable()/table(); mutated only through registerTable —
+        # the cache-safety lint rule flags writes anywhere else
+        self._catalog: Dict[str, Any] = {}
 
     # -- observability ------------------------------------------------------
     def _record_query(self, entry: Dict[str, Any]) -> None:
@@ -287,6 +295,74 @@ class TpuSession:
         nparts = int(self.conf.get("spark.default.parallelism", 1))
         return DataFrame(self, InMemoryRelation(table, st, nparts),
                          structs)
+
+    # -- catalog + result cache ---------------------------------------------
+    def registerTable(self, name: str, data, schema=None) -> "DataFrame":
+        """Register (or re-register) a named table in the session
+        catalog.  This is the fingerprint-bump chokepoint for in-memory
+        inputs: re-registering a name re-mints the content digest and
+        drops every cached result that read the old version, so a
+        refreshed table can never serve stale hits."""
+        from spark_rapids_tpu import cache as cache_mod
+        from spark_rapids_tpu.cache import fingerprints
+
+        table = self._to_arrow(data, schema)
+        table, structs = _decompose_structs(table)
+        rebind = name in self._catalog
+        fp = (fingerprints.bump_table_fingerprint(table) if rebind
+              else fingerprints.table_fingerprint(table))
+        self._catalog[name] = (table, structs, fp)
+        if rebind:
+            store = cache_mod.peek_cache()
+            if store is not None:
+                store.invalidate(source=name)
+        return self.table(name)
+
+    def table(self, name: str) -> "DataFrame":
+        """A DataFrame over a catalog table registered with
+        ``registerTable`` — its relation carries the content
+        fingerprint, so results derived from it are cache-keyed."""
+        from spark_rapids_tpu.plan.logical import InMemoryRelation
+        from spark_rapids_tpu.sql.dataframe import DataFrame
+        if name not in self._catalog:
+            raise KeyError(f"table {name!r} is not registered")
+        table, structs, fp = self._catalog[name]
+        st = T.StructType(tuple(
+            T.StructField(n, T.from_arrow(table.schema.field(n).type))
+            for n in table.column_names))
+        nparts = int(self.conf.get("spark.default.parallelism", 1))
+        rel = InMemoryRelation(table, st, nparts,
+                               fingerprint=fp, source=name)
+        return DataFrame(self, rel, structs)
+
+    def invalidate_cache(self, name: Optional[str] = None, *,
+                         signature: Optional[str] = None,
+                         fingerprint: Optional[str] = None) -> int:
+        """Explicitly drop cached results: by catalog ``name``, plan
+        ``signature``, input ``fingerprint``, or — with no arguments —
+        everything.  Returns the number of entries dropped."""
+        from spark_rapids_tpu import cache as cache_mod
+        store = cache_mod.peek_cache()
+        if store is None:
+            return 0
+        if name is None and signature is None and fingerprint is None:
+            return store.invalidate(everything=True)
+        return store.invalidate(source=name, signature=signature,
+                                fingerprint=fingerprint)
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """Result-cache observability: counts, hit rate, resident
+        bytes, device-seconds avoided, and a per-signature breakdown
+        (the same numbers ``profile top --cache`` reports)."""
+        from spark_rapids_tpu import cache as cache_mod
+        from spark_rapids_tpu import conf as C
+        store = cache_mod.peek_cache()
+        # the store is a process singleton — THIS session's conf decides
+        # whether its queries participate, so a cache-off session must
+        # not report a co-resident session's store as its own
+        if store is None or not self.rapids_conf().get(C.CACHE_ENABLED):
+            return {"enabled": False}
+        return {"enabled": True, **store.stats()}
 
     def _to_arrow(self, data, schema) -> pa.Table:
         if isinstance(data, pa.Table):

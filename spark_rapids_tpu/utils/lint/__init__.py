@@ -64,6 +64,13 @@ Rules (catalog in docs/static_analysis.md):
                       stats, history, or conf, never a fresh device
                       sync in the planning path; measurement lives in
                       the exec layer, which hands the numbers in
+``cache-safety``      mutation of a session ``_catalog`` entry or a
+                      relation ``fingerprint`` outside the
+                      fingerprint-bump chokepoint
+                      (cache/fingerprints.py, sql/session.py) —
+                      changing a registered input without re-minting
+                      its digest is exactly the bug that serves stale
+                      cached results
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -220,6 +227,7 @@ def all_rules() -> List[Rule]:
     from spark_rapids_tpu.utils.lint.adaptive_purity import (
         AdaptivePurityRule)
     from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
+    from spark_rapids_tpu.utils.lint.cache_safety import CacheSafetyRule
     from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
     from spark_rapids_tpu.utils.lint.exchange_purity import (
         ExchangePurityRule)
@@ -235,7 +243,7 @@ def all_rules() -> List[Rule]:
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
             SchedulerBypassRule(), RawJitRule(), ExchangePurityRule(),
-            KernelPurityRule(), AdaptivePurityRule()]
+            KernelPurityRule(), AdaptivePurityRule(), CacheSafetyRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

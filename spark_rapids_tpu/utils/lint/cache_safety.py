@@ -1,0 +1,92 @@
+"""cache-safety rule: registered inputs change only at the bump
+chokepoint.
+
+The result cache (spark_rapids_tpu/cache/) is sound exactly as long as
+every mutation of a registered input flows through the fingerprint-bump
+chokepoint: ``TpuSession.registerTable`` re-mints the content digest
+and invalidates dependent entries.  Code that rebinds a ``_catalog``
+entry or re-assigns a relation's ``fingerprint`` anywhere else changes
+what a query reads WITHOUT changing its result key — the exact bug
+class that serves stale results.  This rule fails any module outside
+the sanctioned set that
+
+- assigns, augments, or deletes a subscript of a ``_catalog`` mapping
+  (``x._catalog[name] = ...`` / ``del ...``),
+- calls a mutating mapping method on a ``_catalog`` attribute
+  (``pop``/``update``/``clear``/``setdefault``/``popitem``/
+  ``__setitem__``), or
+- assigns a ``.fingerprint`` attribute (relation fingerprints are
+  minted only by cache/fingerprints.py).
+
+Reading the catalog (``self._catalog[name]``, ``in`` checks) stays
+legal everywhere.  A deliberate mutation carries::
+
+    # lint: exempt(cache-safety): <why>
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+# the fingerprint chokepoint + the catalog's owning session
+ALLOWED = (
+    "spark_rapids_tpu/cache/fingerprints.py",
+    "spark_rapids_tpu/sql/session.py",
+)
+
+_MUTATORS = {"pop", "update", "clear", "setdefault", "popitem",
+             "__setitem__"}
+
+
+def _is_catalog(node: ast.AST) -> bool:
+    """True for a ``_catalog`` name or ``<x>._catalog`` attribute."""
+    return (isinstance(node, ast.Name) and node.id == "_catalog") or (
+        isinstance(node, ast.Attribute) and node.attr == "_catalog")
+
+
+class CacheSafetyRule(Rule):
+    name = "cache-safety"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        rel = mod.rel.replace("\\", "/")
+        if rel in ALLOWED:
+            return
+        for node in ast.walk(mod.tree):
+            targets = ()
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = (node.target,)
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                if isinstance(t, ast.Subscript) and _is_catalog(t.value):
+                    yield Finding(
+                        self.name, mod.rel, node.lineno,
+                        "catalog entry mutated outside the "
+                        "fingerprint-bump chokepoint — rebind tables "
+                        "via session.registerTable so the content "
+                        "digest is re-minted and stale cached results "
+                        "are invalidated")
+                elif (isinstance(t, ast.Attribute)
+                        and t.attr == "fingerprint"):
+                    yield Finding(
+                        self.name, mod.rel, node.lineno,
+                        "relation fingerprint assigned outside "
+                        "cache/fingerprints.py — fingerprints are "
+                        "minted only at the chokepoint; assigning one "
+                        "elsewhere can alias a stale cached result")
+            if isinstance(node, ast.Call):
+                f = node.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _MUTATORS
+                        and _is_catalog(f.value)):
+                    yield Finding(
+                        self.name, mod.rel, node.lineno,
+                        f"_catalog.{f.attr}() outside the "
+                        "fingerprint-bump chokepoint — catalog "
+                        "mutation must flow through "
+                        "session.registerTable")

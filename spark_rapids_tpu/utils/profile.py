@@ -20,7 +20,7 @@ per file:
 Usage::
 
     python -m spark_rapids_tpu.utils.profile top    <input> [--n N]
-        [--adaptive]
+        [--adaptive] [--cache]
     python -m spark_rapids_tpu.utils.profile skew   <input>
     python -m spark_rapids_tpu.utils.profile storms <input>
     python -m spark_rapids_tpu.utils.profile diff   <a> <b>
@@ -28,7 +28,9 @@ Usage::
 
 ``top --adaptive`` additionally lists each query's adaptive-plane
 decisions (broadcast/shuffled/skew-split/batch-retarget) with the
-triggering stat.  ``diff`` compares per-op self-times of two runs
+triggering stat.  ``top --cache`` adds the result-cache report:
+per-signature hit rate, bytes saved, and device-seconds avoided from
+the event log's ``cache`` records.  ``diff`` compares per-op self-times of two runs
 (keys matched by plan signature when both sides have one) and exits
 nonzero when any op regressed by >= the threshold ratio — the bench
 gate's verdict; joins whose adaptive strategy flipped between the two
@@ -171,7 +173,8 @@ def load_runs(path: str) -> List[dict]:
                      "compiles": compiles,
                      "wall_s": r.get("wall_s"),
                      "health": r.get("health") or [],
-                     "decisions": r.get("adaptive_decisions") or []})
+                     "decisions": r.get("adaptive_decisions") or [],
+                     "cache": r.get("cache")})
     return runs
 
 
@@ -256,6 +259,52 @@ def report_adaptive(runs: List[dict]) -> List[str]:
     if not found:
         lines.append("  (no adaptive decisions in this input — run "
                      "with spark.rapids.tpu.adaptive.enabled)")
+    return lines
+
+
+def report_cache(runs: List[dict]) -> List[str]:
+    """Result-cache effectiveness per plan signature, from the event
+    log's ``entry["cache"]`` records: hit rate, bytes saved (hit bytes
+    served from host), and device-seconds avoided (the cold runtime
+    each hit skipped)."""
+    per_sig: Dict[str, dict] = {}
+    seen = False
+    for run in runs:
+        c = run.get("cache")
+        if not isinstance(c, dict) or "status" not in c:
+            continue
+        seen = True
+        slot = per_sig.setdefault(c.get("signature", "?"), {
+            "hits": 0, "misses": 0, "bytes_saved": 0,
+            "device_s_avoided": 0.0})
+        if c["status"] == "hit":
+            slot["hits"] += 1
+            slot["bytes_saved"] += int(c.get("bytes") or 0)
+            slot["device_s_avoided"] += float(c.get("saved_s") or 0.0)
+        else:
+            slot["misses"] += 1
+    lines = [f"result cache over {len(runs)} run(s):"]
+    if not seen:
+        lines.append("  (no cache records in this input — run with "
+                     "spark.rapids.tpu.cache.enabled)")
+        return lines
+    total_h = sum(s["hits"] for s in per_sig.values())
+    total_m = sum(s["misses"] for s in per_sig.values())
+    lines.append(
+        f"  overall: {total_h} hit(s) / {total_m} miss(es) "
+        f"(rate {total_h / max(1, total_h + total_m):.2%}), "
+        f"{sum(s['bytes_saved'] for s in per_sig.values())} bytes "
+        f"saved, "
+        f"{sum(s['device_s_avoided'] for s in per_sig.values()):.3f} "
+        f"device-seconds avoided")
+    ranked = sorted(per_sig.items(),
+                    key=lambda kv: -kv[1]["device_s_avoided"])
+    for sig, s in ranked:
+        n = s["hits"] + s["misses"]
+        lines.append(f"  [{sig}]: {s['hits']}/{n} hits "
+                     f"(rate {s['hits'] / max(1, n):.2%}) "
+                     f"bytes_saved={s['bytes_saved']} "
+                     f"device_s_avoided={s['device_s_avoided']:.3f}")
     return lines
 
 
@@ -408,6 +457,10 @@ def main(argv: Optional[List[str]] = None) -> int:
             sp.add_argument("--adaptive", action="store_true",
                             help="also list per-query adaptive-plane "
                                  "decisions with the triggering stat")
+            sp.add_argument("--cache", action="store_true",
+                            help="also report per-signature result-"
+                                 "cache hit rate, bytes saved, and "
+                                 "device-seconds avoided")
     dp = sub.add_parser("diff", help="regression diff: b vs baseline a "
                                      "(nonzero exit on regression)")
     dp.add_argument("a", help="baseline input")
@@ -431,6 +484,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         print("\n".join(report_top(runs, args.n)))
         if args.adaptive:
             print("\n".join(report_adaptive(runs)))
+        if args.cache:
+            print("\n".join(report_cache(runs)))
         return EXIT_OK
     if args.cmd == "skew":
         print("\n".join(report_skew(load(args.input))))

@@ -1008,3 +1008,48 @@ def test_adaptive_purity_exemption():
             return np.asarray(counts)
         """)
     assert _run([AdaptivePurityRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
+# cache-safety
+# ---------------------------------------------------------------------------
+
+def test_cache_safety_flags_out_of_chokepoint_mutation():
+    from spark_rapids_tpu.utils.lint.cache_safety import CacheSafetyRule
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        def sneak_table_swap(session, name, table, relation):
+            session._catalog[name] = (table, [], None)
+            session._catalog.pop("other", None)
+            relation.fingerprint = "t0000000000000000"
+        """)
+    out = _run([CacheSafetyRule()], m)
+    assert [f.rule for f in out] == ["cache-safety"] * 3
+    assert "registerTable" in out[0].message
+    assert "fingerprints.py" in out[2].message
+
+
+def test_cache_safety_chokepoint_and_reads_clean():
+    from spark_rapids_tpu.utils.lint.cache_safety import CacheSafetyRule
+    # the SAME mutations inside the sanctioned chokepoint are legal
+    choke = _mod("spark_rapids_tpu/cache/fingerprints.py", """
+        def remint(relation, fp):
+            relation.fingerprint = fp
+        """)
+    # reading the catalog stays legal everywhere
+    reader = _mod("spark_rapids_tpu/exec/x.py", """
+        def resolve(session, name):
+            if name in session._catalog:
+                return session._catalog[name]
+            return None
+        """)
+    assert _run([CacheSafetyRule()], choke, reader) == []
+
+
+def test_cache_safety_exemption():
+    from spark_rapids_tpu.utils.lint.cache_safety import CacheSafetyRule
+    m = _mod("spark_rapids_tpu/exec/x.py", """
+        def drop_all(session):
+            # lint: exempt(cache-safety): teardown path, cache reset follows
+            session._catalog.clear()
+        """)
+    assert _run([CacheSafetyRule()], m) == []

@@ -1,0 +1,390 @@
+"""Result-cache plane: the full staleness matrix.
+
+Coverage map over spark_rapids_tpu/cache/ + the serving hooks:
+
+* hit correctness — a repeated query is served bit-identically to its
+  cold run WITHOUT acquiring the device semaphore (the acceptance
+  criterion, asserted via the semaphore's keyed query-stats window);
+* key derivation — result-affecting confs (kernel backend, exchange
+  mode, adaptive knobs) and per-tenant overrides key separately; the
+  same plan+conf+inputs key identically;
+* invalidation — re-registered table (content-digest bump), file
+  mtime bump, TTL expiry, LRU eviction under maxBytes, explicit
+  ``session.invalidate_cache``;
+* concurrency — single-flight: N concurrent executions of one key
+  compute once;
+* subplan mode — a shared exchange subtree computed by one query is
+  reused by a partially-overlapping one;
+* observability — ``entry["cache"]``, ``session.cache_stats()``, and
+  the ``tpuq_result_cache_*`` telemetry counters.
+"""
+
+import os
+import threading
+import time
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu import cache as cache_mod
+from spark_rapids_tpu.cache import keys as K
+from spark_rapids_tpu.conf import RapidsConf
+from spark_rapids_tpu.runtime import cancel as CN
+from spark_rapids_tpu.runtime import scheduler as SCH
+from spark_rapids_tpu.runtime import semaphore as SEM
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.sql.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def _clean_cache_state():
+    """The result cache, scheduler, semaphore, and cancel scope are
+    process singletons — every test starts and ends with none."""
+    cache_mod.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    SEM.reset_semaphore()
+    yield
+    cache_mod.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    SEM.reset_semaphore()
+
+
+def mk_session(**over):
+    raw = {"spark.rapids.tpu.cache.enabled": "true"}
+    raw.update({k: str(v) for k, v in over.items()})
+    return TpuSession(raw)
+
+
+def sample_table(scale=1, shift=0):
+    n = 64 * scale
+    return pa.table({
+        "k": [i % 8 for i in range(n)],
+        "v": [float(i + shift) for i in range(n)]})
+
+
+def a_query(s):
+    return s.table("t").filter(col("v") > 2.0).groupBy(
+        "k").agg(F.sum("v").alias("sv"))
+
+
+def serialized(t: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    return sink.getvalue().to_pybytes()
+
+
+# ---------------------------------------------------------------------------
+# hit path
+# ---------------------------------------------------------------------------
+
+def test_hit_bit_identical_without_device_semaphore():
+    s = mk_session()
+    s.registerTable("t", sample_table())
+    h0 = cache_mod.HITS.value
+    m0 = cache_mod.MISSES.value
+
+    cold = a_query(s).toArrow()
+    cold_entry = s.query_history()[-1]
+    assert cold_entry["cache"]["status"] == "stored"
+
+    warm = a_query(s).toArrow()
+    warm_entry = s.query_history()[-1]
+
+    # bit-identical to the cold run, down to the IPC serialization
+    assert serialized(warm) == serialized(cold)
+    # tagged cache=hit in the query log, with attribution
+    assert warm_entry["cache"]["status"] == "hit"
+    assert warm_entry["cache"]["key"] == cold_entry["cache"]["key"]
+    assert warm_entry["cache"]["signature"]
+    assert warm_entry["query_id"] != cold_entry["query_id"]
+    # the acceptance criterion: the hit's keyed semaphore window shows
+    # the device semaphore was NEVER acquired
+    assert warm_entry["semaphore"]["max_holders"] == 0
+    assert warm_entry["semaphore"]["wait_s"] == 0.0
+    # telemetry counters moved exactly once each
+    assert cache_mod.HITS.value == h0 + 1
+    assert cache_mod.MISSES.value == m0 + 1
+
+    stats = s.cache_stats()
+    assert stats["enabled"] and stats["hits"] == 1
+    assert stats["misses"] == 1 and stats["entries"] == 1
+    assert stats["device_seconds_avoided"] > 0
+
+
+def test_cache_disabled_is_inert():
+    s = TpuSession({})
+    s.registerTable("t", sample_table())
+    a_query(s).toArrow()
+    assert "cache" not in s.query_history()[-1]
+    assert s.cache_stats() == {"enabled": False}
+
+
+def test_min_runtime_floor_skips_store():
+    s = mk_session(**{"spark.rapids.tpu.cache.minRuntimeMs": 10 ** 7})
+    s.registerTable("t", sample_table())
+    a_query(s).toArrow()
+    e = s.query_history()[-1]["cache"]
+    assert e["status"] == "uncached"
+    assert e["reason"] == "below_min_runtime"
+    a_query(s).toArrow()
+    assert s.cache_stats()["hits"] == 0
+
+
+# ---------------------------------------------------------------------------
+# key derivation (the satellite bugfix: confs fold into the key)
+# ---------------------------------------------------------------------------
+
+def test_backends_do_not_share_a_cache_slot():
+    """Regression: the PR 7 signature is op+path+schema only — without
+    conf folding, kernel.backend=jnp and =fused would alias one slot."""
+    t = sample_table()
+    s_jnp = mk_session(**{"spark.rapids.tpu.kernel.backend": "jnp"})
+    s_jnp.registerTable("t", t)
+    r_jnp = a_query(s_jnp).toArrow()
+    key_jnp = s_jnp.query_history()[-1]["cache"]["key"]
+
+    s_fused = mk_session(**{"spark.rapids.tpu.kernel.backend": "fused"})
+    s_fused.registerTable("t", t)
+    r_fused = a_query(s_fused).toArrow()
+    e = s_fused.query_history()[-1]["cache"]
+    assert e["status"] == "stored", "second backend must NOT hit"
+    assert e["key"] != key_jnp
+    # both slots resident; answers agree (backend bit-identity)
+    store = cache_mod.peek_cache()
+    assert store.stats()["entries"] == 2
+    assert sorted(r_jnp.to_pydict()["k"]) == sorted(
+        r_fused.to_pydict()["k"])
+
+
+def test_result_conf_axes_key_separately():
+    base = RapidsConf({})
+    assert K.conf_fingerprint(base) == K.conf_fingerprint(RapidsConf({}))
+    for key, value in (
+            ("spark.rapids.tpu.kernel.backend", "fused"),
+            ("spark.rapids.shuffle.mode", "CACHE_ONLY"),
+            ("spark.rapids.tpu.exchange.mode", "host"),
+            ("spark.rapids.tpu.adaptive.enabled", "true"),
+            ("spark.rapids.tpu.kernel.bucketLadder", "32,64"),
+            ("spark.sql.adaptive.enabled", "false")):
+        changed = RapidsConf({key: value})
+        assert K.conf_fingerprint(changed) != K.conf_fingerprint(base), key
+
+
+def test_tenant_conf_overrides_key_separately():
+    conf = RapidsConf({
+        "spark.rapids.tpu.scheduler.tenant.gold.weight": "4"})
+    assert (K.conf_fingerprint(conf, tenant="gold")
+            != K.conf_fingerprint(conf, tenant="bronze"))
+    assert (K.conf_fingerprint(conf, tenant="gold")
+            != K.conf_fingerprint(conf))
+
+
+# ---------------------------------------------------------------------------
+# invalidation matrix
+# ---------------------------------------------------------------------------
+
+def test_reregistered_table_invalidates():
+    s = mk_session()
+    s.registerTable("t", sample_table(shift=0))
+    first = a_query(s).toArrow()
+    i0 = cache_mod.INVALIDATIONS.value
+
+    # refresh the data under the same name: the bump chokepoint
+    s.registerTable("t", sample_table(shift=100))
+    assert cache_mod.INVALIDATIONS.value > i0
+    fresh = a_query(s).toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "stored"
+    assert serialized(fresh) != serialized(first), "stale result served"
+    # and the fresh result is itself cacheable
+    again = a_query(s).toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "hit"
+    assert serialized(again) == serialized(fresh)
+
+
+def test_file_mtime_bump_invalidates(tmp_path):
+    path = str(tmp_path / "data.parquet")
+    pq.write_table(pa.table({"x": [1, 2, 3]}), path)
+    s = mk_session()
+
+    def q():
+        return s.read.parquet(path).filter(col("x") > 0)
+
+    first = q().toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "stored"
+    hit = q().toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "hit"
+    assert serialized(hit) == serialized(first)
+
+    # in-place rewrite: same path, new contents, bumped mtime
+    pq.write_table(pa.table({"x": [7, 8, 9]}), path)
+    os.utime(path, ns=(time.time_ns(), time.time_ns() + 1_000_000))
+    fresh = q().toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "stored"
+    assert fresh.to_pydict()["x"] == [7, 8, 9]
+
+
+def test_ttl_expiry_counts_eviction():
+    s = mk_session(**{"spark.rapids.tpu.cache.ttlMs": 50})
+    s.registerTable("t", sample_table())
+    a_query(s).toArrow()
+    time.sleep(0.12)
+    a_query(s).toArrow()
+    st = s.cache_stats()
+    assert st["hits"] == 0 and st["misses"] == 2
+    assert st["evictions"] >= 1
+
+
+def test_lru_eviction_under_max_bytes():
+    s = mk_session(**{"spark.rapids.tpu.cache.maxBytes": "2k"})
+    s.registerTable("t", sample_table())
+
+    def q(thresh):
+        return s.table("t").filter(col("v") > float(thresh))
+
+    sizes = []
+    for i in range(8):
+        out = q(i).toArrow()
+        sizes.append(out.nbytes)
+    store = cache_mod.peek_cache()
+    st = store.stats()
+    assert st["resident_bytes"] <= 2048
+    assert st["evictions"] >= 1, (st, sizes)
+    # the oldest key is gone; the newest is a hit
+    q(7).toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "hit"
+    q(0).toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "stored"
+
+
+def test_oversized_result_never_cached():
+    s = mk_session(**{"spark.rapids.tpu.cache.maxBytes": 64})
+    s.registerTable("t", sample_table(scale=4))
+    s.table("t").filter(col("v") >= 0.0).toArrow()
+    e = s.query_history()[-1]["cache"]
+    assert e["status"] == "uncached" and e["reason"] == "over_budget"
+    assert cache_mod.peek_cache().stats()["entries"] == 0
+
+
+def test_explicit_invalidate_cache():
+    s = mk_session()
+    s.registerTable("t", sample_table())
+    s.registerTable("u", sample_table(shift=5))
+    a_query(s).toArrow()
+    s.table("u").filter(col("v") > 6.0).toArrow()
+    assert cache_mod.peek_cache().stats()["entries"] == 2
+
+    assert s.invalidate_cache("t") == 1
+    a_query(s).toArrow()
+    assert s.query_history()[-1]["cache"]["status"] == "stored"
+
+    assert s.invalidate_cache() == 2  # everything
+    assert cache_mod.peek_cache().stats()["entries"] == 0
+    assert s.invalidate_cache("no-such-table") == 0
+
+
+# ---------------------------------------------------------------------------
+# serving front door: QueryServer + tenancy + single-flight
+# ---------------------------------------------------------------------------
+
+def test_server_hit_bypasses_scheduler_and_tenants_isolate():
+    from spark_rapids_tpu.sql.server import OK, QueryServer
+    s = mk_session(**{
+        "spark.rapids.tpu.scheduler.tenant.gold.weight": 4,
+        "spark.rapids.tpu.scheduler.tenant.free.weight": 1})
+    s.registerTable("t", sample_table())
+    server = QueryServer(s)
+    try:
+        cold = server.result(server.submit(a_query(s), tenant="gold"),
+                             timeout_s=60)
+        sched_stats_after_cold = server.stats()
+
+        warm_handle = server.submit(a_query(s), tenant="gold")
+        warm = server.result(warm_handle, timeout_s=60)
+        assert warm_handle.state == OK
+        assert serialized(warm) == serialized(cold)
+        assert warm_handle.ticket is None, "hit must bypass admission"
+        assert s.query_history()[-1]["cache"]["status"] == "hit"
+        # the scheduler never saw the hit submission
+        gold = server.stats().get("gold", {})
+        cold_gold = sched_stats_after_cold.get("gold", {})
+        assert gold.get("submitted") == cold_gold.get("submitted")
+
+        # a DIFFERENT tenant with different overrides keys separately
+        server.result(server.submit(a_query(s), tenant="free"),
+                      timeout_s=60)
+        assert s.query_history()[-1]["cache"]["status"] == "stored"
+    finally:
+        server.shutdown()
+    st = s.cache_stats()
+    assert st["hits"] == 1 and st["stored"] == 2
+
+
+def test_single_flight_computes_once():
+    s = mk_session()
+    s.registerTable("t", sample_table(scale=4))
+    n = 4
+    barrier = threading.Barrier(n)
+    results = [None] * n
+    errors = []
+
+    def run(i):
+        try:
+            barrier.wait(timeout=30)
+            results[i] = a_query(s).toArrow()
+        except BaseException as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors
+    base = serialized(results[0])
+    assert all(serialized(r) == base for r in results[1:])
+    st = s.cache_stats()
+    assert st["stored"] == 1, "same key must compute exactly once"
+    assert st["misses"] == 1 and st["hits"] == n - 1
+
+
+# ---------------------------------------------------------------------------
+# subplan (exchange-output) mode
+# ---------------------------------------------------------------------------
+
+def test_subplan_reuses_shared_exchange_stage():
+    # subplan caching hooks the in-process device-resident exchange
+    # (CACHE_ONLY transport); the host-file transport already
+    # materializes to reusable shuffle files of its own
+    s = mk_session(**{"spark.rapids.tpu.cache.subplan.enabled": "true",
+                      "spark.rapids.shuffle.mode": "CACHE_ONLY"})
+    s.registerTable("t", sample_table(scale=2))
+
+    def shared_stage():
+        return s.table("t").repartition(4, col("k"))
+
+    r1 = shared_stage().filter(col("v") > 10.0).toArrow()
+    st1 = s.cache_stats()
+    assert st1["sub_stored"] >= 1, "exchange output must be cached"
+
+    # a PARTIALLY-overlapping query: same exchange subtree, different
+    # downstream — full result key misses, the stage is reused
+    r2 = shared_stage().filter(col("v") > 50.0).toArrow()
+    st2 = s.cache_stats()
+    assert st2["sub_hits"] >= 1, "shared stage must be served"
+    assert s.query_history()[-1]["cache"]["status"] == "stored"
+
+    # correctness: bit-identical to an uncached evaluation
+    cache_mod.reset()
+    s_ref = TpuSession({"spark.rapids.shuffle.mode": "CACHE_ONLY"})
+    s_ref.registerTable("t", sample_table(scale=2))
+    ref1 = s_ref.table("t").repartition(4, col("k")).filter(
+        col("v") > 10.0).toArrow()
+    ref2 = s_ref.table("t").repartition(4, col("k")).filter(
+        col("v") > 50.0).toArrow()
+    assert serialized(r1) == serialized(ref1)
+    assert serialized(r2) == serialized(ref2)
