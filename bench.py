@@ -1107,6 +1107,119 @@ def adaptive_bench(mark) -> dict:
     return res
 
 
+def fusion_bench(mark) -> dict:
+    """FUSION_BENCH: whole-stage fusion on a q3-shaped
+    scan→filter→join→agg pipeline (docs/fusion.md), fused vs unfused on
+    the SAME plan at 16k and 128k rows.
+
+    The stream side carries a 12-op filter/project ladder below the
+    join — the chain shape q3's date/segment pushdowns produce — and
+    ``batchRows`` is held small (4096) so the 128k-row run pumps ~32
+    batches: per batch the unfused chain pays 12 pump boundaries and 12
+    kernel dispatches where the fused plan pays 1, which is exactly the
+    per-dispatch toll (tunnel latency + pad/bucket cycle + intermediate
+    materialization) the fusion plane exists to collapse.  The join and
+    aggregate are region boundaries in both runs, so the delta isolates
+    the chain.
+
+    ``warm_speedup`` is the headline (best-of-2 after first
+    materialization, compiles excluded): fusion trades a once-per-plan
+    region compile for a per-batch saving, so warm is the honest
+    steady-state price; ``cold_s`` records the compile side of that
+    trade.  ``dispatch_delta`` counts per-op output batches from the
+    stats plane — the mechanical confirmation that the regions actually
+    removed dispatch boundaries rather than winning on noise.  Outputs
+    are asserted row-equal so no speedup is quoted over a wrong
+    answer."""
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.column import col
+    from spark_rapids_tpu.sql.session import TpuSession
+
+    build_n = 256
+    build = pa.table({"k": np.arange(build_n, dtype=np.int64),
+                      "seg": np.arange(build_n, dtype=np.int64) % 5})
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.stats.enabled": True,
+            "spark.rapids.tpu.batchRows": 4096}
+
+    def stream_table(n):
+        rng = np.random.default_rng(17)
+        return pa.table({
+            "k": rng.integers(0, build_n, n).astype(np.int64),
+            "d": rng.integers(0, 2500, n).astype(np.int64),
+            "price": rng.random(n) * 1000.0,
+            "disc": rng.random(n) * 0.1})
+
+    def q(s, stream):
+        li = (s.createDataFrame(stream)
+              .filter(col("d") > 100)
+              .select(col("k"), col("d"),
+                      (col("price") * (1 - col("disc"))).alias("rev"))
+              .filter(col("d") < 2400)
+              .select(col("k"), (col("d") % 7).alias("dow"),
+                      col("rev"))
+              .filter(col("dow") != 3)
+              .select(col("k"), col("dow"), col("rev"),
+                      (col("rev") * 0.01).alias("tax"))
+              .filter(col("rev") > 5.0)
+              .select(col("k"), col("dow"),
+                      (col("rev") - col("tax")).alias("net"),
+                      col("rev"), col("tax"))
+              .filter(col("dow") != 6)
+              .select(col("k"), col("rev"), col("tax"),
+                      (col("net") * 1.0001).alias("net"))
+              .filter(col("net") > 6.0))
+        return (li.join(s.createDataFrame(build), on="k", how="inner")
+                .groupBy("seg")
+                .agg(F.sum(col("rev")).alias("revenue"),
+                     F.sum(col("tax")).alias("tax")))
+
+    def run(n, fused):
+        conf = dict(base)
+        conf["spark.rapids.tpu.fusion.enabled"] = fused
+        s = TpuSession(conf)
+        df = q(s, stream_table(n))
+        t0 = time.perf_counter()
+        df.toArrow()  # cold: region/op compiles included
+        cold = time.perf_counter() - t0
+        warm, out = timed(lambda: df.toArrow(), reps=2)
+        prof = getattr(df, "_last_profile", None) or {}
+        real = [r for r in prof.get("ops", [])
+                if "fused_region" not in r]
+        dispatches = sum(r.get("batches_out") or 0 for r in real)
+        regions = sum(1 for r in real if r.get("region_ops"))
+        return cold, warm, out, dispatches, regions
+
+    res = {"chain_ops": 12, "batch_rows": 4096}
+    for n in (1 << 14, 1 << 17):
+        # fused first: both runs share the scan/join/agg kernels through
+        # the in-process cache, so running unfused SECOND hands it those
+        # compiles for free — the conservative ordering for fusion's win
+        c_f, w_f, out_f, disp_f, regions = run(n, fused=True)
+        mark(f"fusion {n}r fused:   cold {c_f:.3f}s warm {w_f:.3f}s "
+             f"dispatches {disp_f} regions {regions}")
+        c_u, w_u, out_u, disp_u, _ = run(n, fused=False)
+        mark(f"fusion {n}r unfused: cold {c_u:.3f}s warm {w_u:.3f}s "
+             f"dispatches {disp_u}")
+        rec = {"rows": n,
+               "fusion_regions": regions,
+               "cold_unfused_s": round(c_u, 3),
+               "cold_fused_s": round(c_f, 3),
+               "warm_unfused_s": round(w_u, 3),
+               "warm_fused_s": round(w_f, 3),
+               "warm_speedup": round(w_u / w_f, 3),
+               "dispatches_unfused": disp_u,
+               "dispatches_fused": disp_f,
+               "dispatch_delta": disp_u - disp_f,
+               "rows_equal": _rows_equal(out_f, out_u)}
+        if not rec["rows_equal"]:
+            mark(f"fusion_bench {n}: FUSED/UNFUSED OUTPUTS DIFFER — "
+                 "speedup is void")
+        res[f"n{n}"] = rec
+    res["speedup"] = res["n131072"]["warm_speedup"]
+    return res
+
+
 def _ici_bench_main() -> None:
     """Measure the compiled exchange's boundary program (the device
     collective the engine dispatches at every stage seam) over the
@@ -1264,7 +1377,13 @@ TPCH_SF1_CONF = {"spark.rapids.sql.enabled": True,
                  # stats-driven replanning rides the SF1 ladder: its
                  # decisions land in each query's TPCH_SF1_STATS record
                  # so profile.py diff can flag strategy flips run-over-run
-                 "spark.rapids.tpu.adaptive.enabled": True}
+                 "spark.rapids.tpu.adaptive.enabled": True,
+                 # whole-stage fusion rides the sweep too: the scan-side
+                 # filter/project ladders every TPC-H query carries are
+                 # exactly the chains the plane collapses, and each
+                 # query's record carries fusion_regions /
+                 # fused_op_fraction so the coverage is auditable
+                 "spark.rapids.tpu.fusion.enabled": True}
 TPCH_SF1_CONF.update(json.loads(os.environ.get(
     "TPUQ_BENCH_CONF_JSON", "{}")))
 
@@ -1395,8 +1514,17 @@ def _sf1_query_main(name: str) -> None:
             top = sorted(prof["ops"],
                          key=lambda r: -(r.get("self_s") or 0))[:12]
             from spark_rapids_tpu import kernels as KN
+            # fusion coverage: how many regions the plan carries and
+            # what fraction of the would-be-unfused op count they
+            # absorbed (member ops / (real ops - regions + members))
+            real = [r for r in prof["ops"] if "fused_region" not in r]
+            member_n = sum(r.get("region_ops") or 0 for r in real)
+            region_n = sum(1 for r in real if r.get("region_ops"))
+            denom = max(len(real) - region_n + member_n, 1)
             print("TPCH_SF1_STATS=" + json.dumps(
                 {"ops": top, "exchanges": prof["exchanges"],
+                 "fusion_regions": region_n,
+                 "fused_op_fraction": round(member_n / denom, 3),
                  # effective kernel rung for this run's joins/aggs
                  # (docs/kernels.md): "auto" resolves per platform, so
                  # the record pins what actually ran
@@ -1828,6 +1956,7 @@ def main():
         "result_cache_soak": None,
         "kernel_bench": None,
         "adaptive_bench": None,
+        "fusion_bench": None,
         "tpch_small_oracle_ok": checked,
         "tudo_serialize_gb_per_s": round(tudo_serialize_gb_per_s(), 2),
         "host_memcpy_gb_per_s": round(host_memcpy_gb_per_s(), 2),
@@ -1859,6 +1988,12 @@ def main():
     except Exception as e:  # a microbench failure must not kill the run
         result["adaptive_bench"] = {"error": str(e)}
         mark(f"adaptive_bench failed: {e}")
+    emit()
+    try:
+        result["fusion_bench"] = fusion_bench(mark)
+    except Exception as e:  # a microbench failure must not kill the run
+        result["fusion_bench"] = {"error": str(e)}
+        mark(f"fusion_bench failed: {e}")
     emit()
     result.update(ici_bench(mark))
     emit()

@@ -1192,6 +1192,57 @@ KERNEL_WARMUP_ON_START = (
 )
 
 
+# -- whole-stage fusion plane (spark_rapids_tpu/fusion/) --------------------
+
+FUSION_ENABLED = (
+    conf("spark.rapids.tpu.fusion.enabled")
+    .doc("Master switch for the whole-stage fusion plane "
+         "(spark_rapids_tpu/fusion/): after plan conversion, maximal "
+         "chains of fusable per-batch map operators (project / filter / "
+         "cast chains) are stitched into FusedStageExec regions, each "
+         "lowered to ONE jitted XLA program — intermediate batches stay "
+         "device-resident SSA values inside the program, and the pump / "
+         "pad-mask / shape-bucket boundary is paid once per region "
+         "instead of once per operator.  Region boundaries are "
+         "exchanges, stateful or non-jitable operators (limits, UDF "
+         "fallbacks, collect aggregates) and anything whose fusion hook "
+         "the fusion-purity analysis cannot prove host-pull-free.  A "
+         "region that fails to compile falls open to the unfused pump "
+         "chain (counted in tpuq_fusion_fallback_total); answers are "
+         "bit-identical either way (tests/test_fusion.py).")
+    .category("fusion")
+    .boolean()
+    .create_with_default(False)
+)
+
+FUSION_MAX_OPS = (
+    conf("spark.rapids.tpu.fusion.maxOpsPerRegion")
+    .doc("Upper bound on the member operators stitched into one fused "
+         "region.  A chain longer than this splits into consecutive "
+         "regions, bounding single-program XLA compile time; raising it "
+         "trades compile latency for fewer dispatch boundaries.")
+    .category("fusion")
+    .integer()
+    .check(lambda v: 2 <= int(v) <= 64, "in [2, 64]")
+    .create_with_default(16)
+)
+
+FUSION_MODE = (
+    conf("spark.rapids.tpu.fusion.mode")
+    .doc("Region-selection policy: 'auto' fuses only chains of 2+ "
+         "fusable operators (a singleton region saves nothing over the "
+         "op's own cached kernel), 'aggressive' also wraps singleton "
+         "fusable ops so every map rides region bookkeeping (useful to "
+         "exercise the plane), 'off' disables region selection even "
+         "when fusion.enabled is true.")
+    .category("fusion")
+    .string()
+    .check(lambda v: str(v).lower() in ("auto", "off", "aggressive"),
+           "one of auto, off, aggressive")
+    .create_with_default("auto")
+)
+
+
 # -- multi-tenant query service (runtime/scheduler.py + sql/server.py) ------
 #
 # Per-tenant overrides ride a dynamic key family the scheduler reads at

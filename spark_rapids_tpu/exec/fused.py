@@ -1,0 +1,115 @@
+"""FusedStageExec: one exec node, one jitted program, N operators.
+
+[REF: sql-plugin/../basicPhysicalOperators.scala :: GpuTieredProject;
+ Spark WholeStageCodegenExec]
+
+The fusion pass (fusion/regions.py) replaces a chain of fusable map
+operators with one of these.  Execution composes the members'
+``fusion()`` functions bottom-up into a single batch→batch function and
+compiles it once through ``cached_kernel`` under a region signature
+(the tuple of member cache keys), so per batch the whole chain costs
+one pump boundary and one XLA dispatch — the intermediate batches the
+unfused chain would materialize exist only as SSA values inside the
+program.  Because the region is ONE exec node, the auto-wrapped pump
+stack (stats / cancel / shape-bucket / prefetch) and the shape plane's
+pad-mask handling also run once per region instead of once per member.
+
+Fall-open: the member nodes keep their original chain wiring (bottom
+member → shared source), so if the region program fails to build or
+trace on its first dispatch the region permanently reverts to pumping
+that unfused chain — counted in ``tpuq_fusion_fallback_total`` and
+flagged by the ``fusionFellOpen`` metric.  Failures after the first
+successful dispatch are real execution failures and propagate through
+``cached_kernel``'s execute failure domain like any operator's.
+
+A region is itself a pure batch→batch map, so it exposes ``fusion()``
+too: an aggregate that tiers its upstream maps into its own kernel
+(``fuse_upstream``) absorbs the whole region exactly as it absorbed
+the loose chain before the fusion plane existed.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, List, Sequence
+
+from spark_rapids_tpu.columnar.column import DeviceBatch
+from spark_rapids_tpu.exec.base import TpuExec
+
+
+class FusedStageExec(TpuExec):
+    def __init__(self, members: Sequence[TpuExec], sigs: List[dict],
+                 child: TpuExec):
+        if not members:
+            raise ValueError("a fused region needs at least one member")
+        super().__init__(members[0].schema, child)
+        self.members = list(members)  # top-down (consumer first)
+        # member metadata consumed by the stats plane: each member's
+        # pre-fusion plan signature/path, so profile records stay
+        # diffable against unfused history (runtime/stats.py)
+        self.fusion_members = list(sigs)
+        self._region_key = ("fused_region",) + tuple(
+            s["key"] for s in sigs)
+        self._fell_open = False
+
+    def node_string(self) -> str:
+        names = "+".join(
+            m.name[:-4] if m.name.endswith("Exec") else m.name
+            for m in self.members)
+        return f"FusedStage [fused: {names}]"
+
+    def fusion(self):
+        return self._composed(), self._region_key
+
+    def _composed(self):
+        # bottom-up application order: members are stored top-down
+        fns = [m.fusion()[0] for m in reversed(self.members)]
+
+        def run(batch: DeviceBatch) -> DeviceBatch:
+            for f in fns:
+                batch = f(batch)
+            return batch
+
+        return run
+
+    def _fall_open(self) -> None:
+        from spark_rapids_tpu import fusion as F
+        self._fell_open = True
+        self.metric("fusionFellOpen").value = 1
+        F.FALLBACKS.inc()
+
+    def execute(self, partition: int) -> Iterator[DeviceBatch]:
+        from spark_rapids_tpu import fusion as F
+        from spark_rapids_tpu.runtime.kernel_cache import (
+            cached_kernel, compile_snapshot)
+        fn = None
+        if not self._fell_open:
+            try:
+                fn = cached_kernel(self._region_key, self._composed)
+            except Exception:
+                self._fall_open()
+        if self._fell_open:
+            yield from self.members[0].execute(partition)
+            return
+        first = True
+        for b in self.children[0].execute(partition):
+            try:
+                if first:
+                    c0, s0 = compile_snapshot()
+                with self.timer():
+                    out = fn(b)
+                if first:
+                    c1, s1 = compile_snapshot()
+                    if c1 > c0:
+                        self.metric("regionCompileTime").add(s1 - s0)
+                        F.COMPILE_SECONDS.inc(s1 - s0)
+            except Exception:
+                if not first:
+                    raise  # a real mid-stream execution failure
+                # nothing yielded yet: fall open to the unfused chain,
+                # which re-pulls the shared source from scratch
+                self._fall_open()
+                yield from self.members[0].execute(partition)
+                return
+            first = False
+            self.metric("numOutputBatches").add(1)
+            yield out

@@ -1,0 +1,54 @@
+"""Whole-stage fusion plane: operator chains as single XLA programs.
+
+[REF: sql-plugin/../basicPhysicalOperators.scala :: GpuTieredProject;
+ Spark WholeStageCodegenExec]  (PAPER.md §kernels: the reference gets
+its single-query throughput from one kernel launch per stage, not one
+per operator.)
+
+The exec layer pays a fixed toll at every operator boundary: a pump
+dispatch (stats/trace/cancel/prefetch generators), a shape-plane
+pad/bucket cycle, a cached-kernel dispatch, and an intermediate
+device batch.  For map-shaped operators (project / filter / cast
+chains) none of that buys anything — the ops are pure batch→batch
+functions that XLA would happily fuse into one program if it ever saw
+them together.
+
+This plane makes XLA see them together.  ``fuse_plan`` walks the
+converted physical plan after ``apply_overrides`` finishes rewriting
+it, greedily stitches maximal chains of unary ``TpuExec`` nodes whose
+``fusion()`` hook is non-None into ``FusedStageExec`` regions
+(exec/fused.py), and leaves everything else — exchanges, joins,
+aggregates, limits, UDF fallbacks, CPU islands — as natural region
+boundaries (their ``fusion()`` is None).  Each region compiles to ONE
+jitted program through the ``cached_kernel`` chokepoint: intermediate
+batches are device-resident SSA values inside the program, and the
+pump / pad-mask / shape-bucket boundary runs once per region instead
+of once per member.
+
+Conf-gated under ``spark.rapids.tpu.fusion.{enabled,maxOpsPerRegion,
+mode}``; a region whose program fails to build or trace falls open to
+the preserved unfused chain (counted in ``tpuq_fusion_fallback_total``)
+so fusion can never change an answer — only its dispatch count.
+See docs/fusion.md.
+"""
+
+from __future__ import annotations
+
+from spark_rapids_tpu.runtime.telemetry import REGISTRY
+
+# process-telemetry family (docs/observability.md)
+REGIONS_BUILT = REGISTRY.counter(
+    "tpuq_fusion_regions_built_total",
+    "FusedStageExec regions stitched into plans by the fusion pass")
+FALLBACKS = REGISTRY.counter(
+    "tpuq_fusion_fallback_total",
+    "fused regions that fell open to their unfused pump chain after a "
+    "region program failed to build or trace")
+COMPILE_SECONDS = REGISTRY.counter(
+    "tpuq_fusion_compile_seconds_total",
+    "XLA compile seconds attributed to fused region programs (first "
+    "dispatch per region signature)")
+
+from spark_rapids_tpu.fusion.regions import fuse_plan  # noqa: E402
+
+__all__ = ["fuse_plan", "REGIONS_BUILT", "FALLBACKS", "COMPILE_SECONDS"]

@@ -636,6 +636,11 @@ def apply_overrides(cpu_plan: CpuExec, conf: RapidsConf) -> OverrideResult:
     if isinstance(plan, TpuExec):
         plan = DeviceToHostExec(plan)
     plan = insert_coalesce(plan, conf)
+    # whole-stage fusion last: it needs the final converted tree (so the
+    # member signatures it records match what an unfused run of this
+    # exact plan would execute — see fusion/regions.py)
+    from spark_rapids_tpu.fusion import fuse_plan
+    plan, _ = fuse_plan(plan, conf)
     from spark_rapids_tpu.parallel.executor import get_executor
     if get_executor() is not None:
         _validate_multiproc(plan)

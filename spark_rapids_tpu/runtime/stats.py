@@ -61,6 +61,14 @@ STATS_FIELDS = {
     "self_s": "operator self-time from the trace rollup (traced runs)",
     "total_s": "operator total time from the trace rollup (traced runs)",
     "fused": "operator was fused into its consumer's kernel (stays zero)",
+    "fused_region": "signature of the enclosing FusedStageExec on the "
+                    "synthetic per-member records a fused region emits "
+                    "(the member keeps its pre-fusion sig/path, so "
+                    "profile diff lines it up with unfused history)",
+    "region_ops": "member operators compiled into this fused region's "
+                  "single XLA program (FusedStageExec records only)",
+    "region_compile_s": "XLA compile seconds observed on this fused "
+                        "region's first dispatch (regionCompileTime)",
     "kernel_backend": "kernel-plane backend that produced this "
                       "operator's results (jnp/fused/pallas; 'mixed' "
                       "when dispatches disagreed across batches)",
@@ -385,6 +393,32 @@ class OpStatsCollector:
                     rec["self_s"] = r.get("self_s")
                     rec["total_s"] = r.get("total_s")
             ops.append(rec)
+            members = getattr(node, "fusion_members", None)
+            if members:
+                rec["region_ops"] = len(members)
+                ct = getattr(node, "metrics", {}).get("regionCompileTime")
+                if ct is not None and ct.value:
+                    rec["region_compile_s"] = round(float(ct.value), 6)
+                # synthetic per-member records: each member keeps the
+                # signature/path it would have carried unfused, so
+                # `profile diff` compares fused runs against unfused
+                # history and `top` attributes region time back to the
+                # member ops (an even split — the program is one fused
+                # dispatch, per-member time has no separate observer)
+                share = (rec["self_s"] / len(members)
+                         if rec.get("self_s") is not None else None)
+                for m in members:
+                    mrec: Dict[str, Any] = {
+                        "op": m["op"], "sig": m["sig"], "path": m["path"],
+                        "fused": True, "fused_region": rec["sig"],
+                        "rows_out": 0, "batches_out": 0, "bytes_out": 0,
+                        "rows_in": 0, "bytes_in": 0, "batches_in": 0,
+                        "batch_rows_hist": {},
+                    }
+                    if share is not None:
+                        mrec["self_s"] = share
+                        mrec["total_s"] = share
+                    ops.append(mrec)
             for i, c in enumerate(node.children):
                 walk(c, f"{path}.{i}")
 

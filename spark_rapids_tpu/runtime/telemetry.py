@@ -367,7 +367,7 @@ def ensure_producers() -> None:
                 "shuffle.manager", "shuffle.exchange",
                 "parallel.executor", "parallel.shuffle",
                 "parallel.rendezvous", "exec.distributed",
-                "kernels", "cache"):
+                "kernels", "cache", "fusion"):
         try:
             importlib.import_module(f"spark_rapids_tpu.{mod}")
         except Exception as e:  # never fail a report over one producer

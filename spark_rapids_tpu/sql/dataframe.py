@@ -1271,7 +1271,11 @@ class DataFrame:
                   "plane; re-run when it finishes>")
             return
         plan = self._last_plan
-        by_path = {r["path"]: r for r in profile["ops"]}
+        # synthetic per-member records of fused regions carry their
+        # PRE-fusion paths, which can collide with real nodes of the
+        # fused tree — the tree walk wants only real-node records
+        by_path = {r["path"]: r for r in profile["ops"]
+                   if "fused_region" not in r}
         lines = []
 
         def walk(node, path, depth):
@@ -1293,6 +1297,10 @@ class DataFrame:
                     ann += f" executors={rec['executors']}"
             if rec.get("fused"):
                 ann += " fused"
+            if rec.get("region_ops"):
+                ann += f" region_ops={rec['region_ops']}"
+                if rec.get("region_compile_s") is not None:
+                    ann += f" compile={rec['region_compile_s']:.6f}s"
             if rec.get("kernel_backend"):
                 ann += f" kernel={rec['kernel_backend']}"
             if rec.get("adaptive"):

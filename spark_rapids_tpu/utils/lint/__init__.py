@@ -233,6 +233,7 @@ def all_rules() -> List[Rule]:
         ExchangePurityRule)
     from spark_rapids_tpu.utils.lint.failure_domains import (
         FailureDomainRule)
+    from spark_rapids_tpu.utils.lint.fusion_purity import FusionPurityRule
     from spark_rapids_tpu.utils.lint.host_sync import HostSyncInJitRule
     from spark_rapids_tpu.utils.lint.kernel_purity import KernelPurityRule
     from spark_rapids_tpu.utils.lint.lock_order import LockOrderRule
@@ -243,7 +244,8 @@ def all_rules() -> List[Rule]:
     return [LockOrderRule(), ConfDriftRule(), FailureDomainRule(),
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
             SchedulerBypassRule(), RawJitRule(), ExchangePurityRule(),
-            KernelPurityRule(), AdaptivePurityRule(), CacheSafetyRule()]
+            KernelPurityRule(), AdaptivePurityRule(), CacheSafetyRule(),
+            FusionPurityRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

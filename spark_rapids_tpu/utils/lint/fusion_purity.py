@@ -1,0 +1,60 @@
+"""``fusion-purity`` — fused-region builders stay host-pull-free.
+
+A fused region's whole point is that N operators run as ONE jitted
+program with their intermediates as device-resident SSA values
+(docs/fusion.md).  The functions that build those programs — the
+fusion plane (``spark_rapids_tpu/fusion/``), ``exec/fused.py``, and
+every operator's ``fusion()`` region-builder hook in ``exec/`` — must
+therefore never materialize on the host: a ``np.asarray`` / ``.item()``
+/ ``device_get`` there either fails at trace time inside the region
+program or silently reinstates a per-batch host round trip *multiplied
+by every region the operator joins*.  The region-selection contract
+("fusable" == provably host-pull-free) is exactly this rule: an
+operator whose hook can't pass it must keep ``fusion() -> None`` and
+stay a region boundary.  Same flag tables as ``exchange-purity`` /
+``kernel-purity`` so the three rules can't drift.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+from spark_rapids_tpu.utils.lint.exchange_purity import (
+    ExchangePurityRule)
+
+SCOPE_PREFIX = "spark_rapids_tpu/fusion/"
+SCOPE_FILES = ("spark_rapids_tpu/exec/fused.py",)
+# outside the plane itself, only the region-builder hooks are in scope
+HOOK_PREFIX = "spark_rapids_tpu/exec/"
+HOOK_NAME = "fusion"
+
+
+class FusionPurityRule(Rule):
+    name = "fusion-purity"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        whole_module = (mod.rel.startswith(SCOPE_PREFIX)
+                        or mod.rel in SCOPE_FILES)
+        if not whole_module and not mod.rel.startswith(HOOK_PREFIX):
+            return ()
+        flag = ExchangePurityRule()._flag
+        out: List[Finding] = []
+        seen: Set[int] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if not whole_module and node.name != HOOK_NAME:
+                continue
+            for sub in ast.walk(node):
+                msg = flag(sub)
+                if msg and sub.lineno not in seen:
+                    seen.add(sub.lineno)
+                    out.append(Finding(
+                        self.name, mod.rel, sub.lineno,
+                        f"{msg} inside fused-region builder "
+                        f"`{node.name}` "
+                        f"(`{mod.snippet(sub.lineno)}`)"))
+        return out

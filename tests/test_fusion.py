@@ -1,0 +1,298 @@
+"""Whole-stage fusion plane: region selection, bit identity, fall-open.
+
+A fused region must never change ANSWERS — every integration test here
+runs the same query fused, unfused, and on the CPU oracle and compares
+sorted tables exactly.  [REF: Spark WholeStageCodegen semantics —
+fusion is a physical rewrite, never a logical one]
+"""
+
+import pyarrow as pa
+import pyarrow.compute as pc
+import pytest
+
+from spark_rapids_tpu import fusion as FU
+from spark_rapids_tpu.exec.fused import FusedStageExec
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils.datagen import (
+    DoubleGen, LongGen, SkewedLongGen, StringGen, gen_table,
+    skewed_null_table)
+from spark_rapids_tpu.utils.harness import cpu_session, tpu_session
+
+FUSED = {"spark.rapids.tpu.fusion.enabled": True}
+
+
+def _canon(t: pa.Table) -> pa.Table:
+    t = t.combine_chunks()
+    idx = pc.sort_indices(
+        t, sort_keys=[(n, "ascending") for n in t.column_names])
+    return t.take(idx)
+
+
+def _assert_identical(a: pa.Table, b: pa.Table, what: str):
+    assert _canon(a).equals(_canon(b)), f"{what}: tables differ"
+
+
+def _regions(node):
+    out = [node] if isinstance(node, FusedStageExec) else []
+    for c in node.children:
+        out.extend(_regions(c))
+    return out
+
+
+def _chain(s, t):
+    """filter → project → filter: the canonical 3-op fusable chain."""
+    return (s.createDataFrame(t)
+            .filter(col("k") % 3 != 1)
+            .select((col("k") % 7).alias("k7"), col("v"))
+            .filter(col("k7") > 1))
+
+
+# ---------------------------------------------------------------------------
+# region selection
+# ---------------------------------------------------------------------------
+
+def test_chain_fuses_into_one_region():
+    t = gen_table([LongGen(min_val=0, max_val=1000, nullable=False),
+                   DoubleGen(no_nans=True)], 2000, seed=0,
+                  names=["k", "v"])
+    df = _chain(tpu_session(FUSED), t)
+    fused = df.toArrow()
+    regions = _regions(df._last_plan)
+    assert len(regions) == 1
+    assert len(regions[0].fusion_members) == 3
+    assert "[fused: TpuFilter+TpuProject+TpuFilter]" in \
+        regions[0].node_string()
+    unfused = _chain(tpu_session(), t)
+    t_off = unfused.toArrow()
+    assert _regions(unfused._last_plan) == []
+    _assert_identical(fused, t_off, "fused vs unfused")
+    _assert_identical(fused, _chain(cpu_session(), t).toArrow(),
+                      "fused vs cpu")
+
+
+def test_mode_off_and_aggressive():
+    t = gen_table([LongGen(min_val=0, max_val=100, nullable=False),
+                   DoubleGen(no_nans=True)], 512, seed=3,
+                  names=["k", "v"])
+
+    off = dict(FUSED, **{"spark.rapids.tpu.fusion.mode": "off"})
+    df = _chain(tpu_session(off), t)
+    df.toArrow()
+    assert _regions(df._last_plan) == []
+
+    # aggressive wraps even a singleton fusable op
+    agg = dict(FUSED, **{"spark.rapids.tpu.fusion.mode": "aggressive"})
+    df1 = tpu_session(agg).createDataFrame(t).filter(col("k") > 10)
+    out = df1.toArrow()
+    regions = _regions(df1._last_plan)
+    assert regions and len(regions[0].fusion_members) == 1
+    _assert_identical(
+        out,
+        cpu_session().createDataFrame(t).filter(col("k") > 10).toArrow(),
+        "aggressive singleton vs cpu")
+
+
+def test_max_ops_per_region_splits_chain():
+    t = gen_table([LongGen(min_val=0, max_val=1000, nullable=False),
+                   DoubleGen(no_nans=True)], 1024, seed=4,
+                  names=["k", "v"])
+
+    def q(s):
+        return (s.createDataFrame(t)
+                .filter(col("k") % 2 == 0)
+                .select((col("k") % 11).alias("a"), col("v"))
+                .filter(col("a") > 2)
+                .select((col("a") + 1).alias("b"), col("v")))
+
+    conf = dict(FUSED, **{"spark.rapids.tpu.fusion.maxOpsPerRegion": 2})
+    df = q(tpu_session(conf))
+    fused = df.toArrow()
+    regions = _regions(df._last_plan)
+    assert len(regions) == 2
+    assert all(len(r.fusion_members) == 2 for r in regions)
+    _assert_identical(fused, q(cpu_session()).toArrow(),
+                      "split regions vs cpu")
+
+
+def test_udf_mid_chain_splits_region():
+    t = gen_table([LongGen(min_val=0, max_val=500, nullable=False),
+                   DoubleGen(no_nans=True)], 600, seed=5,
+                  names=["k", "v"])
+    bump = F.pandas_udf(lambda x: x + 1.0, "double")
+
+    def q(s):
+        return (s.createDataFrame(t)
+                .filter(col("k") % 3 != 0)
+                .select(col("k"), (col("v") * 2).alias("v2"))
+                .withColumn("u", bump(col("v2")))
+                .filter(col("k") % 5 != 0)
+                .select((col("k") % 9).alias("k9"), col("u")))
+
+    df = q(tpu_session(FUSED))
+    fused = df.toArrow()
+    regions = _regions(df._last_plan)
+    # the UDF is a host round trip by definition: one region below it,
+    # one above — never one region through it
+    assert len(regions) == 2
+    _assert_identical(fused, q(cpu_session()).toArrow(),
+                      "udf-split chain vs cpu")
+
+
+# ---------------------------------------------------------------------------
+# bit-identity matrix over the nasty generators
+# ---------------------------------------------------------------------------
+
+_GEN_TABLES = {
+    "skewed": lambda: pa.table({
+        "k": gen_table([SkewedLongGen(hot_mass=0.8, nullable=False)],
+                       4000, seed=11, names=["k"])["k"],
+        "v": gen_table([DoubleGen(no_nans=True, null_ratio=0.1)],
+                       4000, seed=12, names=["v"])["v"]}),
+    "null_heavy": lambda: skewed_null_table(4000, seed=13,
+                                            null_ratio=0.5)
+    .select(["k", "v"]),
+    "string_heavy": lambda: gen_table(
+        [LongGen(min_val=0, max_val=200, nullable=False),
+         StringGen(min_len=0, max_len=16, null_ratio=0.3)],
+        4000, seed=14, names=["k", "v"]),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(_GEN_TABLES))
+def test_bit_identity_matrix(kind):
+    t = _GEN_TABLES[kind]()
+    df = _chain(tpu_session(FUSED), t)
+    fused = df.toArrow()
+    assert _regions(df._last_plan), "expected a fused region"
+    t_off = _chain(tpu_session(), t).toArrow()
+    t_cpu = _chain(cpu_session(), t).toArrow()
+    _assert_identical(fused, t_off, f"{kind}: fused vs unfused")
+    _assert_identical(fused, t_cpu, f"{kind}: fused vs cpu")
+
+
+def test_zero_row_partitions():
+    # (a) a fused region whose predicate keeps nothing
+    t = gen_table([LongGen(min_val=0, max_val=50, nullable=False),
+                   DoubleGen(no_nans=True)], 300, seed=21,
+                  names=["k", "v"])
+
+    def empty_q(s):
+        return (s.createDataFrame(t)
+                .filter(col("k") < -1)
+                .select((col("k") % 3).alias("k3"), col("v"))
+                .filter(col("k3") >= 0))
+
+    df = empty_q(tpu_session(FUSED))
+    out = df.toArrow()
+    assert out.num_rows == 0
+    assert _regions(df._last_plan)
+    _assert_identical(out, empty_q(cpu_session()).toArrow(),
+                      "empty result vs cpu")
+
+    # (b) zero-row input partitions: 3 rows across 8 partitions
+    tiny = pa.table({"k": pa.array([1, 2, 3], type=pa.int64()),
+                     "v": pa.array([1.0, 2.0, 3.0])})
+
+    def part_q(s):
+        return (s.createDataFrame(tiny).repartition(8)
+                .filter(col("k") != 2)
+                .select((col("k") * 10).alias("k10"), col("v")))
+
+    df2 = part_q(tpu_session(FUSED))
+    fused2 = df2.toArrow()
+    assert _regions(df2._last_plan)
+    _assert_identical(fused2, part_q(cpu_session()).toArrow(),
+                      "zero-row partitions vs cpu")
+
+
+def test_pad_mask_invariance_forced_ladder():
+    """Fused regions see the shape plane's pad rows exactly once per
+    region; a forced bucket ladder (heavy padding) must not leak pads
+    into answers."""
+    t = skewed_null_table(3000, seed=31).select(["k", "v"])
+    ladder = dict(FUSED, **{
+        "spark.rapids.tpu.kernel.bucketing": "ladder",
+        "spark.rapids.tpu.kernel.bucketLadder": "1024,8192"})
+    off = dict(FUSED, **{"spark.rapids.tpu.kernel.bucketing": "off"})
+    df = _chain(tpu_session(ladder), t)
+    t_ladder = df.toArrow()
+    assert _regions(df._last_plan)
+    t_off = _chain(tpu_session(off), t).toArrow()
+    t_cpu = _chain(cpu_session(), t).toArrow()
+    _assert_identical(t_ladder, t_off, "ladder vs bucketing-off")
+    _assert_identical(t_ladder, t_cpu, "ladder vs cpu")
+
+
+# ---------------------------------------------------------------------------
+# fall-open on compile failure
+# ---------------------------------------------------------------------------
+
+def test_compile_failure_falls_open(monkeypatch):
+    t = gen_table([LongGen(min_val=0, max_val=100, nullable=False),
+                   DoubleGen(no_nans=True)], 1000, seed=41,
+                  names=["k", "v"])
+
+    def boom(self):
+        raise ValueError("forced region build failure")
+
+    # earlier tests in this module may have compiled the same region
+    # program; a cache hit would skip the poisoned builder entirely
+    from spark_rapids_tpu.runtime import kernel_cache
+    kernel_cache.clear()
+    monkeypatch.setattr(FusedStageExec, "_composed", boom)
+    before = FU.FALLBACKS.value
+    df = _chain(tpu_session(FUSED), t)
+    out = df.toArrow()
+    assert FU.FALLBACKS.value > before
+    region = _regions(df._last_plan)[0]
+    assert region._fell_open
+    assert region.metrics["fusionFellOpen"].value == 1
+    monkeypatch.undo()
+    _assert_identical(out, _chain(cpu_session(), t).toArrow(),
+                      "fell-open region vs cpu")
+
+
+# ---------------------------------------------------------------------------
+# observability: diffable member signatures + EXPLAIN ANALYZE
+# ---------------------------------------------------------------------------
+
+def test_member_signatures_diff_against_unfused():
+    """The fused run's synthetic member records carry the SAME
+    signatures an unfused run of the same query records — the property
+    `profile diff` needs to line fused runs up against unfused
+    history."""
+    t = gen_table([LongGen(min_val=0, max_val=1000, nullable=False),
+                   DoubleGen(no_nans=True)], 2000, seed=51,
+                  names=["k", "v"])
+    stats_on = {"spark.rapids.tpu.stats.enabled": True}
+
+    s_off = tpu_session(stats_on)
+    _chain(s_off, t).toArrow()
+    prof_off = s_off.last_query_profile()
+    sigs_off = {(r["op"], r["sig"]) for r in prof_off["ops"]
+                if r["op"] in ("TpuFilterExec", "TpuProjectExec")}
+
+    s_on = tpu_session(dict(FUSED, **stats_on))
+    _chain(s_on, t).toArrow()
+    prof_on = s_on.last_query_profile()
+    members = [r for r in prof_on["ops"] if "fused_region" in r]
+    assert len(members) == 3
+    assert {(r["op"], r["sig"]) for r in members} == sigs_off
+    assert all(r["fused"] for r in members)
+    region = next(r for r in prof_on["ops"] if r.get("region_ops"))
+    assert region["region_ops"] == 3
+    assert all(m["fused_region"] == region["sig"] for m in members)
+
+
+def test_explain_analyze_renders_fused_region(capsys):
+    t = gen_table([LongGen(min_val=0, max_val=1000, nullable=False),
+                   DoubleGen(no_nans=True)], 2000, seed=61,
+                  names=["k", "v"])
+    df = _chain(tpu_session(dict(
+        FUSED, **{"spark.rapids.tpu.stats.enabled": True})), t)
+    df.toArrow()
+    df.explain("analyze")
+    out = capsys.readouterr().out
+    assert "[fused: TpuFilter+TpuProject+TpuFilter]" in out
+    assert "region_ops=3" in out

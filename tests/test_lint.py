@@ -760,6 +760,71 @@ def test_kernel_purity_exemption():
 
 
 # ---------------------------------------------------------------------------
+# fusion-purity
+# ---------------------------------------------------------------------------
+
+def test_fusion_purity_flags_host_pulls_in_plane():
+    from spark_rapids_tpu.utils.lint.fusion_purity import FusionPurityRule
+    m = _mod("spark_rapids_tpu/fusion/regions.py", """
+        import jax
+        import numpy as np
+
+        def stitch_region(members):
+            probe = np.asarray(members[0])
+            jax.device_get(members)
+            members[0].block_until_ready()
+            return members
+        """)
+    out = _run([FusionPurityRule()], m)
+    assert [f.rule for f in out] == ["fusion-purity"] * 3
+    assert "stitch_region" in out[0].message
+
+
+def test_fusion_purity_scope_hooks_only_outside_plane():
+    from spark_rapids_tpu.utils.lint.fusion_purity import FusionPurityRule
+    # in exec/ (outside fused.py) only the fusion() hook is in scope:
+    # the hook's host pull is flagged, execute()'s is another rule's job
+    hook = _mod("spark_rapids_tpu/exec/widgets.py", """
+        import numpy as np
+
+        class TpuWidgetExec:
+            def fusion(self):
+                def run(batch):
+                    return np.asarray(batch)
+                return run, ("widget",)
+
+            def execute(self, partition):
+                return np.asarray(partition)
+        """)
+    out = _run([FusionPurityRule()], hook)
+    assert [f.rule for f in out] == ["fusion-purity"]
+    assert "fusion" in out[0].message
+    clean = _mod("spark_rapids_tpu/fusion/planner.py", """
+        def pick_regions(plan, max_ops):
+            return [plan]
+        """)
+    elsewhere = _mod("spark_rapids_tpu/runtime/gather.py", """
+        import numpy as np
+
+        def pull(x):
+            return np.asarray(x)
+        """)
+    assert _run([FusionPurityRule()], clean, elsewhere) == []
+
+
+def test_fusion_purity_exemption():
+    from spark_rapids_tpu.utils.lint.fusion_purity import FusionPurityRule
+    m = _mod("spark_rapids_tpu/exec/fused.py", """
+        import numpy as np
+
+        def region_debug_dump(batch):
+            # lint: exempt(fusion-purity): debug dump behind a flag
+            return np.asarray(batch)
+        """)
+    assert _run([FusionPurityRule()], m) == []
+
+
+# ---------------------------------------------------------------------------
 # the tier-1 gate: the real tree is clean
 # ---------------------------------------------------------------------------
 
