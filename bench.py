@@ -1383,7 +1383,18 @@ TPCH_SF1_CONF = {"spark.rapids.sql.enabled": True,
                  # exactly the chains the plane collapses, and each
                  # query's record carries fusion_regions /
                  # fused_op_fraction so the coverage is auditable
-                 "spark.rapids.tpu.fusion.enabled": True}
+                 "spark.rapids.tpu.fusion.enabled": True,
+                 # r06: the full serving stack rides the sweep —
+                 # compiled exchange plans, the per-platform kernel
+                 # rung resolver, and the result cache.  minRuntimeMs
+                 # is pushed above any SF1 query so the timed reps stay
+                 # honest cache MISSES (the cache plane still exercises
+                 # its probe path, which the attribution ledger books
+                 # under the `cache` bucket)
+                 "spark.rapids.tpu.exchange.mode": "compiled",
+                 "spark.rapids.tpu.kernel.backend": "auto",
+                 "spark.rapids.tpu.cache.enabled": True,
+                 "spark.rapids.tpu.cache.minRuntimeMs": 10_000_000}
 TPCH_SF1_CONF.update(json.loads(os.environ.get(
     "TPUQ_BENCH_CONF_JSON", "{}")))
 
@@ -1419,7 +1430,25 @@ def _sf1_query_main(name: str) -> None:
     # rows/bytes + exchange skew keyed by stable plan signatures — the
     # record utils/profile.py diff compares across bench runs
     conf["spark.rapids.tpu.stats.enabled"] = True
+    # black boxes land in a per-child dir so a deadline-killed query's
+    # payload can be lifted verbatim into the bench record
+    import tempfile
+    bb_dir = tempfile.mkdtemp(prefix="tpuq-bench-bb-")
+    conf["spark.rapids.tpu.attribution.blackboxPath"] = bb_dir
     dfq = build(TpuSession(conf), sf1)
+
+    def emit_attribution():
+        # where the seconds went (exclusive buckets + verdict), and for
+        # a query that died, the black box the engine dumped on the way
+        # down — the bench record is the flight recorder's archive
+        entry = getattr(dfq, "_last_query_entry", None) or {}
+        att = entry.get("attribution")
+        if att:
+            print("TPCH_SF1_ATTRIBUTION=" + json.dumps(att))
+        box_path = entry.get("blackbox")
+        if box_path and os.path.exists(box_path):
+            with open(box_path) as f:
+                print("TPCH_SF1_BLACKBOX=" + json.dumps(json.load(f)))
     # cold-vs-warm compile split: the shape plane's whole value
     # proposition is warm_compiles == 0 — the second sweep pays zero
     # compile tax because every batch landed on a canonical bucket
@@ -1435,7 +1464,20 @@ def _sf1_query_main(name: str) -> None:
     except QueryCancelled as e:
         outcome = "timeout" if e.reason == "deadline" else "cancelled"
         print(f"TPCH_SF1_OUTCOME={outcome}")
+        try:
+            emit_attribution()
+        except Exception as exc:  # diagnostics must never fail the run
+            print(f"TPCH_SF1_ATTRIBUTION_ERR={exc}")
         return
+    except Exception:
+        # a crashing query still leaves its black box (trigger=error)
+        # in the record before the child dies with the real traceback
+        print("TPCH_SF1_OUTCOME=error")
+        try:
+            emit_attribution()
+        except Exception as exc:
+            print(f"TPCH_SF1_ATTRIBUTION_ERR={exc}")
+        raise
     c2, cs2 = compile_snapshot()
     sh2 = SHP.snapshot()
     print("TPCH_SF1_OUTCOME=ok")
@@ -1450,6 +1492,10 @@ def _sf1_query_main(name: str) -> None:
         "bucket_misses": sh2[1] - sh0[1],
         "pad_rows": sh2[2] - sh0[2],
         "pad_bytes": sh2[3] - sh0[3]}))
+    try:
+        emit_attribution()
+    except Exception as exc:  # diagnostics must never fail the run
+        print(f"TPCH_SF1_ATTRIBUTION_ERR={exc}")
     rollup = getattr(dfq, "_last_rollup", None)
     if rollup:
         print("TPCH_SF1_ROLLUP=" + json.dumps(rollup))
@@ -1541,7 +1587,10 @@ def _sf1_query_main(name: str) -> None:
 def _sf1_query_subprocess(name: str, mark, budget_s: float):
     """Returns (seconds | "timeout" | "cancelled" | None,
     fallback_summary | None, op_rollup | None, memory_stats | None,
-    stats_profile | None, compile_record | None).
+    stats_profile | None, compile_record | None, attribution | None,
+    blackbox | None).  ``attribution`` is the per-query exclusive time
+    ledger (present for ok AND dead outcomes); ``blackbox`` is the
+    flight-recorder dump a deadline-killed/cancelled query left behind.
     The per-query deadline is enforced IN-PROCESS by the child (the
     engine's cancellation layer raises ``QueryCancelled`` at the
     deadline and reclaims resources); the subprocess timeout is kept
@@ -1554,7 +1603,7 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     budget_s = min(SF1_QUERY_BUDGET_S, budget_s)
     if budget_s < 30:
         mark(f"{name}: skipped — outer bench budget exhausted")
-        return None, None, None, None, None, None
+        return None, None, None, None, None, None, None, None
     env = dict(os.environ)
     env["TPUQ_BENCH_QUERY_DEADLINE_S"] = f"{budget_s:.0f}"
     try:
@@ -1566,8 +1615,9 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
     except subprocess.TimeoutExpired:
         mark(f"{name}: BACKSTOP kill after {budget_s + 60:.0f}s — the "
              f"in-process deadline failed to cancel the query")
-        return "timeout", None, None, None, None, None
+        return "timeout", None, None, None, None, None, None, None
     secs = fb = rollup = mem = stats = compiles = outcome = None
+    att = box = None
     for line in (out.stdout or "").splitlines():
         if line.startswith("TPCH_SF1_OUTCOME="):
             outcome = line.split("=", 1)[1].strip()
@@ -1583,16 +1633,23 @@ def _sf1_query_subprocess(name: str, mark, budget_s: float):
             stats = json.loads(line.split("=", 1)[1])
         elif line.startswith("TPCH_SF1_COMPILE="):
             compiles = json.loads(line.split("=", 1)[1])
+        elif line.startswith("TPCH_SF1_ATTRIBUTION="):
+            att = json.loads(line.split("=", 1)[1])
+        elif line.startswith("TPCH_SF1_BLACKBOX="):
+            box = json.loads(line.split("=", 1)[1])
     if outcome in ("timeout", "cancelled"):
+        # the dead query's ledger + black box are the whole point of
+        # the flight recorder: they ride the record even though no
+        # timing number does
         mark(f"{name}: {outcome} after {budget_s:.0f}s (in-process "
              f"deadline, resources reclaimed)")
-        return outcome, None, None, None, None, None
+        return outcome, None, None, None, None, None, att, box
     if secs is not None:
-        return secs, fb, rollup, mem, stats, compiles
+        return secs, fb, rollup, mem, stats, compiles, att, box
     # crashed child: surface the failure, don't blur it into a timeout
     mark(f"{name}: child exited rc={out.returncode}; stderr tail: "
          + (out.stderr or "")[-500:].replace("\n", " | "))
-    return None, None, None, None, None, None
+    return None, None, None, None, None, None, att, box
 
 
 CONCURRENCY_LEVELS = (1, 8, 64)
@@ -1929,6 +1986,8 @@ def main():
     memories = {name: None for name in TPCH_BUILDERS}
     statses = {name: None for name in TPCH_BUILDERS}
     compile_recs = {name: None for name in TPCH_BUILDERS}
+    attributions = {name: None for name in TPCH_BUILDERS}
+    blackboxes = {name: None for name in TPCH_BUILDERS}
     result = {
         "metric": "tpch_q6_throughput",
         "value": round(ROWS / t_tpu / 1e6, 2),
@@ -1952,6 +2011,10 @@ def main():
         "tpch_sf1_memory": memories,
         "tpch_sf1_stats": statses,
         "tpch_sf1_compile": compile_recs,
+        # per-query exclusive time ledger + the black boxes dead
+        # queries leave behind (profile.py `why` renders both)
+        "tpch_sf1_attribution": attributions,
+        "tpch_sf1_blackbox": blackboxes,
         "tpch_sf1_concurrency": None,
         "result_cache_soak": None,
         "kernel_bench": None,
@@ -2053,8 +2116,8 @@ def main():
         n_left = len(sf1_order) - i
         carve = min(remaining, max(remaining / n_left, 180.0))
         (times[name], fallbacks[name], rollups[name], memories[name],
-         statses[name], compile_recs[name]) = _sf1_query_subprocess(
-             name, mark, carve)
+         statses[name], compile_recs[name], attributions[name],
+         blackboxes[name]) = _sf1_query_subprocess(name, mark, carve)
         mark(f"{name} sf1: {times[name]}s")
         emit()
 

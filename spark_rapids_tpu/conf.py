@@ -877,6 +877,68 @@ STATS_SKEW_THRESHOLD = (
     .create_with_default(2.0)
 )
 
+ATTRIBUTION_ENABLED = (
+    conf("spark.rapids.tpu.attribution.enabled")
+    .doc("Per-query wall-clock attribution (the time books): fold trace "
+         "spans, telemetry counter deltas and op/exchange stats into "
+         "exclusive buckets (queue wait, semaphore wait, compile, kernel "
+         "dispatch, exchange collectives, host shuffle, spill/restore "
+         "I/O, cache, pump idle, host fallback) that sum to the query's "
+         "end-to-end wall time within closeTolerance, with any gap "
+         "reported explicitly as unaccounted. Also arms the flight "
+         "recorder: a bounded ring of recent spans/health/retry/cancel "
+         "events dumped atomically as query-<id>.blackbox.json when a "
+         "query dies (timeout, cancel, error) or health degrades. On by "
+         "default — reuses the existing span/counter instrumentation, "
+         "no new timers on the pump hot path.")
+    .category("observability")
+    .boolean()
+    .create_with_default(True)
+)
+
+ATTRIBUTION_RING_SIZE = (
+    conf("spark.rapids.tpu.attribution.ringSize")
+    .doc("Flight-recorder ring capacity: the last N closed spans and the "
+         "last N health/retry/cancel events are retained per query "
+         "(oldest evicted first) and shipped in the black box.")
+    .category("observability")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(256)
+)
+
+ATTRIBUTION_CLOSE_TOLERANCE = (
+    conf("spark.rapids.tpu.attribution.closeTolerance")
+    .doc("Fraction of end-to-end wall time the unaccounted remainder may "
+         "reach before the attribution is reported as NOT CLOSED (the "
+         "gap is always reported either way, never absorbed into "
+         "another bucket).")
+    .category("observability")
+    .double()
+    .check(lambda v: 0.0 < v <= 1.0, "in (0, 1]")
+    .create_with_default(0.10)
+)
+
+ATTRIBUTION_BLACKBOX_PATH = (
+    conf("spark.rapids.tpu.attribution.blackboxPath")
+    .doc("Directory for flight-recorder dumps "
+         "(query-<id>.blackbox.json, written atomically via "
+         "tmp+rename). Empty disables dumping.")
+    .category("observability")
+    .string()
+    .create_with_default("/tmp/tpuq-blackbox")
+)
+
+ATTRIBUTION_BLACKBOX_MAX = (
+    conf("spark.rapids.tpu.attribution.blackboxMaxDumps")
+    .doc("Cap on black-box files kept in blackboxPath; when a new dump "
+         "would exceed it the oldest files are evicted first.")
+    .category("observability")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(64)
+)
+
 QUERY_TIMEOUT_MS = (
     conf("spark.rapids.tpu.query.timeoutMs")
     .doc("Per-query deadline in milliseconds, enforced in-process by "

@@ -163,6 +163,15 @@ class CancelToken:
         if first:
             _TM_CANCELLED.inc(self.reason or "user")
             _TM_LATENCY.observe(self.latency_s)
+            # flight recorder: the first observation of the fired token
+            # is the moment the cancel became effective for the query
+            from spark_rapids_tpu.runtime import attribution
+            attribution.record_event("cancel", {
+                "reason": self.reason or "user",
+                "query_id": self.query_id,
+                "detail": self.detail,
+                "latency_s": round(self.latency_s or 0.0, 6),
+            })
         raise QueryCancelled(self.reason or "user", self.query_id,
                              self.detail)
 

@@ -39,6 +39,7 @@ from spark_rapids_tpu.columnar.column import DeviceBatch, DeviceColumn
 from spark_rapids_tpu.runtime import cancel
 from spark_rapids_tpu.runtime import resilience as R
 from spark_rapids_tpu.runtime import telemetry as TM
+from spark_rapids_tpu.runtime import trace
 
 # process-cumulative counters (per-manager views live in mgr.metrics);
 # gauges pull the CURRENT manager's state at snapshot time
@@ -177,6 +178,10 @@ class SpillableBatch:
         """Device → host.  Returns bytes freed on device."""
         if self._batch is None:
             return 0
+        with trace.span("Spill", "spillTime"):
+            return self._spill_to_host()
+
+    def _spill_to_host(self) -> int:
         import jax
         b = self._batch
         leaves, treedef = jax.tree.flatten(b)
@@ -200,6 +205,10 @@ class SpillableBatch:
         stays in the host tier, marked so the eviction loop skips it)."""
         if self._host is None:
             return 0
+        with trace.span("Spill", "spillTime"):
+            return self._spill_to_disk()
+
+    def _spill_to_disk(self) -> int:
         leaves, treedef = self._host
         os.makedirs(self._mgr.spill_path, exist_ok=True)
         path = os.path.join(self._mgr.spill_path,
@@ -237,6 +246,10 @@ class SpillableBatch:
         """Restore (if needed) and return the device batch."""
         if self._batch is not None:
             return self._batch
+        with trace.span("Spill", "restoreTime"):
+            return self._restore()
+
+    def _restore(self) -> DeviceBatch:
         import jax
         from_host = self._host is not None
         if not from_host and self._disk_path is not None:

@@ -431,6 +431,10 @@ def note_retry(domain: str) -> None:
     budget.  Also the hook ``with_retry`` (alloc/OOM rollback) calls so
     every retry in the engine lands in one place."""
     _TM_RETRY.inc(domain)
+    # flight recorder: a retry burst right before a timeout is exactly
+    # the evidence the black box exists to preserve
+    from spark_rapids_tpu.runtime import attribution
+    attribution.record_event("retry", {"domain": domain})
     with _STATE.lock:
         _STATE.retries_used += 1
         _STATE.retries_by_domain[domain] = (

@@ -81,10 +81,12 @@ class DeviceSemaphore:
         wakeup and re-blocking is not wait (the old single start/stop
         stamp inflated ``semaphoreWaitTime`` under contention)."""
         from spark_rapids_tpu.runtime import cancel
+        from spark_rapids_tpu.runtime import trace
         waited = 0.0
         tok = cancel.current()
         registered = False
         blocked = False
+        wait_span = None
         try:
             with self._cv:
                 try:
@@ -92,6 +94,14 @@ class DeviceSemaphore:
                         if not blocked:
                             blocked = True
                             self.waiting += 1
+                            # attribution: the blocked path (and only
+                            # it) opens a span so the wait lands in the
+                            # semaphore_wait bucket on the timeline —
+                            # the uncontended acquire stays span-free
+                            wait_tr = trace.current()
+                            if wait_tr is not None:
+                                wait_span = wait_tr.begin(
+                                    "DeviceSemaphore", "semaphoreWait")
                         if tok is not None:
                             tok.check()
                             if not registered:
@@ -109,6 +119,8 @@ class DeviceSemaphore:
                 finally:
                     if blocked:
                         self.waiting -= 1
+                    if wait_span is not None:
+                        wait_tr.end(wait_span)
                 self.holders += 1
                 self.max_holders = max(self.max_holders, self.holders)
                 self.peak_holders = max(self.peak_holders, self.holders)

@@ -339,6 +339,11 @@ class MetricsRegistry:
             self._health.append(event)
             if len(self._health) > self.HEALTH_CAP:
                 del self._health[:-self.HEALTH_CAP]
+        # flight recorder (runtime/attribution.py): health verdicts
+        # join the active query's black-box ring.  Lazy import —
+        # attribution imports this module at its top level.
+        from spark_rapids_tpu.runtime import attribution
+        attribution.record_event("health", dict(event))
 
     def recent_health(self) -> List[dict]:
         with self._lock:
@@ -361,7 +366,7 @@ def ensure_producers() -> None:
     would otherwise miss the shuffle family)."""
     import importlib
     for mod in ("runtime.cancel", "runtime.memory", "runtime.semaphore",
-                "runtime.scheduler",
+                "runtime.scheduler", "runtime.attribution",
                 "runtime.kernel_cache", "runtime.resilience",
                 "runtime.lockdep", "runtime.shapes", "adaptive",
                 "shuffle.manager", "shuffle.exchange",

@@ -105,6 +105,10 @@ class Tracer:
         self.wall_s: Optional[float] = None
         self.dropped = 0
         self.events: List[Span] = []
+        # duck-typed flight-recorder hook (runtime/attribution.py):
+        # when set, every closed span also lands in the recorder's
+        # bounded ring — one extra deque append, no new timers
+        self.recorder = None
         self._lock = threading.Lock()
         self._tls = threading.local()
 
@@ -140,6 +144,9 @@ class Tracer:
                 self.events.append(span)
             else:
                 self.dropped += 1
+        rec = self.recorder
+        if rec is not None:
+            rec.record_span(span)
 
     def span(self, op: str, stage: str, args: Optional[dict] = None):
         """Context manager recording one span."""

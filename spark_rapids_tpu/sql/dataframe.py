@@ -834,10 +834,22 @@ class DataFrame:
         rwin = resilience.begin_query(qid)
         cwin = cancel_mod.begin_query(qid, conf, timeout_ms=timeout_ms,
                                       token=cancel_token)
+        # the attribution plane rides the tracer: when attribution is on
+        # (the default) the tracer runs even with trace.enabled off, but
+        # _record_query only emits the rollup/chrome-trace artifacts the
+        # user asked for — the spans feed the ledger + flight recorder
+        from spark_rapids_tpu.runtime import attribution as attr_mod
+        attr_on = bool(conf.get(C.ATTRIBUTION_ENABLED))
         tracer = None
-        if conf.get(C.TRACE_ENABLED):
+        if conf.get(C.TRACE_ENABLED) or attr_on:
             tracer = trace.start_query(
                 qid, max_events=int(conf.get(C.QUERY_LOG_MAX_EVENTS)))
+        arec = None
+        if attr_on:
+            arec = attr_mod.start_query(
+                qid, ring_size=int(conf.get(C.ATTRIBUTION_RING_SIZE)))
+            if tracer is not None and arec is not None:
+                tracer.recorder = arec
         collector = None
         if conf.get(C.STATS_ENABLED):
             collector = stats_mod.start_query(
@@ -865,20 +877,22 @@ class DataFrame:
             with profile, root:
                 served = None
                 if cache_store is not None:
-                    served = cache_store.lookup(ckey.key)
-                    if served is None:
-                        role, fl = cache_store.join_flight(ckey.key)
-                        if role == "leader":
-                            flight = fl
-                        else:
-                            # another execution of this exact key is in
-                            # progress — wait for it, then re-probe;
-                            # compute ourselves if it failed or skipped
-                            while not fl.done.wait(0.05):
-                                cancel_mod.check()
-                            served = cache_store.lookup(ckey.key)
-                            if served is not None:
-                                cache_info = {"coalesced": True}
+                    with trace.span("ResultCache", "cacheProbe"):
+                        served = cache_store.lookup(ckey.key)
+                        if served is None:
+                            role, fl = cache_store.join_flight(ckey.key)
+                            if role == "leader":
+                                flight = fl
+                            else:
+                                # another execution of this exact key is
+                                # in progress — wait for it, then
+                                # re-probe; compute ourselves if it
+                                # failed or skipped
+                                while not fl.done.wait(0.05):
+                                    cancel_mod.check()
+                                served = cache_store.lookup(ckey.key)
+                                if served is not None:
+                                    cache_info = {"coalesced": True}
                 if served is not None:
                     out = served.value
                     cache_info = {
@@ -892,18 +906,21 @@ class DataFrame:
                 else:
                     t_exec = _time.perf_counter()
                     tables = self._pump_partitions(plan, conf)
-                    if not tables:
-                        out = self._reassemble_structs(pa.table(
-                            {f.name: pa.array([], type=T.to_arrow(f.dtype))
-                             for f in self.schema.fields}))
-                    else:
-                        out = self._reassemble_structs(
-                            pa.concat_tables(tables))
+                    with trace.span("Result", "concatTime"):
+                        if not tables:
+                            out = self._reassemble_structs(pa.table(
+                                {f.name: pa.array(
+                                    [], type=T.to_arrow(f.dtype))
+                                 for f in self.schema.fields}))
+                        else:
+                            out = self._reassemble_structs(
+                                pa.concat_tables(tables))
                     if cache_store is not None:
                         runtime_s = _time.perf_counter() - t_exec
                         cache_store.note_miss()
-                        stored = cache_store.put(
-                            ckey, out, out.nbytes, runtime_s)
+                        with trace.span("ResultCache", "cacheServe"):
+                            stored = cache_store.put(
+                                ckey, out, out.nbytes, runtime_s)
                         cache_info = {
                             "key": ckey.key, "signature": ckey.sig,
                             "bytes": out.nbytes,
@@ -930,16 +947,17 @@ class DataFrame:
                 cache_store.finish_flight(ckey.key, flight)
             trace.end_query(tracer)
             stats_mod.end_query(collector)
+            attr_mod.end_query(arec)
             cancel_mod.finish_query(cwin)
             self._record_query(qid, tracer, conf, profile_dir, error,
                                qwin, rwin, cancelled=cancelled,
                                ctoken=cwin, collector=collector,
-                               cache_info=cache_info)
+                               cache_info=cache_info, recorder=arec)
         return out
 
     def _record_query(self, qid, tracer, conf, profile_dir, error,
                       qwin=None, rwin=None, cancelled=None, ctoken=None,
-                      collector=None, cache_info=None):
+                      collector=None, cache_info=None, recorder=None):
         """One event-log entry per execution: plan tree, device/fallback
         report, all metrics at their levels, span rollup, artifact
         cross-links — the reference's driver-log plan-conversion report,
@@ -974,7 +992,10 @@ class DataFrame:
         if override is not None:
             entry["fallback"] = override.fallback_summary()
             entry["fallback_report"] = override.fallback_report()
-        if tracer is not None:
+        if tracer is not None and conf.get(C.TRACE_ENABLED):
+            # tracing artifacts only when the user asked for tracing —
+            # an attribution-only tracer feeds the ledger below but
+            # must not start emitting rollups/chrome traces
             entry["wall_s"] = round(tracer.wall_s, 6)
             rollup = tracer.rollup()
             entry["op_rollup"] = rollup
@@ -984,6 +1005,14 @@ class DataFrame:
                 str(conf.get(C.TRACE_PATH)), tracer)
             if tf:
                 entry["trace_file"] = tf
+        attribution = None
+        if tracer is not None and conf.get(C.ATTRIBUTION_ENABLED):
+            from spark_rapids_tpu.runtime import attribution as attr_mod
+            attribution = attr_mod.attribute(
+                tracer, tolerance=float(
+                    conf.get(C.ATTRIBUTION_CLOSE_TOLERANCE)))
+            entry["attribution"] = attribution
+            attr_mod.note_unaccounted(attribution["unaccounted_s"])
         if profile_dir:
             entry["profile_dir"] = profile_dir
         lore = str(conf.get(C.LORE_TAG))
@@ -1037,6 +1066,8 @@ class DataFrame:
                 wall_s=entry.get("wall_s"))
             profile["ts"] = entry["ts"]
             profile["status"] = entry["status"]
+            if attribution is not None:
+                profile["attribution"] = attribution
             entry["op_stats"] = profile["ops"]
             if profile["exchanges"]:
                 entry["exchange_stats"] = profile["exchanges"]
@@ -1048,6 +1079,31 @@ class DataFrame:
             store = str(conf.get(C.STATS_STORE_PATH))
             if store:
                 stats_mod.append_profile(store, profile)
+        if recorder is not None:
+            # bad exit -> leave the black box: the ring + ledger survive
+            # the query that died.  Triggers: deadline kill, explicit
+            # cancel, error, or a health WARN on an otherwise-ok run.
+            trigger = None
+            if cancelled is not None:
+                trigger = ("timeout" if cancelled.reason == "deadline"
+                           else "cancel")
+            elif error:
+                trigger = "error"
+            elif entry.get("health"):
+                trigger = "health"
+            bb_dir = str(conf.get(C.ATTRIBUTION_BLACKBOX_PATH))
+            if trigger is not None and bb_dir:
+                from spark_rapids_tpu.runtime import (
+                    attribution as attr_mod)
+                extra = {k: entry[k] for k in
+                         ("status", "error", "cancel", "health")
+                         if entry.get(k)}
+                path = attr_mod.dump_blackbox(
+                    bb_dir, qid, trigger, attribution=attribution,
+                    recorder=recorder, extra=extra,
+                    max_dumps=int(conf.get(C.ATTRIBUTION_BLACKBOX_MAX)))
+                if path:
+                    entry["blackbox"] = path
         self._last_query_entry = entry
         self.session._record_query(entry)
         log_path = str(conf.get(C.QUERY_LOG_PATH))
@@ -1112,8 +1168,16 @@ class DataFrame:
         nparts = plan.num_partitions()
         on_device = has_device_work(plan)
 
+        from spark_rapids_tpu.runtime import trace as trace_mod
+
         def pump(p: int) -> List[pa.Table]:
-            return [H.to_arrow_table(b) for b in plan.execute(p)]
+            # per-partition envelope span: charges iterator plumbing +
+            # the root arrow conversion (time between instrumented
+            # stages) to the pump_idle bucket — a no-op when neither
+            # tracing nor attribution is active
+            with trace_mod.span("PumpTask", "pumpTask",
+                                {"partition": p}):
+                return [H.to_arrow_table(b) for b in plan.execute(p)]
 
         if not on_device:
             out = []

@@ -212,6 +212,12 @@ class QueryServer:
             handle.state = OK
         except cancel.QueryCancelled as e:
             handle.error = e
+            if handle.state == QUEUED:
+                # died while still queued for a run slot — toArrow
+                # never ran, so the dataframe-side black-box hook never
+                # fired; leave a queue-side box where the entire wall
+                # is queue wait
+                self._dump_queued_blackbox(handle, e, t0)
             handle.state = CANCELLED
         except BaseException as e:
             handle.error = e
@@ -223,6 +229,34 @@ class QueryServer:
             with self._lock:
                 self._handles.pop(handle.query_id, None)
             handle.done.set()
+
+    def _dump_queued_blackbox(self, handle: QueryHandle, exc,
+                              t0: float) -> None:
+        """Black box for a query killed before admission (deadline or
+        cancel fired while QUEUED): no tracer ever ran, so the ledger
+        is built from the one fact the server owns — the whole wall
+        was queue wait."""
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import attribution
+        conf = self.session.rapids_conf()
+        if not conf.get(C.ATTRIBUTION_ENABLED):
+            return
+        bb_dir = str(conf.get(C.ATTRIBUTION_BLACKBOX_PATH))
+        if not bb_dir:
+            return
+        waited = time.monotonic() - t0
+        att = attribution.attribute(
+            spans=(), e2e_s=0.0,
+            tolerance=float(conf.get(C.ATTRIBUTION_CLOSE_TOLERANCE)),
+            extras={"queue_wait": waited})
+        trigger = ("timeout" if getattr(exc, "reason", "") == "deadline"
+                   else "cancel")
+        attribution.dump_blackbox(
+            bb_dir, handle.query_id, trigger, attribution=att,
+            extra={"status": "cancelled", "tenant": handle.tenant,
+                   "cancel": {"reason": getattr(exc, "reason", "user"),
+                              "while": "QUEUED"}},
+            max_dumps=int(conf.get(C.ATTRIBUTION_BLACKBOX_MAX)))
 
     # -- observation -------------------------------------------------------
 

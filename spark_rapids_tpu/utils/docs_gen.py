@@ -93,6 +93,21 @@ def check_stats_documented(doc_path: str = None) -> list:
     return sorted(collect_stats_fields() - _documented_names(doc_path))
 
 
+def collect_attribution_buckets() -> set:
+    """Every attribution-ledger bucket.  The ``BUCKETS`` catalog dict
+    in runtime/attribution.py IS the registry — the ledger fold, the
+    bucket-accounting lint rule, and this check all read it."""
+    from spark_rapids_tpu.runtime.attribution import BUCKETS
+    return set(BUCKETS)
+
+
+def check_attribution_documented(doc_path: str = None) -> list:
+    """Attribution buckets missing from docs/observability.md — the
+    tier-1 drift check's attribution-plane arm."""
+    return sorted(collect_attribution_buckets()
+                  - _documented_names(doc_path))
+
+
 def check_blocking_waits_cancellable(pkg_dir: str = None) -> list:
     """Blocking waits in runtime/ and parallel/ that the cancellation
     layer cannot interrupt — enforced in tier-1 so no new unbounded
@@ -277,6 +292,10 @@ def main(out_dir: str = "docs"):
         if missing_st:
             print(f"UNDOCUMENTED stats fields (add to {obs}): "
                   f"{missing_st}")
+        missing_att = check_attribution_documented(obs)
+        if missing_att:
+            print(f"UNDOCUMENTED attribution buckets (add to {obs}): "
+                  f"{missing_att}")
     from spark_rapids_tpu.utils.lint import run_lint
     findings = run_lint()
     for f in findings:

@@ -71,6 +71,14 @@ Rules (catalog in docs/static_analysis.md):
                       changing a registered input without re-minting
                       its digest is exactly the bug that serves stale
                       cached results
+``bucket-accounting`` every string-literal stage at a
+                      ``.timer("<stage>")`` or
+                      ``.begin/.span(op, "<stage>")`` site must map to
+                      a declared attribution bucket
+                      (runtime/attribution.py STAGE_BUCKETS) — an
+                      unmapped stage silently grows the per-query
+                      ``unaccounted`` gap until the time books stop
+                      closing
 
 A deliberate violation carries a same-line or preceding-line
 annotation::
@@ -79,8 +87,9 @@ annotation::
 
 The reason is mandatory — an empty reason is itself a finding.  The
 legacy ``# cancel-exempt: <why>`` annotation is honored as an alias
-for ``exempt(blocking-wait)``, and ``# jit-exempt: <why>`` as an alias
-for ``exempt(raw-jit)``.
+for ``exempt(blocking-wait)``, ``# jit-exempt: <why>`` as an alias
+for ``exempt(raw-jit)``, and ``# attribution-exempt: <why>`` as an
+alias for ``exempt(bucket-accounting)``.
 """
 
 from __future__ import annotations
@@ -100,6 +109,9 @@ EXEMPT_RE = re.compile(
 CANCEL_EXEMPT_RE = re.compile(r"#\s*cancel-exempt\s*(?::\s*(.*))?")
 # raw-jit's domain-specific spelling (mirrors cancel-exempt)
 JIT_EXEMPT_RE = re.compile(r"#\s*jit-exempt\s*(?::\s*(.*))?")
+# bucket-accounting's domain-specific spelling
+ATTRIBUTION_EXEMPT_RE = re.compile(
+    r"#\s*attribution-exempt\s*(?::\s*(.*))?")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -161,6 +173,16 @@ class SourceModule:
                         "jit-exempt without a reason — write "
                         "'# jit-exempt: <why>'"))
                 self.exemptions[i] = ({"raw-jit"}, reason)
+                continue
+            m = ATTRIBUTION_EXEMPT_RE.search(ln)
+            if m:
+                reason = (m.group(1) or "").strip()
+                if not reason:
+                    self._bad_exemptions.append(Finding(
+                        "exemption", rel, i,
+                        "attribution-exempt without a reason — write "
+                        "'# attribution-exempt: <why>'"))
+                self.exemptions[i] = ({"bucket-accounting"}, reason)
 
     def _comments(self):
         """(line, comment_text) for real COMMENT tokens only — an
@@ -227,6 +249,8 @@ def all_rules() -> List[Rule]:
     from spark_rapids_tpu.utils.lint.adaptive_purity import (
         AdaptivePurityRule)
     from spark_rapids_tpu.utils.lint.blocking_wait import BlockingWaitRule
+    from spark_rapids_tpu.utils.lint.bucket_accounting import (
+        BucketAccountingRule)
     from spark_rapids_tpu.utils.lint.cache_safety import CacheSafetyRule
     from spark_rapids_tpu.utils.lint.conf_drift import ConfDriftRule
     from spark_rapids_tpu.utils.lint.exchange_purity import (
@@ -245,7 +269,7 @@ def all_rules() -> List[Rule]:
             HostSyncInJitRule(), BlockingWaitRule(), OpStatsRule(),
             SchedulerBypassRule(), RawJitRule(), ExchangePurityRule(),
             KernelPurityRule(), AdaptivePurityRule(), CacheSafetyRule(),
-            FusionPurityRule()]
+            FusionPurityRule(), BucketAccountingRule()]
 
 
 def run_lint(pkg_dir: Optional[str] = None,

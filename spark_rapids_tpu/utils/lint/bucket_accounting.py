@@ -1,0 +1,87 @@
+"""bucket-accounting rule: every stage label lands in a declared
+attribution bucket.
+
+The attribution ledger (runtime/attribution.py) can only close the
+per-query time books if every span the engine emits maps to one of the
+declared buckets — a new ``MetricTimer`` stage name or
+``tracer.begin``/``trace.span`` stage label that ``STAGE_BUCKETS``
+doesn't know about silently grows the ``unaccounted`` gap until the
+closure check fails in production.  This rule moves that failure to
+lint time: it collects every string-literal stage at
+
+- ``.timer("<stage>")`` call sites (the ``MetricTimer`` pairing — the
+  no-arg form defaults to ``opTime``, which maps), and
+- the second argument of ``.begin(op, "<stage>")`` /
+  ``.span(op, "<stage>")`` call sites,
+
+across the engine (``utils/`` excluded — the toolchain talks *about*
+stages) and fails any stage missing from
+``attribution.STAGE_BUCKETS``.  A deliberately unbucketed stage
+carries::
+
+    # attribution-exempt: <why>
+
+(or the generic ``# lint: exempt(bucket-accounting): <why>``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
+
+# paths never scanned: the lint/docs toolchain mentions stage names in
+# catalogs and fixtures, not as live span sites
+SKIP_PREFIXES = (
+    "spark_rapids_tpu/utils/",
+)
+
+
+def _stage_literal(node: ast.Call) -> tuple:
+    """(stage, is_stage_site) for one call node."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None, False
+    if func.attr == "timer":
+        if not node.args:
+            return "opTime", True  # the .timer() default
+        a = node.args[0]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value, True
+        return None, False  # dynamic stage — not statically checkable
+    if func.attr in ("begin", "span") and len(node.args) >= 2:
+        op, stage = node.args[0], node.args[1]
+        # only op+stage string-literal pairs are span sites — keeps
+        # str.span()/re matches and forwarding wrappers out
+        if (isinstance(op, ast.Constant) and isinstance(op.value, str)
+                and isinstance(stage, ast.Constant)
+                and isinstance(stage.value, str)):
+            return stage.value, True
+    return None, False
+
+
+class BucketAccountingRule(Rule):
+    name = "bucket-accounting"
+
+    def check(self, mod: SourceModule) -> Iterable[Finding]:
+        rel = mod.rel.replace("\\", "/")
+        if any(rel.startswith(p) for p in SKIP_PREFIXES):
+            return
+        from spark_rapids_tpu.runtime.attribution import STAGE_BUCKETS
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            stage, is_site = _stage_literal(node)
+            if not is_site or stage is None:
+                continue
+            if stage in STAGE_BUCKETS:
+                continue
+            yield Finding(
+                self.name, mod.rel, node.lineno,
+                f"stage '{stage}' is not mapped to an attribution "
+                "bucket — add it to "
+                "runtime/attribution.py:STAGE_BUCKETS (and the bucket "
+                "to BUCKETS/docs if new) so the per-query time books "
+                "still close, or annotate the site with "
+                "'# attribution-exempt: <why>'")

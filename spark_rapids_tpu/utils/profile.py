@@ -15,16 +15,27 @@ per file:
 * **bench scoreboard** (``BENCH_*.json``): one JSON object whose
   ``tpch_sf1_op_rollup``/``tpch_sf1_stats`` maps key per-op records by
   query name, plus the ``tpch_sf1_compile`` cold-vs-warm compile split
-  the ``storms`` report reads.
+  the ``storms`` report reads;
+* **black box** (``query-<id>.blackbox.json``): a single flight-
+  recorder dump left by a query that died (timeout/cancel/error) —
+  ``why`` renders its ledger, verdict and final ring events.
 
 Usage::
 
     python -m spark_rapids_tpu.utils.profile top    <input> [--n N]
         [--adaptive] [--cache]
+    python -m spark_rapids_tpu.utils.profile why    <input>
+        [--query Q]
     python -m spark_rapids_tpu.utils.profile skew   <input>
     python -m spark_rapids_tpu.utils.profile storms <input>
     python -m spark_rapids_tpu.utils.profile diff   <a> <b>
         [--threshold R] [--min-self-s S]
+
+``why`` answers "where did this query's wall time go": the attribution
+plane's exclusive bucket ledger rendered as a ranked table with the
+one-line verdict ("exchange-bound: 71% of 23.3 s in
+exchange_collective"), over any of the four inputs — and for a
+timed-out query, the black box's last spans and cancel/health events.
 
 ``top --adaptive`` additionally lists each query's adaptive-plane
 decisions (broadcast/shuffled/skew-split/batch-retarget) with the
@@ -70,7 +81,10 @@ def _load_json_lines(path: str) -> List[dict]:
 
 
 def detect_kind(records: List[dict]) -> str:
-    """profile-store | event-log | bench, from record shape alone."""
+    """profile-store | event-log | bench | blackbox, from record shape
+    alone."""
+    if any(r.get("record") == "blackbox" for r in records):
+        return "blackbox"
     if len(records) == 1 and ("tpch_sf1_op_rollup" in records[0]
                               or "tpch_sf1_stats" in records[0]
                               or "metric" in records[0]):
@@ -81,7 +95,8 @@ def detect_kind(records: List[dict]) -> str:
            for r in records):
         return "event-log"
     raise ValueError("unrecognized input: neither a profile store, a "
-                     "query event log, nor a BENCH_*.json scoreboard")
+                     "query event log, a BENCH_*.json scoreboard, nor "
+                     "a query-*.blackbox.json dump")
 
 
 def _op_key(rec: dict) -> str:
@@ -120,12 +135,26 @@ def load_runs(path: str) -> List[dict]:
         raise ValueError(f"{path}: no records")
     kind = detect_kind(records)
     runs: List[dict] = []
+    if kind == "blackbox":
+        for r in records:
+            if r.get("record") != "blackbox":
+                continue
+            runs.append({"label": f"query {r.get('query_id')}",
+                         "ops": {}, "exchanges": [], "compiles": None,
+                         "wall_s": None, "decisions": [],
+                         "attribution": r.get("attribution"),
+                         "blackbox": r,
+                         "status": r.get("status")})
+        return runs
     if kind == "bench":
         b = records[0]
         rollups = b.get("tpch_sf1_op_rollup") or {}
         statses = b.get("tpch_sf1_stats") or {}
         compile_recs = b.get("tpch_sf1_compile") or {}
-        for q in sorted(set(rollups) | set(statses) | set(compile_recs)):
+        atts = b.get("tpch_sf1_attribution") or {}
+        boxes = b.get("tpch_sf1_blackbox") or {}
+        for q in sorted(set(rollups) | set(statses) | set(compile_recs)
+                        | set(atts) | set(boxes)):
             ops: Dict[str, dict] = {}
             for op, r in (rollups.get(q) or {}).items():
                 ops[f"{q}/{op}"] = {"op": op, "sig": None,
@@ -139,7 +168,9 @@ def load_runs(path: str) -> List[dict]:
                          "exchanges": (st.get("exchanges") or []),
                          "compiles": (crec or {}).get("cold_compiles"),
                          "compile_rec": crec, "wall_s": None,
-                         "decisions": st.get("adaptive_decisions") or []})
+                         "decisions": st.get("adaptive_decisions") or [],
+                         "attribution": atts.get(q),
+                         "blackbox": boxes.get(q)})
         return runs
     for r in records:
         if kind == "profile-store":
@@ -151,7 +182,8 @@ def load_runs(path: str) -> List[dict]:
                          "exchanges": r.get("exchanges") or [],
                          "compiles": None,
                          "wall_s": r.get("wall_s"),
-                         "decisions": r.get("adaptive_decisions") or []})
+                         "decisions": r.get("adaptive_decisions") or [],
+                         "attribution": r.get("attribution")})
             continue
         # event log: prefer the stats plane's op_stats, fall back to
         # the trace rollup alone
@@ -174,7 +206,10 @@ def load_runs(path: str) -> List[dict]:
                      "wall_s": r.get("wall_s"),
                      "health": r.get("health") or [],
                      "decisions": r.get("adaptive_decisions") or [],
-                     "cache": r.get("cache")})
+                     "cache": r.get("cache"),
+                     "attribution": r.get("attribution"),
+                     "blackbox_file": r.get("blackbox"),
+                     "status": r.get("status")})
     return runs
 
 
@@ -306,6 +341,60 @@ def report_cache(runs: List[dict]) -> List[str]:
                      f"bytes_saved={s['bytes_saved']} "
                      f"device_s_avoided={s['device_s_avoided']:.3f}")
     return lines
+
+
+def report_why(runs: List[dict],
+               query: Optional[str] = None) -> Optional[List[str]]:
+    """The attribution verdict per run: a ranked exclusive-bucket table
+    under the one-line diagnosis, the black box's last ring events for
+    a query that died.  ``query`` filters by run label substring.
+    Returns None when no run in the input carries attribution (the
+    caller exits EXIT_BAD_INPUT)."""
+    lines: List[str] = []
+    found = False
+    for run in runs:
+        if query is not None and query not in str(run["label"]):
+            continue
+        att = run.get("attribution")
+        box = run.get("blackbox")
+        if not isinstance(att, dict) and isinstance(box, dict):
+            att = box.get("attribution")  # a query that died mid-flight
+        if not isinstance(att, dict):
+            continue
+        found = True
+        status = run.get("status")
+        tag = f" [{status}]" if status and status != "ok" else ""
+        lines.append(f"{run['label']}{tag}: {att.get('verdict')}")
+        e2e = float(att.get("e2e_s") or 0.0)
+        ranked = sorted((att.get("buckets") or {}).items(),
+                        key=lambda kv: -float(kv[1] or 0.0))
+        for b, s in ranked:
+            s = float(s or 0.0)
+            if s <= 0.0:
+                continue
+            share = s / e2e if e2e > 0 else 0.0
+            lines.append(f"    {b:<20} {s:>10.3f} s  {share:>6.1%}")
+        if not att.get("closed", True):
+            lines.append(
+                f"    NOT CLOSED: {att.get('unaccounted_s')} s "
+                f"unaccounted exceeds the "
+                f"{float(att.get('tolerance') or 0):.0%} tolerance")
+        if isinstance(box, dict):
+            lines.append(f"    black box: trigger={box.get('trigger')}")
+            fr = box.get("flight_recorder") or {}
+            for ev in list(fr.get("events") or [])[-5:]:
+                rest = ", ".join(f"{k}={v}" for k, v in ev.items()
+                                 if k not in ("kind", "t_s"))
+                lines.append(f"      event {ev.get('kind')} "
+                             f"@{ev.get('t_s')}s  {rest}")
+            spans = list(fr.get("recent_spans") or [])
+            if spans:
+                lines.append("      last spans: " + ", ".join(
+                    f"{sp.get('op')}:{sp.get('stage')}"
+                    for sp in spans[-5:]))
+        elif run.get("blackbox_file"):
+            lines.append(f"    black box: {run['blackbox_file']}")
+    return lines if found else None
 
 
 def _join_decisions(runs: List[dict]) -> Dict[str, str]:
@@ -448,10 +537,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                     "stores, query event logs, and bench scoreboards")
     sub = p.add_subparsers(dest="cmd", required=True)
     for name, help_ in (("top", "slowest ops by traced self time"),
+                        ("why", "attribution verdict: where the wall "
+                                "time went, per query"),
                         ("skew", "exchange partition-skew report"),
                         ("storms", "kernel compile-storm report")):
         sp = sub.add_parser(name, help=help_)
         sp.add_argument("input")
+        if name == "why":
+            sp.add_argument("--query", default=None,
+                            help="filter runs by label substring "
+                                 "(e.g. 'q3' or a query id)")
         if name == "top":
             sp.add_argument("--n", type=int, default=10)
             sp.add_argument("--adaptive", action="store_true",
@@ -486,6 +581,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             print("\n".join(report_adaptive(runs)))
         if args.cache:
             print("\n".join(report_cache(runs)))
+        return EXIT_OK
+    if args.cmd == "why":
+        lines = report_why(load(args.input), query=args.query)
+        if lines is None:
+            print("error: no attribution records in this input — run "
+                  "with spark.rapids.tpu.attribution.enabled (default "
+                  "on), or point at a query-*.blackbox.json",
+                  file=sys.stderr)
+            return EXIT_BAD_INPUT
+        print("\n".join(lines))
         return EXIT_OK
     if args.cmd == "skew":
         print("\n".join(report_skew(load(args.input))))

@@ -82,15 +82,18 @@ def pytest_configure(config):
 
 
 def pytest_collection_modifyitems(config, items):
-    # The kernel backend-identity matrix and the adaptive-plane
-    # bit-identity matrix are the newest and most compile-heavy modules
-    # in the suite (test_adaptive would otherwise run FIRST
+    # The kernel backend-identity matrix, the adaptive-plane
+    # bit-identity matrix, and the attribution-plane closure tests are
+    # the newest and most compile-heavy modules in the suite
+    # (test_adaptive/test_attribution would otherwise run FIRST
     # alphabetically).  Tier-1 runs under a hard wall-clock budget (see
     # ROADMAP.md), so keep the long-established regression signal in
     # front and let the newest matrices run last — a harness-level
     # timeout then cuts into the newest tests first instead of
     # displacing the seed suite past the horizon.
+    late = ("test_attribution.py", "test_adaptive.py", "test_kernels.py")
     items.sort(key=lambda it: (
+        it.fspath.basename in late,
         it.fspath.basename in ("test_adaptive.py", "test_kernels.py"),
         it.fspath.basename == "test_kernels.py"))
 
