@@ -1924,6 +1924,103 @@ def result_cache_soak_bench(mark, budget_s: float):
     return None
 
 
+def _tenancy_soak_main() -> None:
+    """Child-process entry: the sustained preemptive-tenancy soak.
+
+    Keeps 64 submissions outstanding across four tenants (two hot —
+    result-cache-hit q6 variants — one cold with unique filter
+    literals, one high-priority urgent lane) for a sustained window
+    with preemption armed and per-tenant HBM shares enforced, then
+    prints one ``TENANCY_SOAK=<json>`` line: per-tenant p50/p99
+    submit→done latency, preempt request/suspend/resume counts,
+    HBM-budget breaches, and the zero-leak / zero-deadlock /
+    ledgers-closed verdicts from ``run_tenancy_soak``."""
+    from spark_rapids_tpu.utils.harness import run_tenancy_soak
+
+    sf = float(os.environ.get("TPUQ_BENCH_TENANCY_SF", "0.1"))
+    duration = float(os.environ.get("TPUQ_BENCH_TENANCY_DURATION_S",
+                                    "30"))
+    in_flight = int(os.environ.get("TPUQ_BENCH_TENANCY_INFLIGHT", "64"))
+    t = gen_tpch(sf)
+    conf = dict(TPCH_SF1_CONF)
+    conf.update({
+        "spark.rapids.tpu.cache.enabled": True,
+        "spark.rapids.tpu.cache.maxBytes": "64m",
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 4,
+        "spark.rapids.tpu.scheduler.maxQueuedQueries": 256,
+        "spark.rapids.tpu.scheduler.shed.queueDepth": 256,
+        "spark.rapids.tpu.scheduler.tenantMaxQueued": 128,
+        "spark.rapids.tpu.scheduler.tenantMaxInFlight": 4,
+        "spark.rapids.tpu.scheduler.preempt.enabled": True,
+        "spark.rapids.tpu.scheduler.preempt.graceMs": 100,
+        "spark.rapids.tpu.scheduler.preempt.minRunMs": 50,
+        # hot tenants get a modest HBM share so sustained load
+        # exercises the per-tenant budget path, not just fairness
+        "spark.rapids.tpu.scheduler.tenant.hot_a.hbmShare": 0.5,
+        "spark.rapids.tpu.scheduler.tenant.hot_b.hbmShare": 0.5,
+    })
+    from spark_rapids_tpu.sql import functions as F
+    from spark_rapids_tpu.sql.column import col
+
+    def q6_variant(session, quantity):
+        return (_t(session, t, "lineitem", "l_shipdate", "l_discount",
+                   "l_quantity", "l_extendedprice")
+                .filter((col("l_shipdate") >= _D(1994, 1, 1))
+                        & (col("l_shipdate") < _D(1995, 1, 1))
+                        & (col("l_discount") >= 0.05)
+                        & (col("l_discount") <= 0.07)
+                        & (col("l_quantity") < float(quantity)))
+                .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                     .alias("revenue")))
+
+    HOT = {"hot_a": 24, "hot_b": 36}
+
+    def make_query(session, name, spec, rnd, i):
+        qty = HOT.get(name, 1000 + i if not spec.get("hot") else 30)
+        return lambda: q6_variant(session, qty)
+
+    tenants = {
+        "hot_a": {"priority": 0, "hot": True},
+        "hot_b": {"priority": 0, "hot": True},
+        "cold": {"priority": 0, "hot": False},
+        "urgent": {"priority": 10, "hot": False},
+    }
+    rec = run_tenancy_soak(
+        duration_s=duration, in_flight=in_flight, tenants=tenants,
+        conf=conf, seed=7, timeout_s=600.0, make_query=make_query)
+    rec["errors"] = [repr(e)[:200] for e in rec["errors"][:8]]
+    rec["sched_stats"] = {
+        name: {k: s.get(k) for k in ("completed", "preempted",
+                                     "suspended", "shed", "rejected")}
+        for name, s in rec["sched_stats"].items()}
+    print("TENANCY_SOAK=" + json.dumps(rec))
+
+
+def tenancy_soak_bench(mark, budget_s: float):
+    """Run the tenancy soak in a subprocess (same isolation as the
+    concurrency ladder); returns the record dict or None."""
+    import subprocess
+    budget_s = min(float(os.environ.get(
+        "TPUQ_BENCH_TENANCY_BUDGET_S", "1200")), budget_s)
+    if budget_s < 60:
+        mark("tenancy soak: skipped — outer budget exhausted")
+        return None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--tenancy-soak"],
+            capture_output=True, text=True, timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        mark(f"tenancy soak: timed out after {budget_s:.0f}s")
+        return None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("TENANCY_SOAK="):
+            return json.loads(line.split("=", 1)[1])
+    mark(f"tenancy soak: child rc={out.returncode}; stderr tail: "
+         + (out.stderr or "")[-400:].replace("\n", " | "))
+    return None
+
+
 def main():
     from spark_rapids_tpu.sql.session import TpuSession
 
@@ -2017,6 +2114,7 @@ def main():
         "tpch_sf1_blackbox": blackboxes,
         "tpch_sf1_concurrency": None,
         "result_cache_soak": None,
+        "tenancy_soak": None,
         "kernel_bench": None,
         "adaptive_bench": None,
         "fusion_bench": None,
@@ -2089,6 +2187,11 @@ def main():
     result["result_cache_soak"] = result_cache_soak_bench(
         mark, TOTAL_BUDGET_S - (time.monotonic() - t_start))
     emit()
+    # sustained preemptive-tenancy soak: 64 in-flight mixed hot/cold
+    # tenants with preemption + HBM shares armed
+    result["tenancy_soak"] = tenancy_soak_bench(
+        mark, TOTAL_BUDGET_S - (time.monotonic() - t_start))
+    emit()
     # cheapest-first, with a per-query carve-out: running the ladder in
     # declaration order let one heavy early query (q3's first-ever
     # compile) eat the whole remaining budget and starve q8-q22 into
@@ -2132,5 +2235,7 @@ if __name__ == "__main__":
         _concurrency_bench_main()
     elif len(_sys.argv) == 2 and _sys.argv[1] == "--result-cache-soak":
         _result_cache_soak_main()
+    elif len(_sys.argv) == 2 and _sys.argv[1] == "--tenancy-soak":
+        _tenancy_soak_main()
     else:
         main()

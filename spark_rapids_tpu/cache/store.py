@@ -58,13 +58,18 @@ class CacheEntry:
 
 
 class Flight:
-    """One in-progress computation of a key (single-flight election)."""
+    """One in-progress computation of a key (single-flight election).
 
-    __slots__ = ("key", "done")
+    ``leader_qid`` is stamped by the winning query so followers can
+    tell when the leader has been preempted and break away instead of
+    holding their run slots hostage to a suspended computation."""
+
+    __slots__ = ("key", "done", "leader_qid")
 
     def __init__(self, key: str):
         self.key = key
         self.done = threading.Event()
+        self.leader_qid: Optional[int] = None
 
 
 class ResultCache:

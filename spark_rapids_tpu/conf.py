@@ -1434,6 +1434,47 @@ SCHED_SHED_SEM_SATURATION = (
     .create_with_default(4.0)
 )
 
+SCHED_PREEMPT_ENABLED = (
+    conf("spark.rapids.tpu.scheduler.preempt.enabled")
+    .doc("Let the scheduler cooperatively preempt running queries: "
+         "when a waiter has starved past preempt.graceMs the arbiter "
+         "suspends a victim (largest-runtime query of the most "
+         "over-share tenant) at its next pump boundary — permits "
+         "released, resident batches spilled through the HBM tiers — "
+         "admits the waiter, and resumes the victim bit-identically "
+         "once capacity frees. Off by default: preemption trades "
+         "victim latency for waiter fairness and should be an "
+         "operator's explicit choice.")
+    .category("scheduler")
+    .boolean()
+    .create_with_default(False)
+)
+
+SCHED_PREEMPT_GRACE_MS = (
+    conf("spark.rapids.tpu.scheduler.preempt.graceMs")
+    .doc("How long a queued query must wait before the preemption "
+         "arbiter considers suspending a running victim on its "
+         "behalf. Small values make the scheduler aggressive "
+         "(hot-potato slots); large values approach "
+         "fairness-by-politeness.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(500)
+)
+
+SCHED_PREEMPT_MIN_RUN_MS = (
+    conf("spark.rapids.tpu.scheduler.preempt.minRunMs")
+    .doc("A running query younger than this (measured from its grant, "
+         "and re-armed at each resume) is never picked as a "
+         "preemption victim — the anti-thrash floor that guarantees "
+         "forward progress under sustained overload.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(250)
+)
+
 
 # ---------------------------------------------------------------------------
 # Result-cache plane (spark_rapids_tpu/cache/, docs/result_cache.md)

@@ -121,9 +121,13 @@ def _traced_pump(node: "ExecNode", partition: int, it: Iterator) -> Iterator:
 
 def _cancellable_pump(tok, it: Iterator) -> Iterator:
     """Poll the query's CancelToken before each pumped batch — every
-    operator boundary in the plan becomes a cancellation point."""
+    operator boundary in the plan becomes a cancellation point AND a
+    preemption yield point (``preempt_point`` parks here when the
+    scheduler suspended the query, releasing this thread's device
+    permits until the resume)."""
     while True:
         tok.check()
+        tok.preempt_point()
         try:
             batch = next(it)
         except StopIteration:

@@ -71,6 +71,7 @@ class CpuScanExec(CpuExec):
             yield b
 
 
+import threading
 import weakref
 
 # Device-resident cache for in-memory relations: repeated executions of a
@@ -78,6 +79,7 @@ import weakref
 # the reference benchmarks — inter-stage data stays on device there; here
 # the analog of Spark's columnar cache).  Entries die with their table.
 _scan_cache: dict = {}
+_scan_cache_lock = threading.Lock()
 
 
 def _scan_cache_get(table: pa.Table, key):
@@ -86,7 +88,8 @@ def _scan_cache_get(table: pa.Table, key):
 
 
 def _scan_cache_evict(tid):
-    entries = _scan_cache.pop(tid, None)
+    with _scan_cache_lock:
+        entries = _scan_cache.pop(tid, None)
     if entries:
         for pairs in entries.values():
             for sp, _ in pairs:
@@ -102,13 +105,24 @@ def clear_scan_cache():
 
 def _scan_cache_put(table: pa.Table, key, batches):
     tid = id(table)
-    if tid not in _scan_cache:
-        try:
-            weakref.finalize(table, _scan_cache_evict, tid)
-        except TypeError:
+    with _scan_cache_lock:
+        if tid not in _scan_cache:
+            try:
+                weakref.finalize(table, _scan_cache_evict, tid)
+            except TypeError:
+                return
+            _scan_cache[tid] = {}
+        if key in _scan_cache[tid]:
+            # lost a build race: a preempted builder parked mid-scan
+            # while a concurrent query built the same entry.  Readers
+            # may already hold the installed list, so first-put wins —
+            # close our duplicates instead of orphaning theirs.
+            losers = batches
+        else:
+            _scan_cache[tid][key] = batches
             return
-        _scan_cache[tid] = {}
-    _scan_cache[tid][key] = batches
+    for sp, _ in losers:
+        sp.close()
 
 
 class TpuScanExec(TpuExec):

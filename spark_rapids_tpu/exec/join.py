@@ -731,6 +731,44 @@ class TpuSortMergeJoinExec(TpuExec):
                 with self.timer():
                     yield from self._merge_join(lb, rb, jt)
 
+    def _semi_stream_right(self, l_list, l_counts, r_list, jt, mgr
+                           ) -> Iterator[DeviceBatch]:
+        """semi/anti with the LEFT side in-core and an oversized RIGHT:
+        stream the right side in bounded groups, OR-accumulating the
+        per-row match flag across groups.  Correct because a semi/anti
+        row's verdict is "matched anywhere on the right" — group
+        membership never changes it; null-key and dead left rows get
+        m == 0 from every group, matching _merge_join's in-core
+        semantics exactly."""
+        from spark_rapids_tpu.parallel.shuffle import slice_batch
+        cap = self.sub_partition_rows
+        lb = _concat_or_empty(self.children[0].schema, l_list,
+                              counts=l_counts)
+        groups: List[List[DeviceBatch]] = [[]]
+        acc = 0
+        for b in r_list:
+            chunks = ([b] if b.capacity <= cap else
+                      [slice_batch(b, lo, cap)
+                       for lo in range(0, b.capacity, cap)])
+            for c in chunks:
+                if groups[-1] and acc + c.capacity > cap:
+                    groups.append([])
+                    acc = 0
+                groups[-1].append(c)
+                acc += c.capacity
+        matched = jnp.zeros((lb.capacity,), jnp.bool_)
+        for g in groups:
+            if not g:
+                continue
+            rb = _concat_or_empty(self.children[1].schema, g)
+            with mgr.transient(2 * (lb.nbytes() + rb.nbytes())):
+                with self.timer():
+                    m, lo, perm, l_null = self._match_ranges(lb, rb)
+                    matched = matched | (m > 0)
+        keep = matched if jt == "left_semi" else ~matched
+        out = lb.with_sel(lb.sel & keep)
+        yield from self._rebatch(self._project_semi(out), out.capacity)
+
     def _sub_partition_join(self, l_list, r_list, jt, total, mgr,
                             depth: int = 0, live_rows: Optional[int] = None
                             ) -> Iterator[DeviceBatch]:

@@ -64,6 +64,9 @@ BUCKETS: Dict[str, str] = {
                            "exchange's device launches)",
     "host_shuffle": "host-side shuffle partition/serialize/read/write",
     "spill_io": "device->host->disk spill writes and restore reads",
+    "preempted": "time parked in the SUSPENDED state after the "
+                 "scheduler preempted the query (permits released, "
+                 "residency spilled) — never lands in unaccounted",
     "cache": "result-cache probe and store (serve on hit, put on miss)",
     "pump_idle": "partition-pump machinery between instrumented "
                  "stages: iterator plumbing, batch handoff, "
@@ -83,6 +86,7 @@ BUCKET_VERDICTS: Dict[str, str] = {
     "exchange_collective": "exchange-bound",
     "host_shuffle": "shuffle-bound",
     "spill_io": "spill-bound",
+    "preempted": "preempt-bound",
     "cache": "cache-bound",
     "pump_idle": "pump-bound",
     "host_fallback": "fallback-bound",
@@ -117,6 +121,7 @@ STAGE_BUCKETS: Dict[str, Optional[str]] = {
     "restoreTime": "spill_io",
     "semaphoreWait": "semaphore_wait",
     "semaphoreWaitTime": "semaphore_wait",
+    "preemptWait": "preempted",
     "cacheProbe": "cache",
     "cacheServe": "cache",
     "queueWait": "queue_wait",
@@ -131,9 +136,9 @@ STAGE_BUCKETS: Dict[str, Optional[str]] = {
 # highest-priority active bucket.  Waits and one-shot I/O stages beat
 # compute; compute beats the pump envelope.
 BUCKET_PRIORITY: Tuple[str, ...] = (
-    "compile", "semaphore_wait", "spill_io", "exchange_collective",
-    "host_shuffle", "cache", "host_fallback", "kernel_dispatch",
-    "queue_wait", "pump_idle",
+    "compile", "preempted", "semaphore_wait", "spill_io",
+    "exchange_collective", "host_shuffle", "cache", "host_fallback",
+    "kernel_dispatch", "queue_wait", "pump_idle",
 )
 
 # closure slack floor: on sub-100ms queries fixed per-query overheads

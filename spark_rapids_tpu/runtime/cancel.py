@@ -49,8 +49,47 @@ _TM_LATENCY = TM.REGISTRY.histogram(
     "tpuq_cancel_latency_seconds",
     "cancel-request (or deadline-expiry) to QueryCancelled-raised "
     "latency")
+_TM_PREEMPT_REQ = TM.REGISTRY.counter(
+    "tpuq_preempt_requests_total",
+    "suspend requests issued against running queries")
+_TM_PREEMPT_SUSPENDED = TM.REGISTRY.counter(
+    "tpuq_preempt_suspended_total",
+    "queries that reached the SUSPENDED state (permits released, "
+    "residency spilled)")
+_TM_PREEMPT_RESUMED = TM.REGISTRY.counter(
+    "tpuq_preempt_resumed_total",
+    "suspended queries resumed by the scheduler")
+_TM_SUSPEND_LATENCY = TM.REGISTRY.histogram(
+    "tpuq_preempt_suspend_latency_seconds",
+    "suspend-request to SUSPENDED (first thread parked, permits "
+    "released) latency")
 
 DEFAULT_POLL_S = 0.05
+
+# -- preemption state machine (RUN -> SUSPEND_REQUESTED -> SUSPENDED ->
+#    RESUMED).  The cancel plane's poll points are exactly the yield
+#    points, so the same token carries both orders: ``check()`` asks
+#    "must I die?", ``preempt_point()`` asks "must I yield?".
+PREEMPT_RUN = "RUN"
+PREEMPT_SUSPEND_REQUESTED = "SUSPEND_REQUESTED"
+PREEMPT_SUSPENDED = "SUSPENDED"
+PREEMPT_RESUMED = "RESUMED"
+
+# (suspend_fn(token) -> state | None, resume_fn(token, state)) pairs a
+# resource layer registers so a suspending THREAD can hand back what it
+# holds (the device semaphore registers its per-thread permit stack)
+# and take it back on resume.  suspend_fn returning None means the
+# thread held nothing from that layer.
+_SUSPEND_PROVIDERS: List[tuple] = []
+
+
+def register_suspend_provider(suspend_fn: Callable,
+                              resume_fn: Callable) -> None:
+    """Register a (suspend, resume) pair run around every suspended
+    park: ``suspend_fn(token)`` releases the calling thread's holdings
+    and returns an opaque state (or None), ``resume_fn(token, state)``
+    reacquires them after the scheduler resumes the query."""
+    _SUSPEND_PROVIDERS.append((suspend_fn, resume_fn))
 
 
 class QueryCancelled(RuntimeError):
@@ -100,6 +139,16 @@ class CancelToken:
         self.latency_s: Optional[float] = None
         self._waiters: List[threading.Condition] = []
         self._callbacks: List[Callable[[], None]] = []
+        # tenant the query runs as — set by the QueryServer at submit;
+        # the HBM arbiter charges reservations to it
+        self.tenant: str = "default"
+        # preemption state (see the module-level state constants)
+        self._preempt_state: str = PREEMPT_RUN
+        self._preempt_detail: str = ""
+        self._preempt_requested_at: Optional[float] = None
+        self._resume_event = threading.Event()
+        self.suspend_latency_s: Optional[float] = None
+        self.preempt_count = 0     # completed suspend->resume cycles
 
     # -- firing ---------------------------------------------------------
 
@@ -115,6 +164,8 @@ class CancelToken:
             self.detail = detail
             self._effective_at = time.monotonic()
             self._event.set()
+            # wake suspended parks instantly — their next check() raises
+            self._resume_event.set()
             waiters = list(self._waiters)
             callbacks = list(self._callbacks)
         for cv in waiters:
@@ -136,6 +187,7 @@ class CancelToken:
                 self.detail = "query deadline expired"
                 self._effective_at = self._deadline
                 self._event.set()
+                self._resume_event.set()
                 waiters = list(self._waiters)
             else:
                 waiters = []
@@ -201,6 +253,7 @@ class CancelToken:
         deadline = time.monotonic() + max(seconds, 0.0)
         while True:
             self.check()
+            self.preempt_point()   # backoff sleeps are yield points too
             rem = deadline - time.monotonic()
             if rem <= 0:
                 return
@@ -243,6 +296,133 @@ class CancelToken:
             except Exception:
                 pass
         return remove
+
+    # -- preemption -----------------------------------------------------
+
+    @property
+    def preempt_state(self) -> str:
+        return self._preempt_state
+
+    def preempt_pending(self) -> bool:
+        """True while a suspend is requested or in force — resource
+        layers (the device semaphore) refuse new admissions for the
+        query while this holds."""
+        return self._preempt_state in (PREEMPT_SUSPEND_REQUESTED,
+                                       PREEMPT_SUSPENDED)
+
+    def suspended(self) -> bool:
+        return self._preempt_state == PREEMPT_SUSPENDED
+
+    def request_suspend(self, detail: str = "") -> bool:
+        """Ask the query to yield at its next preempt point (first
+        request wins; returns True on the RUN/RESUMED ->
+        SUSPEND_REQUESTED transition).  A cancelled token cannot be
+        suspended — the cancel already reclaims everything."""
+        with self._lock:
+            if self._event.is_set() or self.preempt_pending():
+                return False
+            self._preempt_state = PREEMPT_SUSPEND_REQUESTED
+            self._preempt_detail = detail
+            self._preempt_requested_at = time.monotonic()
+            self._resume_event.clear()
+            waiters = list(self._waiters)
+        # wake registered waiters (semaphore CVs) so a thread parked in
+        # acquire notices the suspend within one tick, not one poll
+        for cv in waiters:
+            with cv:
+                cv.notify_all()
+        _TM_PREEMPT_REQ.inc()
+        from spark_rapids_tpu.runtime import attribution
+        attribution.record_event("preempt", {
+            "phase": "suspend_requested", "query_id": self.query_id,
+            "detail": detail})
+        return True
+
+    def resume(self) -> bool:
+        """Let a suspended (or suspend-requested) query run again.
+        Sets only the resume event — parked threads wake off it
+        directly and semaphore waiters re-poll within their bounded
+        wait — so this is safe to call while holding scheduler locks
+        (it never takes a foreign condition variable)."""
+        with self._lock:
+            if not self.preempt_pending():
+                return False
+            self._preempt_state = PREEMPT_RESUMED
+            self.preempt_count += 1
+            self._resume_event.set()
+        _TM_PREEMPT_RESUMED.inc()
+        from spark_rapids_tpu.runtime import attribution
+        attribution.record_event("preempt", {
+            "phase": "resumed", "query_id": self.query_id})
+        return True
+
+    def preempt_point(self) -> None:
+        """The cooperative yield point, called wherever ``check()`` is
+        polled (pump boundaries, semaphore waits, backoff sleeps).
+        Fast when clean — one attribute compare.  When a suspend is
+        pending the calling thread releases its device permits (via the
+        registered suspend providers), the FIRST thread to park spills
+        the query's resident device batches through the HBM tiers, and
+        every thread waits (cancellably, poll-bounded) for the
+        scheduler's resume, then reacquires what it released."""
+        if self._preempt_state in (PREEMPT_RUN, PREEMPT_RESUMED):
+            return
+        self._park_suspended()
+
+    def _park_suspended(self) -> None:
+        self.check()
+        states = []
+        for suspend_fn, _resume_fn in _SUSPEND_PROVIDERS:
+            try:
+                states.append(suspend_fn(self))
+            except Exception:
+                states.append(None)
+        first = False
+        with self._lock:
+            if self._preempt_state == PREEMPT_SUSPEND_REQUESTED:
+                self._preempt_state = PREEMPT_SUSPENDED
+                first = True
+        if first:
+            lat = max(0.0, time.monotonic()
+                      - (self._preempt_requested_at or time.monotonic()))
+            self.suspend_latency_s = lat
+            _TM_PREEMPT_SUSPENDED.inc()
+            _TM_SUSPEND_LATENCY.observe(lat)
+            from spark_rapids_tpu.runtime import attribution
+            attribution.record_event("preempt", {
+                "phase": "suspended", "query_id": self.query_id,
+                "latency_s": round(lat, 6),
+                "detail": self._preempt_detail})
+            # spill resident device batches so the preemptor inherits
+            # the HBM headroom; they rehydrate lazily (bit-identically,
+            # CRC-checked) when the query resumes and touches them
+            from spark_rapids_tpu.runtime import memory
+            mgr = memory.peek_manager()
+            if mgr is not None and self.query_id is not None:
+                try:
+                    mgr.suspend_spill(self.query_id)
+                except Exception:
+                    pass
+        from spark_rapids_tpu.runtime import trace
+        tr = trace.current()
+        span = (tr.begin("Preempt", "preemptWait")
+                if tr is not None else None)
+        try:
+            while self._preempt_state == PREEMPT_SUSPENDED:
+                self.check()
+                self._resume_event.wait(self.wait_interval())
+        finally:
+            if span is not None:
+                tr.end(span)
+            # reacquire in reverse registration order; a provider whose
+            # suspend returned None released nothing.  On cancel the
+            # reacquire is skipped by the raise — released permits stay
+            # released and the hold contexts above know not to
+            # double-release (the provider marks what it gave back).
+            for (_s, resume_fn), st in zip(reversed(_SUSPEND_PROVIDERS),
+                                           reversed(states)):
+                if st is not None:
+                    resume_fn(self, st)
 
 
 # ---------------------------------------------------------------------------
@@ -407,6 +587,35 @@ def cancel_query(query_id: int, reason: str = "user",
     return tok.cancel(reason, detail)
 
 
+def suspend_query(query_id: int, detail: str = "") -> bool:
+    """Request cooperative suspension of one in-flight query (the
+    scheduler's preemption arbiter backend; also a chaos-harness hook).
+    Returns False when no such query is active or it cannot be
+    suspended (already pending, or cancelled)."""
+    with _ACTIVE_LOCK:
+        tok = _ACTIVE.get(query_id)
+    if tok is None:
+        return False
+    return tok.request_suspend(detail)
+
+
+def resume_query(query_id: int) -> bool:
+    """Resume a suspended in-flight query.  Returns False when no such
+    query is active or no suspend was pending."""
+    with _ACTIVE_LOCK:
+        tok = _ACTIVE.get(query_id)
+    if tok is None:
+        return False
+    return tok.resume()
+
+
+def get_token(query_id: int) -> Optional[CancelToken]:
+    """The in-flight token for ``query_id`` (None when not active) —
+    observability/harness hook for reading preempt state and latency."""
+    with _ACTIVE_LOCK:
+        return _ACTIVE.get(query_id)
+
+
 def active_queries() -> List[int]:
     """Query ids with an open cancel scope, oldest first."""
     with _ACTIVE_LOCK:
@@ -422,3 +631,14 @@ def reset() -> None:
     with _ACTIVE_LOCK:
         _ACTIVE.clear()
         del _AMBIENT[:]
+
+
+def _suspended_now() -> int:
+    with _ACTIVE_LOCK:
+        return sum(1 for t in _ACTIVE.values() if t.suspended())
+
+
+TM.REGISTRY.gauge(
+    "tpuq_preempt_suspended",
+    "in-flight queries currently parked in the SUSPENDED state",
+    fn=_suspended_now)

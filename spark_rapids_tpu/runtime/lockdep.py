@@ -296,6 +296,8 @@ class _TrackedCondition:
     def wait(self, timeout: Optional[float] = None):
         _note_release(self)
         try:
+            # the CALLER owns the token-polling loop around this wait
+            # cancel-exempt: lockdep shim forwards the caller's bounded wait
             return self._real.wait(timeout)
         finally:
             _note_acquire(self)

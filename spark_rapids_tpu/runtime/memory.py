@@ -57,6 +57,15 @@ _TM_RETRY_OOM = TM.REGISTRY.counter(
     "tpuq_retry_oom_total", "RetryOOM raises (incl. injected)")
 _TM_SPLIT_RETRY = TM.REGISTRY.counter(
     "tpuq_split_retry_total", "SplitAndRetryOOM batch halvings")
+_TM_PREEMPT_SPILLED = TM.REGISTRY.counter(
+    "tpuq_preempt_spilled_bytes_total",
+    "device bytes spilled to host because their query was suspended "
+    "by the preemption plane")
+_TM_TENANT_BREACH = TM.REGISTRY.labeled_counter(
+    "tpuq_tenant_hbm_breach_total",
+    "reservations denied because the tenant's enforced HBM byte "
+    "budget (hbmShare x pool) was exhausted even after spilling its "
+    "own residency", label="tenant")
 
 
 class RetryOOM(RuntimeError):
@@ -156,14 +165,26 @@ class SpillableBatch:
         # set when a disk spill degraded (stayed in the host tier); the
         # host-limit eviction loop must skip such victims or it spins
         self._disk_spill_failed = False
+        # True while a disk write is in flight for this batch.  The
+        # write's retry/backoff sleeps are preempt yield points, and a
+        # park's suspend-spill can re-enter the host-eviction loop on
+        # this very batch — without the guard both frames write a file
+        # and the second assignment orphans the first.
+        self._disk_spilling = False
         self.schema = batch.schema
         self.compacted = batch.compacted
         self.nbytes = batch.nbytes()
         # static row capacity, readable without restoring a spilled
         # batch (the join's skew re-check must not force an unspill)
         self.capacity = batch.capacity
+        # tenancy: the batch belongs to the ambient query — its bytes
+        # charge that tenant's enforced HBM budget, and a suspend of
+        # that query spills it through the tiers
+        tok = cancel.current()
+        self._tenant = tok.tenant if tok is not None else "default"
+        self._query_id = tok.query_id if tok is not None else None
         if reserve:
-            manager.reserve(self.nbytes)
+            manager.reserve(self.nbytes, tenant=self._tenant)
         manager._register(self)
 
     @property
@@ -203,7 +224,7 @@ class SpillableBatch:
         """Host → disk through the ``spill_write`` failure domain.
         Returns host bytes freed (0 when the write degraded — the batch
         stays in the host tier, marked so the eviction loop skips it)."""
-        if self._host is None:
+        if self._host is None or self._disk_spilling:
             return 0
         with trace.span("Spill", "spillTime"):
             return self._spill_to_disk()
@@ -211,6 +232,13 @@ class SpillableBatch:
     def _spill_to_disk(self) -> int:
         leaves, treedef = self._host
         os.makedirs(self._mgr.spill_path, exist_ok=True)
+        if self._disk_path is not None:
+            # a restore raced an eviction (preemption churn makes this
+            # reachable: the restore staged _host, then RetryOOM'd its
+            # reservation while the evictor re-spilled) — drop the
+            # stale file or the overwrite below orphans it
+            _unlink_spill(self._disk_path)
+            self._disk_path = None
         path = os.path.join(self._mgr.spill_path,
                             f"spill-{uuid.uuid4().hex}.npz")
 
@@ -225,11 +253,20 @@ class SpillableBatch:
         def degrade():
             return False  # keep the host copy; data is still safe
 
-        if not R.run_guarded("spill_write", attempt, op="spill_to_disk",
-                             degrade=degrade):
+        self._disk_spilling = True
+        try:
+            ok = R.run_guarded("spill_write", attempt, op="spill_to_disk",
+                               degrade=degrade)
+        finally:
+            self._disk_spilling = False
+        if not ok:
             self._disk_spill_failed = True
             _unlink_spill(path)  # drop any partial file
             return 0
+        if self._disk_path is not None and self._disk_path != path:
+            # someone re-spilled this batch while our write was in its
+            # retry loop — never orphan their file
+            _unlink_spill(self._disk_path)
         self._disk_path = path
         self._treedef = treedef
         freed = sum(x.nbytes for x in leaves)
@@ -270,7 +307,8 @@ class SpillableBatch:
             _unlink_spill(self._disk_path)
             self._disk_path = None
         leaves, treedef = self._host
-        self._mgr.reserve(self.nbytes, _restoring=self)
+        self._mgr.reserve(self.nbytes, _restoring=self,
+                          tenant=self._tenant)
         self._device_accounted = True
         self._batch = jax.tree.unflatten(
             treedef, [jax.numpy.asarray(x) for x in leaves])
@@ -305,10 +343,21 @@ class DeviceMemoryManager:
                  spill_path: str = "/tmp/tpuq-spill",
                  inject_oom_at: int = -1,
                  retry_max_attempts: int = 8,
-                 debug: bool = False):
+                 debug: bool = False,
+                 conf=None):
         self.retry_max_attempts = retry_max_attempts
         self._lock = threading.RLock()
         self._spillables: Dict[int, SpillableBatch] = {}
+        # per-tenant HBM enforcement: live reserved bytes per tenant,
+        # checked against hbmShare x budget at every reserve.  The conf
+        # is kept only to resolve per-tenant hbmShare overrides.
+        self._conf = conf
+        self._tenant_used: Dict[str, int] = {}
+        self._tenant_share_default = 1.0
+        if conf is not None:
+            from spark_rapids_tpu import conf as C
+            self._tenant_share_default = float(
+                conf.get(C.SCHED_TENANT_HBM_SHARE))
         # leak tracker [REF: cudf MemoryCleaner]: with debug on, every
         # registration records its creation stack; unreleased handles
         # are reported at shutdown / replacement (LEAK DETECTED)
@@ -332,7 +381,8 @@ class DeviceMemoryManager:
         self._inject_at = inject_oom_at
         self.metrics = {"spillToHostBytes": 0, "spillToDiskBytes": 0,
                         "restoredBytes": 0, "retryOOMs": 0,
-                        "splitRetries": 0, "peakReserved": 0}
+                        "splitRetries": 0, "peakReserved": 0,
+                        "tenantBreaches": 0, "preemptSpilledBytes": 0}
         self.budget = budget if budget else self._detect_budget(
             alloc_fraction)
 
@@ -348,10 +398,20 @@ class DeviceMemoryManager:
         return int((4 << 30) * fraction)
 
     # -- accounting ---------------------------------------------------------
-    def reserve(self, nbytes: int, _restoring=None) -> None:
-        """Claim bytes for an upcoming materialization.  Synchronously
-        spills victims if needed; raises RetryOOM when the budget cannot
-        be met (or when fault injection fires)."""
+    def reserve(self, nbytes: int, _restoring=None,
+                tenant: Optional[str] = None) -> None:
+        """Claim bytes for an upcoming materialization, charged to
+        ``tenant`` (the ambient query token's tenant when omitted).
+        Synchronously spills victims if needed; raises RetryOOM when
+        the global budget — or the tenant's enforced hbmShare byte
+        budget — cannot be met (or when fault injection fires).  A
+        tenant breach escalates OUTSIDE the manager lock: spill the
+        tenant's own residency first, then ask the scheduler to
+        preempt its largest-runtime other query, then RetryOOM."""
+        if tenant is None:
+            tok = cancel.current()
+            tenant = tok.tenant if tok is not None else "default"
+        breached = False
         with self._lock:
             self._alloc_count += 1
             if self._inject_at >= 0 and self._alloc_count == self._inject_at:
@@ -383,14 +443,87 @@ class DeviceMemoryManager:
                     raise RetryOOM(
                         f"cannot reserve {nbytes} B: {self._reserved} of "
                         f"{self.budget} B reserved, nothing left to spill")
-            self._reserved += nbytes
-            _TM_RESERVE.inc(nbytes)
-            self.metrics["peakReserved"] = max(
-                self.metrics["peakReserved"], self._reserved)
+            tenant_budget = self._tenant_budget(tenant)
+            if tenant_budget < self.budget:
+                # spill-first: the tenant's own device residency pays
+                # before anyone else is disturbed
+                while (self._tenant_used.get(tenant, 0) + nbytes
+                       > tenant_budget):
+                    if not self._spill_one_tenant(tenant,
+                                                  exclude=_restoring):
+                        break
+                if (self._tenant_used.get(tenant, 0) + nbytes
+                        > tenant_budget):
+                    breached = True
+                    self.metrics["tenantBreaches"] += 1
+                    self.metrics["retryOOMs"] += 1
+            if not breached:
+                self._reserved += nbytes
+                self._tenant_used[tenant] = (
+                    self._tenant_used.get(tenant, 0) + nbytes)
+                _TM_RESERVE.inc(nbytes)
+                self.metrics["peakReserved"] = max(
+                    self.metrics["peakReserved"], self._reserved)
+        if breached:
+            _TM_TENANT_BREACH.inc(tenant)
+            _TM_RETRY_OOM.inc()
+            # escalate to preemption: suspend the tenant's largest-
+            # runtime OTHER running query so its reservations unwind.
+            # Must run without the manager lock — the scheduler takes
+            # its own lock and the documented order is sched -> memory.
+            tok = cancel.current()
+            exclude = tok.query_id if tok is not None else None
+            from spark_rapids_tpu.runtime import scheduler
+            sched = scheduler.peek_scheduler()
+            if sched is not None:
+                try:
+                    sched.request_tenant_preemption(
+                        tenant, exclude_query_id=exclude)
+                except Exception:
+                    pass  # best-effort; the RetryOOM still rolls back
+            raise RetryOOM(
+                f"tenant {tenant} cannot reserve {nbytes} B: "
+                f"{self._tenant_used.get(tenant, 0)} of its "
+                f"{self._tenant_budget(tenant)} B hbmShare budget used "
+                "and its own residency is already spilled")
 
-    def release(self, nbytes: int) -> None:
+    def release(self, nbytes: int, tenant: Optional[str] = None) -> None:
+        if tenant is None:
+            tok = cancel.current()
+            tenant = tok.tenant if tok is not None else "default"
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
+            if tenant in self._tenant_used:
+                self._tenant_used[tenant] = max(
+                    0, self._tenant_used[tenant] - nbytes)
+
+    def _tenant_budget(self, tenant: str) -> int:
+        """The tenant's enforced HBM byte budget: hbmShare (per-tenant
+        conf override, else the scheduler-wide default) x pool."""
+        share = self._tenant_share_default
+        if self._conf is not None:
+            raw = self._conf.get_raw(
+                f"spark.rapids.tpu.scheduler.tenant.{tenant}.hbmShare")
+            if raw is not None:
+                try:
+                    share = float(raw)
+                except (TypeError, ValueError):
+                    pass
+        return int(min(1.0, max(0.0, share)) * self.budget)
+
+    def _spill_one_tenant(self, tenant: str, exclude=None) -> bool:
+        for s in list(self._spillables.values()):
+            if (s is exclude or s.tier != "device"
+                    or not s._device_accounted or s._tenant != tenant):
+                continue
+            s.spill_to_host()
+            return True
+        return False
+
+    def tenant_usage(self) -> Dict[str, int]:
+        """Live reserved bytes per tenant (snapshot)."""
+        with self._lock:
+            return dict(self._tenant_used)
 
     @contextlib.contextmanager
     def transient(self, nbytes: int):
@@ -449,6 +582,28 @@ class DeviceMemoryManager:
                   f"(tier={s.tier}) never closed; created at:\n{origin}")
         return len(leaks)
 
+    def suspend_spill(self, query_id: int) -> int:
+        """Spill a suspending query's device-resident registered
+        batches to the host tier so the preemptor inherits its HBM
+        headroom (scan-cache pins are shared residency — they stay).
+        Called by the first thread to park in ``_park_suspended``;
+        the batches rehydrate lazily (CRC-checked, bit-identical) when
+        the resumed query next touches them.  Returns bytes spilled."""
+        from spark_rapids_tpu.exec.basic import _scan_cache
+        pinned = {id(sp) for entries in _scan_cache.values()
+                  for pairs in entries.values() for sp, _ in pairs}
+        spilled = 0
+        with self._lock:
+            for s in list(self._spillables.values()):
+                if (s.tier != "device" or id(s) in pinned
+                        or s._query_id != query_id):
+                    continue
+                spilled += s.spill_to_host()
+        if spilled:
+            self.metrics["preemptSpilledBytes"] += spilled
+            _TM_PREEMPT_SPILLED.inc(spilled)
+        return spilled
+
     def reclaim_all(self) -> int:
         """Close every non-pinned registered spillable — the cancelled
         query's reclamation sweep.  Closing releases device/host
@@ -467,7 +622,7 @@ class DeviceMemoryManager:
             self._origins.pop(id(s), None)
             if s.tier == "device" and s._device_accounted:
                 s._device_accounted = False
-                self.release(s.nbytes)
+                self.release(s.nbytes, tenant=s._tenant)
             elif s._host_accounted:
                 # symmetric with _on_spill: host-tier bytes leave the
                 # host budget when the batch is closed/evicted (staged
@@ -479,7 +634,9 @@ class DeviceMemoryManager:
                   release_device: bool = True) -> None:
         with self._lock:
             if release_device:
-                self.release(nbytes)
+                # charge the batch's OWN tenant, not the ambient one —
+                # the global spill loop may evict another query's batch
+                self.release(nbytes, tenant=s._tenant)
             self._host_used += nbytes
             self.metrics["spillToHostBytes"] += nbytes
             _TM_SPILL_HOST.inc(nbytes)
@@ -487,7 +644,8 @@ class DeviceMemoryManager:
                 victim = next(
                     (v for v in self._spillables.values()
                      if v.tier == "host" and v._host_accounted
-                     and not v._disk_spill_failed and v is not s), None)
+                     and not v._disk_spill_failed
+                     and not v._disk_spilling and v is not s), None)
                 if victim is None:
                     break
                 victim.spill_to_disk()  # decrements _host_used itself
@@ -513,6 +671,7 @@ def get_manager(conf=None) -> DeviceMemoryManager:
     """The process arbiter.  First caller's conf wins; a session with
     explicit memory confs replaces an unconfigured default."""
     global _manager
+    replaced = False
     with _manager_lock:
         if _manager is None:
             _manager = _build(conf)
@@ -524,13 +683,18 @@ def get_manager(conf=None) -> DeviceMemoryManager:
                     _manager.budget, _manager.host_limit,
                     _manager._inject_at, _manager.retry_max_attempts,
                     _manager.spill_root, _manager.debug):
-                # a new manager orphans batches registered with the old
-                # one — evict the device-resident scan cache so nothing
-                # keeps accounting against the dead arbiter
-                from spark_rapids_tpu.exec.basic import clear_scan_cache
-                clear_scan_cache()
                 _manager = cfg
-        return _manager
+                replaced = True
+        mgr = _manager
+    if replaced:
+        # a new manager orphans batches registered with the old one —
+        # evict the device-resident scan cache so nothing keeps
+        # accounting against the dead arbiter.  Outside _manager_lock:
+        # eviction takes the scan-cache lock (tier 0) and each close
+        # talks to its own batch's arbiter, never the module global.
+        from spark_rapids_tpu.exec.basic import clear_scan_cache
+        clear_scan_cache()
+    return mgr
 
 
 def peek_manager() -> Optional[DeviceMemoryManager]:
@@ -577,6 +741,7 @@ def _build(conf) -> DeviceMemoryManager:
         inject_oom_at=conf.get(C.FAULT_INJECT),
         retry_max_attempts=conf.get(C.RETRY_MAX),
         debug=str(conf.get(C.MEMORY_DEBUG)).upper() == "STDOUT",
+        conf=conf,
     )
 
 

@@ -81,12 +81,24 @@ _TM_CANCELLED_QUEUED = TM.REGISTRY.counter(
 _TM_QUEUE_WAIT = TM.REGISTRY.histogram(
     "tpuq_scheduler_queue_wait_seconds",
     "queued-to-granted latency per admitted query")
+_TM_PREEMPTED = TM.REGISTRY.labeled_counter(
+    "tpuq_scheduler_preempted_total",
+    "running queries suspended by the preemption arbiter, per victim "
+    "tenant", label="tenant")
 
-# ticket lifecycle
+# ticket lifecycle (SUSPENDED: granted once, slot reclaimed by the
+# preemption arbiter, waiting to resume — resumes before new grants)
 QUEUED = "QUEUED"
 RUNNING = "RUNNING"
+SUSPENDED = "SUSPENDED"
 DONE = "DONE"
 CANCELLED = "CANCELLED"
+
+#: sanctioned priority band for ``submit`` — out-of-range values are a
+#: caller bug surfaced as QueryRejected(reason='bad_priority') at the
+#: door, not a KeyError deep in a dispatch lane
+PRIORITY_MIN = -100
+PRIORITY_MAX = 100
 
 #: rejection reasons that mean "the service is overloaded" (counted in
 #: the shed counter + health WARN) as opposed to "this tenant hit its
@@ -101,8 +113,8 @@ class QueryRejected(RuntimeError):
     """Structured admission rejection.  ``reason`` is machine-readable
     (``shed_queue_depth`` / ``shed_spill_pressure`` /
     ``shed_semaphore_saturation`` / ``tenant_queue_full`` /
-    ``queue_full``); callers switch on it to retry, back off, or fail
-    over to another replica."""
+    ``queue_full`` / ``bad_priority``); callers switch on it to retry,
+    back off, fix the request, or fail over to another replica."""
 
     def __init__(self, reason: str, tenant: Optional[str] = None,
                  detail: str = ""):
@@ -117,13 +129,33 @@ class QueryRejected(RuntimeError):
         super().__init__(msg)
 
 
+def check_priority(priority, tenant: Optional[str] = None) -> int:
+    """Validate a submission priority at the door.  Returns the
+    normalized int, or raises ``QueryRejected(reason='bad_priority')``
+    for non-integers and values outside [PRIORITY_MIN, PRIORITY_MAX] —
+    before any token is minted or scheduler state touched."""
+    try:
+        p = int(priority)
+        if p != priority:  # 2.5, "5", ... — only true ints pass
+            p = None
+    except (TypeError, ValueError):
+        p = None
+    if p is None or not (PRIORITY_MIN <= p <= PRIORITY_MAX):
+        _TM_REJECTED.inc("bad_priority")
+        raise QueryRejected(
+            "bad_priority", tenant=tenant,
+            detail=f"priority={priority!r} outside "
+                   f"[{PRIORITY_MIN}, {PRIORITY_MAX}]")
+    return p
+
+
 class Ticket:
     """One submission's place in the service.  Created by ``submit``;
     the owning worker blocks in ``acquire`` until granted, runs the
     query, then ``release``s the slot."""
 
     __slots__ = ("query_id", "tenant", "priority", "token", "state",
-                 "submitted_at", "granted_at")
+                 "submitted_at", "granted_at", "suspended_at")
 
     def __init__(self, query_id: int, tenant: str, priority: int, token):
         self.query_id = query_id
@@ -133,6 +165,7 @@ class Ticket:
         self.state = QUEUED
         self.submitted_at = time.monotonic()
         self.granted_at: Optional[float] = None
+        self.suspended_at: Optional[float] = None
 
 
 class TenantState:
@@ -142,7 +175,7 @@ class TenantState:
     __slots__ = ("name", "weight", "max_in_flight", "max_queued",
                  "hbm_share", "run_cap", "lanes", "deficit", "running",
                  "queued", "submitted", "completed", "rejected", "shed",
-                 "cancelled_queued")
+                 "cancelled_queued", "preempted", "suspended")
 
     def __init__(self, name: str, weight: float, max_in_flight: int,
                  max_queued: int, hbm_share: float, max_concurrent: int):
@@ -168,6 +201,8 @@ class TenantState:
         self.rejected = 0
         self.shed = 0
         self.cancelled_queued = 0
+        self.preempted = 0   # times one of this tenant's queries was
+        self.suspended = 0   # suspended / currently-suspended count
 
     def backlogged(self) -> bool:
         return self.queued > 0 and self.running < self.run_cap
@@ -200,11 +235,17 @@ class QueryScheduler:
     scheduler thread to leak or deadlock).
 
     Lock order: ``self._cv`` may be held while touching a
-    ``CancelToken`` (``check``/``add_waiter``) — safe because token
-    cancel/deadline callbacks notify waiter CVs OUTSIDE the token
-    lock.  The scheduler never touches ``DeviceSemaphore._cv`` or the
-    memory-manager lock while holding ``self._cv`` (the pressure
-    probes read plain attributes).
+    ``CancelToken`` (``check``/``add_waiter``/``request_suspend``/
+    ``resume``) — safe because the token lock is a leaf (token
+    cancel/suspend paths notify waiter CVs OUTSIDE the token lock),
+    and the only foreign CV those notifications take
+    (``DeviceSemaphore._cv``) is never held by any thread that wants
+    ``self._cv`` — the semaphore layer never calls into the
+    scheduler.  The scheduler never takes the memory-manager lock
+    while holding ``self._cv`` (the pressure probes read plain
+    attributes), and the memory arbiter's
+    ``request_tenant_preemption`` upcall must likewise be made
+    without the memory lock held.
     """
 
     def __init__(self, conf=None):
@@ -224,6 +265,11 @@ class QueryScheduler:
             self._default_queued = int(conf.get(C.SCHED_TENANT_MAX_QUEUED))
             self._default_hbm_share = float(
                 conf.get(C.SCHED_TENANT_HBM_SHARE))
+            self.preempt_enabled = bool(conf.get(C.SCHED_PREEMPT_ENABLED))
+            self.preempt_grace_s = float(
+                conf.get(C.SCHED_PREEMPT_GRACE_MS)) / 1000.0
+            self.preempt_min_run_s = float(
+                conf.get(C.SCHED_PREEMPT_MIN_RUN_MS)) / 1000.0
         else:
             self.max_concurrent = C.SCHED_MAX_CONCURRENT.default
             self.max_queued = C.SCHED_MAX_QUEUED.default
@@ -234,9 +280,14 @@ class QueryScheduler:
             self._default_in_flight = C.SCHED_TENANT_MAX_IN_FLIGHT.default
             self._default_queued = C.SCHED_TENANT_MAX_QUEUED.default
             self._default_hbm_share = C.SCHED_TENANT_HBM_SHARE.default
+            self.preempt_enabled = C.SCHED_PREEMPT_ENABLED.default
+            self.preempt_grace_s = C.SCHED_PREEMPT_GRACE_MS.default / 1000.0
+            self.preempt_min_run_s = (
+                C.SCHED_PREEMPT_MIN_RUN_MS.default / 1000.0)
         self._tenants: Dict[str, TenantState] = {}
         self._rr_order: deque = deque()  # round-robin tie-break rotation
         self._tickets: Dict[int, Ticket] = {}
+        self._suspended: List[Ticket] = []  # oldest suspension first
         self.queued_total = 0
         self.running_total = 0
 
@@ -309,6 +360,7 @@ class QueryScheduler:
         (pass it to ``acquire`` from the thread that will run the
         query) or raises ``QueryRejected(reason=...)``.  Never blocks
         beyond the scheduler lock."""
+        priority = check_priority(priority, tenant)
         shed = None
         reason = None
         detail = ""
@@ -354,11 +406,30 @@ class QueryScheduler:
     # -- dispatch ----------------------------------------------------------
 
     def _dispatch_locked(self) -> None:
-        """Grant free run slots to queued tickets, fairest-first.
+        """Grant free run slots: suspended tickets resume FIRST (they
+        already won a slot once — preemption borrowed it, it was not
+        revoked), then queued tickets are granted fairest-first.
         Tickets flip to RUNNING here (the grant is the state change —
         the acquiring thread merely observes it), so a grant holds even
         if the acquirer is slow to wake."""
         granted = False
+        for k in list(self._suspended):
+            if self.running_total >= self.max_concurrent:
+                break
+            vt = self._tenants[k.tenant]
+            if vt.running >= vt.run_cap:
+                continue
+            self._suspended.remove(k)
+            k.state = RUNNING
+            k.granted_at = time.monotonic()
+            vt.running += 1
+            vt.suspended -= 1
+            self.running_total += 1
+            granted = True
+            if k.token is not None:
+                # safe under self._cv: resume() only sets the token's
+                # resume event — it never notifies foreign CVs
+                k.token.resume()
         while (self.running_total < self.max_concurrent
                and self.queued_total > 0):
             ticket = self._next_ticket_locked()
@@ -400,6 +471,119 @@ class QueryScheduler:
                     t.deficit = min(t.deficit, t.weight)
         return None
 
+    # -- preemption arbiter ------------------------------------------------
+
+    def _suspend_locked(self, victim: Ticket, now: float) -> None:
+        victim.state = SUSPENDED
+        victim.suspended_at = now
+        vt = self._tenants[victim.tenant]
+        vt.running -= 1
+        vt.preempted += 1
+        vt.suspended += 1
+        self.running_total -= 1
+        self._suspended.append(victim)
+        _TM_PREEMPTED.inc(victim.tenant)
+
+    def _grant_locked(self, ticket: Ticket, now: float) -> None:
+        t = self._tenants[ticket.tenant]
+        t.remove_ticket(ticket)
+        t.queued -= 1
+        t.running += 1
+        self.queued_total -= 1
+        self.running_total += 1
+        ticket.state = RUNNING
+        ticket.granted_at = now
+
+    def _maybe_preempt_locked(self, ticket: Ticket,
+                              waiting_since: float) -> Optional[Ticket]:
+        """The arbiter: when ``ticket`` has starved past
+        ``preempt.graceMs`` and no slot can free up on its own, pick a
+        victim (largest-runtime query of the most over-share tenant —
+        same-tenant victims only on strict priority, cross-tenant only
+        when the victim's tenant is more over its fair share than the
+        waiter's or the waiter outranks it), suspend it — ticket state
+        AND token request in one locked step, so a concurrent dispatch
+        can never resume a ticket whose token has not yet heard of the
+        suspend — and hand its slot to the waiter atomically.  Returns
+        the victim or None."""
+        if not self.preempt_enabled:
+            return None
+        now = time.monotonic()
+        if now - waiting_since < self.preempt_grace_s:
+            return None
+        t = self._tenants[ticket.tenant]
+        tenant_capped = t.running >= t.run_cap
+        if not tenant_capped and self.running_total < self.max_concurrent:
+            return None  # a slot is free — normal dispatch will grant
+        waiter_score = t.running / t.weight
+        cands = []
+        for k in self._tickets.values():
+            if k.state != RUNNING or k.token is None:
+                continue
+            if k.token.cancelled() or k.token.preempt_pending():
+                continue
+            if (k.granted_at is None
+                    or now - k.granted_at < self.preempt_min_run_s):
+                continue  # anti-thrash floor: let it make progress
+            if k.tenant == ticket.tenant:
+                if k.priority >= ticket.priority:
+                    continue
+            else:
+                if tenant_capped:
+                    continue  # only evicting our own frees quota room
+                kt = self._tenants[k.tenant]
+                if (kt.running / kt.weight <= waiter_score
+                        and k.priority >= ticket.priority):
+                    continue
+            cands.append(k)
+        if not cands:
+            return None
+
+        def _score(k: Ticket):
+            kt = self._tenants[k.tenant]
+            return (kt.running / kt.weight, now - (k.granted_at or now))
+
+        victim = max(cands, key=_score)
+        victim.token.request_suspend(
+            f"preempted by query {ticket.query_id} "
+            f"(tenant={ticket.tenant}, priority={ticket.priority})")
+        self._suspend_locked(victim, now)
+        self._grant_locked(ticket, now)
+        self._cv.notify_all()
+        return victim
+
+    def request_tenant_preemption(self, tenant: str,
+                                  exclude_query_id: Optional[int] = None
+                                  ) -> bool:
+        """HBM-arbiter hook: a tenant breached its byte budget and
+        spilling its own residency was not enough — suspend the
+        tenant's largest-runtime OTHER running query so its residency
+        spills and its reservations unwind.  Call WITHOUT holding the
+        memory-manager lock (this takes the scheduler lock).  The
+        freed run slot is deliberately NOT re-dispatched here — an
+        immediate dispatch would resume the victim straight back into
+        it; the next submit/release event hands the slot out."""
+        with self._cv:
+            if not self.preempt_enabled:
+                return False
+            now = time.monotonic()
+            cands = [
+                k for k in self._tickets.values()
+                if k.state == RUNNING and k.tenant == tenant
+                and k.query_id != exclude_query_id
+                and k.token is not None
+                and not k.token.cancelled()
+                and not k.token.preempt_pending()
+                and k.granted_at is not None
+                and now - k.granted_at >= self.preempt_min_run_s]
+            if not cands:
+                return False
+            victim = min(cands, key=lambda k: k.granted_at)
+            victim.token.request_suspend(
+                f"tenant {tenant} HBM budget breach")
+            self._suspend_locked(victim, now)
+        return True
+
     # -- the worker side ---------------------------------------------------
 
     def acquire(self, ticket: Ticket) -> float:
@@ -408,9 +592,15 @@ class QueryScheduler:
         cancellable and deadline-aware via the ticket's ``CancelToken``
         — cancel/expiry while still QUEUED raises ``QueryCancelled``
         within ~one poll interval, removes the ticket from its lane,
-        and counts ``tpuq_scheduler_cancelled_queued_total``."""
+        and counts ``tpuq_scheduler_cancelled_queued_total``.
+
+        Each poll tick also consults the preemption arbiter: once the
+        wait exceeds ``preempt.graceMs`` and no slot can free on its
+        own, a running victim is suspended and its slot transferred to
+        this ticket in one locked step."""
         tok = ticket.token
         registered = False
+        waiting_since = time.monotonic()
         try:
             with self._cv:
                 try:
@@ -423,6 +613,9 @@ class QueryScheduler:
                             timeout = tok.wait_interval()
                         else:
                             timeout = 0.1
+                        if self._maybe_preempt_locked(
+                                ticket, waiting_since) is not None:
+                            continue  # slot transferred — loop exits
                         self._cv.wait(timeout=timeout)
                 except BaseException:
                     if ticket.state == QUEUED:
@@ -461,6 +654,21 @@ class QueryScheduler:
                 completed = True
                 self._dispatch_locked()
                 self._cv.notify_all()
+            elif ticket.state == SUSPENDED:
+                # worker bailed while suspended (cancel/deadline fired
+                # in the park) — the suspension already returned the
+                # run slot, so only the bookkeeping unwinds here
+                ticket.state = DONE
+                t = self._tenants[ticket.tenant]
+                t.completed += 1
+                t.suspended -= 1
+                try:
+                    self._suspended.remove(ticket)
+                except ValueError:
+                    pass
+                self._tickets.pop(ticket.query_id, None)
+                completed = True
+                self._cv.notify_all()
             elif ticket.state == QUEUED:
                 # worker bailed without acquire() ever raising
                 self._remove_queued_locked(ticket)
@@ -497,7 +705,9 @@ class QueryScheduler:
                            "completed": t.completed,
                            "rejected": t.rejected,
                            "shed": t.shed,
-                           "cancelled_queued": t.cancelled_queued}
+                           "cancelled_queued": t.cancelled_queued,
+                           "preempted": t.preempted,
+                           "suspended": t.suspended}
                     for name, t in self._tenants.items()}
 
 
@@ -536,6 +746,12 @@ def get_scheduler(conf=None) -> QueryScheduler:
                     conf.get(C.SCHED_TENANT_MAX_QUEUED))
                 s._default_hbm_share = float(
                     conf.get(C.SCHED_TENANT_HBM_SHARE))
+                s.preempt_enabled = bool(
+                    conf.get(C.SCHED_PREEMPT_ENABLED))
+                s.preempt_grace_s = float(
+                    conf.get(C.SCHED_PREEMPT_GRACE_MS)) / 1000.0
+                s.preempt_min_run_s = float(
+                    conf.get(C.SCHED_PREEMPT_MIN_RUN_MS)) / 1000.0
                 s._dispatch_locked()
                 s._cv.notify_all()
         return _scheduler

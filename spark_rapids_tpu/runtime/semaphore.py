@@ -31,6 +31,20 @@ _TM_ACQUIRE = TM.REGISTRY.histogram(
     "tpuq_semaphore_acquire_seconds",
     "per-acquire device-admission wait")
 
+# per-THREAD stack of live permits ({"sem", "tok", "released"}), most
+# recent last.  The preemption plane's suspend provider walks it to
+# hand a suspending query's permits back to the semaphore and take
+# them back on resume; ``release()`` pops it so a permit returned at
+# suspension is not double-released by the enclosing ``hold()``.
+_TLS = threading.local()
+
+
+def _tls_entries() -> list:
+    entries = getattr(_TLS, "entries", None)
+    if entries is None:
+        entries = _TLS.entries = []
+    return entries
+
 
 class DeviceSemaphore:
     """Counting semaphore with in-place resize + wait accounting.
@@ -72,25 +86,41 @@ class DeviceSemaphore:
         the uncontended fast path — only actual blocking counts, so an
         unconstrained run reports exactly zero wait).
 
-        The wait is deadline-aware and cancellable: it parks at most
-        the active CancelToken's poll interval per ``wait()`` (and
-        registers with the token so a cancel wakes it immediately),
-        raising ``QueryCancelled`` without admitting.  Wait accounting
-        uses the monotonic clock and sums only time actually spent
-        blocked in the condition wait — time awake between a spurious
-        wakeup and re-blocking is not wait (the old single start/stop
-        stamp inflated ``semaphoreWaitTime`` under contention)."""
+        The wait is deadline-aware, cancellable, AND preempt-aware: it
+        parks at most the active CancelToken's poll interval per
+        ``wait()`` (and registers with the token so a cancel or a
+        suspend request wakes it immediately), raising
+        ``QueryCancelled`` without admitting, and refusing admission
+        while the query's token has a suspend pending (a suspended
+        query must not re-enter the device behind the preemptor's
+        back).  The admitted permit is pushed on the calling thread's
+        permit stack so the preemption plane can hand it back at a
+        suspend and reacquire it on resume."""
         from spark_rapids_tpu.runtime import cancel
+        tok = cancel.current()
+        waited = self._wait_admit(tok)
+        _tls_entries().append(
+            {"sem": self, "tok": tok, "released": False})
+        return waited
+
+    def _wait_admit(self, tok) -> float:
+        """The wait loop + admission accounting (no permit-stack push)
+        — shared by ``acquire`` and the suspend provider's resume
+        reacquire.  Wait accounting uses the monotonic clock and sums
+        only time actually spent blocked in the condition wait — time
+        awake between a spurious wakeup and re-blocking is not wait
+        (the old single start/stop stamp inflated
+        ``semaphoreWaitTime`` under contention)."""
         from spark_rapids_tpu.runtime import trace
         waited = 0.0
-        tok = cancel.current()
         registered = False
         blocked = False
         wait_span = None
         try:
             with self._cv:
                 try:
-                    while self.holders >= self.permits:
+                    while (self.holders >= self.permits
+                           or (tok is not None and tok.preempt_pending())):
                         if not blocked:
                             blocked = True
                             self.waiting += 1
@@ -174,6 +204,25 @@ class DeviceSemaphore:
         self.begin_query_stats(None)
 
     def release(self) -> None:
+        """Return the calling thread's most recent permit for this
+        semaphore.  If that permit was already handed back at a
+        suspension (entry marked ``released`` by the preempt plane and
+        never reacquired — the query was cancelled mid-suspend) the
+        release is a no-op, keeping ``hold()`` balanced.  A release
+        with no matching stack entry (cross-thread release on another
+        thread's behalf — a legacy pattern some callers use) falls
+        through to the raw release."""
+        entries = _tls_entries()
+        for i in range(len(entries) - 1, -1, -1):
+            e = entries[i]
+            if e["sem"] is self:
+                entries.pop(i)
+                if e["released"]:
+                    return
+                break
+        self._release_raw()
+
+    def _release_raw(self) -> None:
         with self._cv:
             self.holders -= 1
             self._cv.notify()
@@ -220,6 +269,42 @@ def reset_semaphore() -> None:
     global _semaphore
     with _sem_lock:
         _semaphore = None
+
+
+# -- preemption suspend provider --------------------------------------
+# A suspending thread hands back every permit it holds for the
+# suspending query (oldest-first release order is irrelevant — they are
+# all returned) and reacquires them in original order on resume.  The
+# opaque state is the list of this thread's stack entries released.
+
+def _suspend_thread_permits(token):
+    entries = [e for e in _tls_entries()
+               if e["tok"] is token and not e["released"]]
+    if not entries:
+        return None
+    for e in entries:
+        e["released"] = True
+        e["sem"]._release_raw()
+    return entries
+
+
+def _resume_thread_permits(token, state):
+    from spark_rapids_tpu.runtime import cancel
+    for e in state:
+        try:
+            e["sem"]._wait_admit(token)
+        except cancel.QueryCancelled:
+            # permits stay released; the enclosing hold()s see the
+            # ``released`` flag and no-op their release, so the permit
+            # count stays balanced on the cancel path
+            return
+        e["released"] = False
+
+
+from spark_rapids_tpu.runtime import cancel as _cancel  # noqa: E402
+
+_cancel.register_suspend_provider(_suspend_thread_permits,
+                                  _resume_thread_permits)
 
 
 TM.REGISTRY.gauge(

@@ -431,6 +431,7 @@ class TelemetrySampler(threading.Thread):
 
     def run(self):
         flush_sinks(self.sink_path, self.prom_path)
+        # cancel-exempt: daemon sampler, no query scope — halts via its own event
         while not self._halt.wait(self.period_s):
             flush_sinks(self.sink_path, self.prom_path)
 

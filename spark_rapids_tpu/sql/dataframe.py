@@ -883,13 +883,30 @@ class DataFrame:
                             role, fl = cache_store.join_flight(ckey.key)
                             if role == "leader":
                                 flight = fl
+                                fl.leader_qid = qid
                             else:
                                 # another execution of this exact key is
                                 # in progress — wait for it, then
                                 # re-probe; compute ourselves if it
                                 # failed or skipped
+                                tok = cancel_mod.current()
                                 while not fl.done.wait(0.05):
                                     cancel_mod.check()
+                                    if tok is not None:
+                                        tok.preempt_point()
+                                    lq = fl.leader_qid
+                                    lt = (cancel_mod.get_token(lq)
+                                          if lq is not None else None)
+                                    if (lt is not None
+                                            and lt.preempt_pending()):
+                                        # the leader was preempted
+                                        # mid-flight; followers waiting
+                                        # on it while holding run slots
+                                        # would starve the scheduler of
+                                        # the very slot the leader needs
+                                        # to resume — break away and
+                                        # compute independently
+                                        break
                                 served = cache_store.lookup(ckey.key)
                                 if served is not None:
                                     cache_info = {"coalesced": True}

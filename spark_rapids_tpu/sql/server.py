@@ -106,14 +106,20 @@ class QueryServer:
         ``QueryRejected(reason=...)`` without side effects.  Higher
         ``priority`` drains first within the tenant; ``timeout_ms``
         deadlines the query from NOW — time spent queued counts, so a
-        deadline can expire a query that was never admitted."""
+        deadline can expire a query that was never admitted.
+
+        An out-of-range ``priority`` is rejected here with
+        ``QueryRejected(reason='bad_priority')`` — at the door, before
+        any token is minted or scheduler state touched."""
         from spark_rapids_tpu import conf as C
         from spark_rapids_tpu.runtime import cancel
+        from spark_rapids_tpu.runtime import scheduler as sched_mod
         from spark_rapids_tpu.runtime import trace
         with self._lock:
             if self._closed:
                 raise QueryRejected("server_shutdown", tenant=tenant,
                                     detail="QueryServer.shutdown() ran")
+        priority = sched_mod.check_priority(priority, tenant)
         conf = self.session.rapids_conf()
         qid = trace.next_query_id()
         eff = (timeout_ms if timeout_ms is not None
@@ -123,6 +129,7 @@ class QueryServer:
         token = cancel.CancelToken(
             qid, timeout_ms=eff,
             poll_ms=float(conf.get(C.CANCEL_POLL_MS)))
+        token.tenant = tenant   # HBM arbiter charges this tenant
         cancel.register(token)
         # result-cache admission check: a DataFrame submission whose
         # result key is already resident is served on THIS thread —

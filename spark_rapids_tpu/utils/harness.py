@@ -652,3 +652,409 @@ def run_rendezvous_cancel_chaos(nprocs: int = 3,
     hung = [i for i, r in enumerate(records) if r is None]
     assert not hung, f"rendezvous cancel participants hung: {hung}"
     return {"records": records, "cancel_elapsed": cancel_elapsed}
+
+
+# ---------------------------------------------------------------------------
+# preempt chaos harness: suspend mid-domain, resume, demand bit-identity
+# ---------------------------------------------------------------------------
+
+def run_preempt_chaos(df_builder: Callable[[TpuSession], "object"],
+                      inject: Dict[str, Tuple[int, int]],
+                      conf: Optional[Dict] = None,
+                      poll_ms: float = 50.0,
+                      seed: int = 0,
+                      timeout_s: float = 60.0) -> dict:
+    """Run one query with ``inject``'s domains armed, suspend it at a
+    randomized point while it is provably mid-domain (cooperative
+    preemption through the cancel plane's yield points), hold it parked
+    for a randomized interval, resume it, and let it finish.
+
+    Mirrors ``run_cancel_chaos``: the schedule keeps the query spinning
+    (large transient budget, backoff pinned to ~2x the poll interval)
+    so the worker thread lives inside yield points when the suspend
+    request lands.  The clean golden run (for the bit-identity
+    comparison) executes FIRST, deliberately: it warms the kernel
+    cache, so the chaos run's pump threads are never wedged inside a
+    multi-hundred-ms fresh compile when the suspend request lands and
+    the 2x-poll permit-drain bound is honest.  (Consequence: do not arm
+    the ``compile`` domain here — its injection points are pre-cached
+    away.  The result cache is pinned OFF for the chaos session so the
+    warm run cannot short-circuit it.)
+
+    Returns a record::
+
+        {"status": "completed" | "cancelled" | "error",
+         "error":       the raised exception, if any,
+         "fired":       the domain whose counter moved (None if raced),
+         "suspend_sent": True if suspend_query found the query in flight,
+         "suspended":   True if the token reached SUSPENDED,
+         "latency_s":   token-recorded request->parked latency,
+         "preempt_count": completed suspend/resume cycles on the token,
+         "sem_holders_during": semaphore holders once parked and
+                        drained (must be 0 for a lone query),
+         "sem_drain_s":  suspend request -> zero holders (all the
+                        query's pump threads yielded their permits),
+         "result", "golden": the two Arrow tables,
+         "leaks", "sem_holders", "spill_files": steady-state checks}
+    """
+    import os
+    import threading
+    import time
+
+    from spark_rapids_tpu.runtime import cancel as CN
+    from spark_rapids_tpu.runtime import memory as M
+    from spark_rapids_tpu.runtime import resilience as R
+    from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+
+    backoff_ms = max(int(2 * poll_ms), 1)
+    full: Dict = {
+        "spark.rapids.tpu.query.cancelPollMs": int(poll_ms),
+        "spark.rapids.tpu.retry.backoffBaseMs": backoff_ms,
+        "spark.rapids.tpu.retry.backoffMaxMs": backoff_ms,
+        "spark.rapids.tpu.retry.maxAttempts": 1_000_000,
+        "spark.rapids.tpu.retry.budgetPerQuery": 0,  # unlimited
+    }
+    full.update(conf or {})
+    for d, (at, budget) in inject.items():
+        full[f"spark.rapids.tpu.test.inject.{d}.at"] = at
+        full[f"spark.rapids.tpu.test.inject.{d}.transientCount"] = budget
+    full["spark.rapids.tpu.cache.enabled"] = False
+    R.INJECTOR.reset()
+    CN.reset()
+    golden = df_builder(tpu_session(dict(conf or {}))).toArrow()
+    s = tpu_session(full)
+    df = df_builder(s)
+    base = dict(R._TM_INJECTED.child_values())
+    box: Dict = {}
+
+    def run():
+        try:
+            box["result"] = df.toArrow()
+        except BaseException as e:
+            box["error"] = e
+
+    worker = threading.Thread(target=run, daemon=True,
+                              name="tpuq-preempt-chaos-query")
+    worker.start()
+    # wait until the query is demonstrably inside an armed domain
+    fired = None
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline and worker.is_alive():
+        cur = R._TM_INJECTED.child_values()
+        fired = next((d for d in inject
+                      if cur.get(d, 0) > base.get(d, 0)), None)
+        if fired is not None:
+            break
+        time.sleep(0.002)
+    rnd = random.Random(seed)
+    time.sleep(rnd.uniform(0.0, backoff_ms / 1000.0))
+    active = CN.active_queries()
+    qid = active[0] if active else None
+    tok = CN.get_token(qid) if qid is not None else None
+    t_req = time.monotonic()
+    suspend_sent = qid is not None and CN.suspend_query(
+        qid, detail=f"preempt-chaos mid-{fired or 'unknown'}")
+    # observe the park: ``suspended()`` flips when the FIRST pump
+    # thread parks; sibling pump threads may still be between yield
+    # points holding permits, so the permit-drain clock keeps running
+    # until holders hits zero (this is a lone query — nobody else can
+    # be holding)
+    suspended = False
+    sem_holders_during = None
+    sem_drain_s = None
+    if suspend_sent and tok is not None:
+        park_deadline = time.monotonic() + timeout_s
+        while time.monotonic() < park_deadline and worker.is_alive():
+            if tok.suspended():
+                suspended = True
+                break
+            time.sleep(0.001)
+        if suspended:
+            sem = peek_semaphore()
+            while (sem is not None and sem.holders > 0
+                   and time.monotonic() < park_deadline):
+                time.sleep(0.001)
+            sem_drain_s = time.monotonic() - t_req
+            sem_holders_during = sem.holders if sem is not None else 0
+            # hold it parked across a few poll intervals, then resume
+            time.sleep(rnd.uniform(0.0, 2 * backoff_ms / 1000.0))
+            CN.resume_query(qid)
+        elif tok.preempt_pending():
+            # raced query completion before any yield point: withdraw
+            CN.resume_query(qid)
+    worker.join(timeout=timeout_s)
+    R.INJECTOR.reset()
+    assert not worker.is_alive(), (
+        f"query failed to resume within {timeout_s}s "
+        f"(mid-{fired}; suspended={suspended})")
+    err = box.get("error")
+    if isinstance(err, CN.QueryCancelled):
+        status = "cancelled"
+    elif err is not None:
+        status = "error"
+    else:
+        status = "completed"
+    # a COMPLETED query legitimately leaves scan-cache residency alive
+    # (shared, table-lifetime) — under pressure confs it sits spilled
+    # on disk.  Evict it so "stranded spill files" below means actual
+    # orphans, not the cache doing its job.
+    from spark_rapids_tpu.exec.basic import clear_scan_cache
+    clear_scan_cache()
+    mgr = M.peek_manager()
+    sem = peek_semaphore()
+    spill_files = []
+    if mgr is not None and os.path.isdir(mgr.spill_path):
+        spill_files = sorted(os.listdir(mgr.spill_path))
+    return {
+        "status": status,
+        "error": err,
+        "fired": fired,
+        "suspend_sent": suspend_sent,
+        "suspended": suspended,
+        "latency_s": tok.suspend_latency_s if tok is not None else None,
+        "preempt_count": tok.preempt_count if tok is not None else 0,
+        "sem_holders_during": sem_holders_during,
+        "sem_drain_s": sem_drain_s,
+        "result": box.get("result"),
+        "golden": golden,
+        "leaks": mgr.report_leaks() if mgr is not None else 0,
+        "sem_holders": sem.holders if sem is not None else 0,
+        "spill_files": spill_files,
+    }
+
+
+def assert_preempt_invariant(
+        df_builder: Callable[[TpuSession], "object"],
+        inject: Dict[str, Tuple[int, int]],
+        conf: Optional[Dict] = None,
+        poll_ms: float = 50.0,
+        seed: int = 0) -> dict:
+    """Assert THE preemption invariant for one query + injection
+    schedule: a suspend fired mid-domain parks the query within 2x
+    ``cancelPollMs`` with every semaphore permit released; after resume
+    the query completes **bit-identical** to an unpreempted run of the
+    same plan, and the engine is back at a clean steady state — zero
+    leaked spillables, zero semaphore holders, an empty spill dir."""
+    from spark_rapids_tpu.utils.asserts import assert_tables_equal
+
+    rec = run_preempt_chaos(df_builder, inject, conf=conf,
+                            poll_ms=poll_ms, seed=seed)
+    assert rec["suspend_sent"], (
+        f"query finished before the suspend could fire "
+        f"(mid-{rec['fired']}): {rec['status']}")
+    assert rec["suspended"], (
+        f"suspend requested mid-{rec['fired']} but the query never "
+        f"parked: {rec['status']} ({rec['error']!r})")
+    assert rec["status"] == "completed", (
+        f"expected clean completion after resume, got "
+        f"{rec['status']}: {rec['error']!r}")
+    assert rec["latency_s"] is not None, "no suspend latency recorded"
+    bound = 2.0 * poll_ms / 1000.0
+    assert rec["latency_s"] < bound, (
+        f"suspend latency {rec['latency_s']:.3f}s >= 2x cancelPollMs "
+        f"({bound:.3f}s) mid-{rec['fired']}")
+    assert rec["sem_holders_during"] == 0, (
+        f"{rec['sem_holders_during']} semaphore permits still held "
+        f"while SUSPENDED — preemption must release the device")
+    assert rec["sem_drain_s"] is not None and rec["sem_drain_s"] < bound, (
+        f"permits drained {rec['sem_drain_s']}s after the suspend "
+        f"request (>= 2x cancelPollMs {bound:.3f}s) mid-{rec['fired']}")
+    assert_tables_equal(rec["golden"], rec["result"])
+    assert rec["leaks"] == 0, (
+        f"{rec['leaks']} spillables leaked after preempt cycle "
+        f"mid-{rec['fired']}")
+    assert rec["sem_holders"] == 0, (
+        f"{rec['sem_holders']} semaphore holders after preempt cycle")
+    assert not rec["spill_files"], (
+        f"spill files stranded after preempt cycle: "
+        f"{rec['spill_files']}")
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# tenancy soak: sustained mixed hot/cold multi-tenant load
+# ---------------------------------------------------------------------------
+
+def _pctile(sorted_vals, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list (0 when
+    empty)."""
+    if not sorted_vals:
+        return 0.0
+    k = min(len(sorted_vals) - 1,
+            max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[k]
+
+
+def run_tenancy_soak(duration_s: float = 3.0,
+                     in_flight: int = 8,
+                     tenants: Optional[Dict[str, dict]] = None,
+                     conf: Optional[Dict] = None,
+                     seed: int = 0,
+                     timeout_s: float = 120.0,
+                     make_query: Optional[Callable] = None) -> dict:
+    """Sustained-load soak for the preemptive-tenancy planes: keep
+    ``in_flight`` submissions outstanding across mixed hot/cold tenants
+    through a ``QueryServer`` for ``duration_s``, resubmitting as
+    completions land, then drain.
+
+    ``tenants`` maps name -> spec: ``{"priority": int, "hot": bool,
+    "rows": int}``.  Hot tenants resubmit the SAME plan (result-cache
+    hits once warm); cold tenants vary the plan every submission.
+    Preemption, HBM-share enforcement, and the result cache run with
+    whatever the caller's ``conf`` enables.  ``make_query(session,
+    name, spec, rnd, i) -> DataFrame | zero-arg callable`` overrides
+    the default ``session.range`` workload (the bench drives TPC-H
+    plans through it).
+
+    Returns a record::
+
+        {"duration_s", "in_flight",
+         "tenants": {name: {"submitted", "completed", "errors",
+                            "rejected", "p50_ms", "p99_ms"}},
+         "outcomes": {"ok": n, "cancelled": n, "error": n},
+         "errors":  [the non-cancel exceptions, if any],
+         "preempt": {"requests", "suspended", "resumed"}  (TM deltas),
+         "hbm_breaches": tenant HBM budget breaches (manager metric),
+         "sched_stats": per-tenant scheduler accounting,
+         "zero_deadlock": every submission drained inside timeout_s,
+         "zero_leak": no spillables/permits/spill files left behind,
+         "ledgers_closed": every recorded attribution ledger closed}
+    """
+    import time
+
+    from spark_rapids_tpu.runtime import cancel as CN
+    from spark_rapids_tpu.runtime import memory as M
+    from spark_rapids_tpu.runtime import scheduler as SCH
+    from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+    from spark_rapids_tpu.sql.server import QueryRejected, QueryServer
+
+    tenants = tenants or {
+        "hot-a": {"priority": 0, "hot": True, "rows": 2048},
+        "hot-b": {"priority": 0, "hot": True, "rows": 3072},
+        "cold-a": {"priority": 0, "hot": False, "rows": 4096},
+        "urgent": {"priority": 10, "hot": False, "rows": 1024},
+    }
+    full: Dict = {
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 2,
+        "spark.rapids.tpu.scheduler.preempt.enabled": True,
+        "spark.rapids.tpu.scheduler.preempt.graceMs": 50,
+        "spark.rapids.tpu.scheduler.preempt.minRunMs": 10,
+        "spark.rapids.tpu.query.cancelPollMs": 20,
+        "spark.rapids.tpu.retry.backoffBaseMs": 0,
+        "spark.rapids.tpu.cache.enabled": True,
+    }
+    full.update(conf or {})
+    CN.reset()
+    SCH.reset_scheduler()
+    s = tpu_session(full)
+    server = QueryServer(s)
+    rnd = random.Random(seed)
+    names = sorted(tenants)
+    per = {n: {"submitted": 0, "completed": 0, "errors": 0,
+               "rejected": 0, "lat": []} for n in names}
+    outcomes = {"ok": 0, "cancelled": 0, "error": 0}
+    errors: list = []
+    pending: list = []
+    pre_req = CN._TM_PREEMPT_REQ.value
+    pre_sus = CN._TM_PREEMPT_SUSPENDED.value
+    pre_res = CN._TM_PREEMPT_RESUMED.value
+    mgr0 = M.peek_manager()
+    breaches0 = mgr0.metrics["tenantBreaches"] if mgr0 is not None else 0
+    counter = [0]
+
+    def submit_one() -> None:
+        name = names[counter[0] % len(names)]
+        i = counter[0]
+        counter[0] += 1
+        spec = tenants[name]
+        if make_query is not None:
+            build = make_query(s, name, spec, rnd, i)
+        else:
+            rows = int(spec.get("rows", 2048))
+            if not spec.get("hot"):
+                rows += 64 * rnd.randint(0, 63)  # vary: cache-cold
+
+            def build(rows=rows):
+                return s.range(rows, numPartitions=2)
+
+        try:
+            h = server.submit(build, tenant=name,
+                              priority=int(spec.get("priority", 0)))
+            per[name]["submitted"] += 1
+            pending.append((h, name))
+        except QueryRejected:
+            per[name]["rejected"] += 1
+
+    def reap(h, name) -> None:
+        if h.state == "OK":
+            outcomes["ok"] += 1
+        elif h.state == "CANCELLED":
+            outcomes["cancelled"] += 1
+        else:
+            outcomes["error"] += 1
+            per[name]["errors"] += 1
+            errors.append(h.error)
+        per[name]["completed"] += 1
+        if h.wall_s is not None:
+            per[name]["lat"].append(h.wall_s)
+
+    for _ in range(in_flight):
+        submit_one()
+    deadline = time.monotonic() + duration_s
+    while time.monotonic() < deadline:
+        done_now = [(h, n) for h, n in pending if h.done.is_set()]
+        for h, n in done_now:
+            pending.remove((h, n))
+            reap(h, n)
+            if time.monotonic() < deadline:
+                submit_one()
+        if not done_now:
+            time.sleep(0.002)
+    # drain
+    zero_deadlock = True
+    drain_deadline = time.monotonic() + timeout_s
+    for h, n in pending:
+        if not h.done.wait(timeout=max(
+                0.0, drain_deadline - time.monotonic())):
+            zero_deadlock = False
+            continue
+        reap(h, n)
+    sched_stats = server.stats()
+    sched = SCH.peek_scheduler()
+    server.shutdown()
+    if sched is not None and (sched.queued_total or sched.running_total):
+        zero_deadlock = False
+    mgr = M.peek_manager()
+    sem = peek_semaphore()
+    import os
+    spill_files = []
+    if mgr is not None and os.path.isdir(mgr.spill_path):
+        spill_files = sorted(os.listdir(mgr.spill_path))
+    zero_leak = ((mgr.report_leaks() if mgr is not None else 0) == 0
+                 and (sem.holders if sem is not None else 0) == 0
+                 and not spill_files)
+    entries = s.query_history()
+    closed = [bool((e.get("attribution") or {}).get("closed", True))
+              for e in entries]
+    for n in names:
+        lat = sorted(per[n].pop("lat"))
+        per[n]["p50_ms"] = round(_pctile(lat, 0.50) * 1000.0, 3)
+        per[n]["p99_ms"] = round(_pctile(lat, 0.99) * 1000.0, 3)
+    return {
+        "duration_s": duration_s,
+        "in_flight": in_flight,
+        "tenants": per,
+        "outcomes": outcomes,
+        "errors": errors,
+        "preempt": {
+            "requests": CN._TM_PREEMPT_REQ.value - pre_req,
+            "suspended": CN._TM_PREEMPT_SUSPENDED.value - pre_sus,
+            "resumed": CN._TM_PREEMPT_RESUMED.value - pre_res,
+        },
+        "hbm_breaches": ((mgr.metrics["tenantBreaches"]
+                          if mgr is not None else 0) - breaches0),
+        "sched_stats": sched_stats,
+        "zero_deadlock": zero_deadlock,
+        "zero_leak": zero_leak,
+        "ledgers_closed": all(closed) if closed else True,
+    }

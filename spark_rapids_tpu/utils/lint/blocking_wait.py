@@ -8,6 +8,18 @@ poll-interval guarantee) or a plain ``time.sleep(...)`` (should be
 ``cancel.sleep`` / a token-bounded wait).  AST-exact: a ``.wait()``
 inside a string or comment no longer counts, and ``wait(timeout=None)``
 — which the regex missed — now does.
+
+The preemption plane adds a second requirement in runtime/: a BOUNDED
+``.wait(timeout=...)`` is only half the contract.  Waking up on time is
+useless if the waking function never consults the query token — the
+thread rides straight back into the wait and a suspend request (or a
+cancel) parks unobserved until some other yield point.  So any function
+in runtime/ containing a bounded ``.wait`` must also poll the token:
+call one of ``check`` / ``preempt_point`` / ``preempt_pending`` /
+``wait_interval`` somewhere in the same function (``wait_interval``
+counts because deriving the timeout from the token is exactly the
+poll-interval contract).  Daemon/shim waits with no query scope stay
+``# cancel-exempt`` with a reason, as before.
 """
 
 from __future__ import annotations
@@ -19,10 +31,23 @@ from spark_rapids_tpu.utils.lint import Finding, Rule, SourceModule
 
 SCOPES = ("runtime", "parallel")
 
+#: runtime/-only: bounded waits must live in a token-polling function
+PREEMPT_SCOPES = ("runtime",)
+
+#: any of these called anywhere in the function counts as polling the
+#: query token around the wait
+POLL_CALLS = frozenset(
+    {"check", "preempt_point", "preempt_pending", "wait_interval"})
+
 
 def _in_scope(rel: str) -> bool:
     parts = rel.replace("\\", "/").split("/")
     return any(p in SCOPES for p in parts[:-1])
+
+
+def _in_preempt_scope(rel: str) -> bool:
+    parts = rel.replace("\\", "/").split("/")
+    return any(p in PREEMPT_SCOPES for p in parts[:-1])
 
 
 def _is_unbounded_wait(call: ast.Call) -> bool:
@@ -42,6 +67,44 @@ def _is_plain_sleep(call: ast.Call) -> bool:
     f = call.func
     return (isinstance(f, ast.Attribute) and f.attr == "sleep"
             and isinstance(f.value, ast.Name) and f.value.id == "time")
+
+
+def _is_bounded_wait(call: ast.Call) -> bool:
+    """``x.wait(<non-None timeout>)`` — bounded, so cancel-legal, but
+    the enclosing function must still poll the token (see module
+    docstring)."""
+    f = call.func
+    if not (isinstance(f, ast.Attribute) and f.attr == "wait"):
+        return False
+    return not _is_unbounded_wait(call)
+
+
+def _call_name(call: ast.Call) -> str:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return ""
+
+
+def _own_calls(fn: ast.AST) -> List[ast.Call]:
+    """Call nodes lexically inside ``fn`` but outside any nested
+    function — a nested function's waits are judged against the nested
+    function's own polling."""
+    out: List[ast.Call] = []
+
+    def walk(node, root=False):
+        if not root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(node, ast.Call):
+            out.append(node)
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(fn, root=True)
+    return out
 
 
 class BlockingWaitRule(Rule):
@@ -64,4 +127,29 @@ class BlockingWaitRule(Rule):
                     self.name, mod.rel, node.lineno,
                     "plain time.sleep — use cancel.sleep / a "
                     f"token-bounded wait (`{mod.snippet(node.lineno)}`)"))
+        if _in_preempt_scope(mod.rel):
+            out.extend(self._check_preempt_aware(mod))
+        return out
+
+    def _check_preempt_aware(self, mod: SourceModule
+                             ) -> Iterable[Finding]:
+        """runtime/ bounded waits must sit in a token-polling function
+        (module-level waits have no query scope and are skipped — the
+        unbounded/plain-sleep checks above still cover them)."""
+        out: List[Finding] = []
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            calls = _own_calls(fn)
+            if any(_call_name(c) in POLL_CALLS for c in calls):
+                continue
+            for call in calls:
+                if _is_bounded_wait(call):
+                    out.append(Finding(
+                        self.name, mod.rel, call.lineno,
+                        "preempt-unaware bounded wait — poll the query "
+                        "token (check/preempt_point/wait_interval) "
+                        "around the wait so a suspend request lands "
+                        f"(`{mod.snippet(call.lineno)}`)"))
         return out

@@ -108,14 +108,15 @@ def test_finding_str_format():
 
 def test_blocking_wait_flags_bare_and_none_timeout():
     m = _mod("spark_rapids_tpu/runtime/x.py", """
-        def f(cv):
+        def f(cv, tok):
+            tok.check()
             cv.wait()
             cv.wait(timeout=None)
             cv.wait(0.1)
             cv.wait(timeout=2.0)
         """)
     lines = [f.line for f in _run([BlockingWaitRule()], m)]
-    assert lines == [3, 4]
+    assert lines == [4, 5]
 
 
 def test_blocking_wait_out_of_scope_dir_ignored():
@@ -130,6 +131,48 @@ def test_blocking_wait_string_literal_not_flagged():
     # the regex predecessor counted matches inside strings
     m = _mod("spark_rapids_tpu/runtime/x.py", """
         DOC = "call cv.wait() and time.sleep(1) at your peril"
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+# -- preempt-safety: bounded waits in runtime/ must poll the token ----------
+
+def test_preempt_safety_flags_pollless_bounded_wait():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        def f(cv):
+            while True:
+                cv.wait(timeout=0.1)
+        """)
+    out = _run([BlockingWaitRule()], m)
+    assert [f.line for f in out] == [4]
+    assert "preempt-unaware" in out[0].message
+
+
+def test_preempt_safety_token_polling_function_is_clean():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        def f(cv, tok):
+            while not done():
+                tok.check()
+                cv.wait(timeout=tok.wait_interval())
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_preempt_safety_cancel_exempt_honored():
+    m = _mod("spark_rapids_tpu/runtime/x.py", """
+        def f(halt):
+            # cancel-exempt: daemon thread, no query scope
+            halt.wait(1.0)
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_preempt_safety_parallel_scope_not_checked():
+    # the preempt-aware check is runtime/-only; parallel/ keeps the
+    # original bounded-wait-is-fine contract
+    m = _mod("spark_rapids_tpu/parallel/x.py", """
+        def f(cv):
+            cv.wait(timeout=0.1)
         """)
     assert _run([BlockingWaitRule()], m) == []
 

@@ -225,6 +225,28 @@ def test_streamed_join_small_left_side():
     assert out.num_rows == exp.num_rows
 
 
+@pytest.mark.parametrize("how", ["left_semi", "left_anti"])
+def test_semi_stream_right_oversized_right_side(how):
+    """Regression: semi/anti with a small left and an oversized right
+    routes to ``_semi_stream_right``, which was referenced but never
+    defined (AttributeError on TPC-H q4 SF1).  The streamed path must
+    OR-accumulate matches across bounded right groups and agree with
+    the in-core oracle."""
+    l, r = _join_tables(n=3_000, m=30_000, seed=47)
+    conf = {"spark.sql.autoBroadcastJoinThreshold": 0,
+            "spark.rapids.tpu.join.targetRows": 4096,
+            "spark.rapids.tpu.batchRows": 8192}
+    assert_tpu_and_cpu_are_equal_collect(
+        lambda s: s.createDataFrame(l).join(s.createDataFrame(r), "k",
+                                            how),
+        conf=conf, ignore_order=True, approx_float=True)
+    s = tpu_session(conf)
+    df = s.createDataFrame(l).join(s.createDataFrame(r), "k", how)
+    df.toArrow()
+    j = _find(df._last_plan, "TpuSortMergeJoinExec")
+    assert j.metric("streamedJoins").value == 1
+
+
 def test_skewed_sub_partition_recurses_and_matches():
     """Low-cardinality keys defeat one split level; the re-split with a
     fresh seed (and, for a single hot key, the bounded-depth in-core

@@ -1,0 +1,282 @@
+"""Cooperative preemption tests: the suspend/resume half of the
+cancel plane.
+
+Coverage map over runtime/cancel.py (the PreemptToken states),
+runtime/semaphore.py (permit release on park, admission refusal while
+a suspend is pending), runtime/memory.py (per-tenant HBM enforcement:
+spill-first, then breach), and the chaos harness:
+
+* token state machine — RUN -> SUSPEND_REQUESTED -> SUSPENDED ->
+  RESUMED transitions; first request wins; cancel beats suspend both
+  ways (a cancelled token refuses suspension, a suspended token still
+  honors cancel).
+* bit-identity across the nasty-generator matrix — a query suspended
+  provably mid-domain (the armed injection counter moved first),
+  parked across several poll intervals, then resumed, must produce a
+  result **bit-identical** to the unpreempted golden run: skewed-key
+  aggregation, null-heavy skewed shuffle, string-heavy groupBy, and a
+  suspend landing mid-``spill_write``.
+* the 2x-poll bound — every matrix entry also asserts the suspend
+  parked within ``2 x cancelPollMs`` with every device-semaphore
+  permit released (``assert_preempt_invariant`` measures the drain
+  from the suspend request, not an instant sample).
+* HBM-share enforcement — a tenant over its ``hbmShare`` byte budget
+  first spills its OWN device residency (no breach counted); only
+  when its residency cannot cover the shortfall does the reserve
+  breach: ``tenantBreaches`` increments and ``RetryOOM`` carries the
+  tenant and budget in its message.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.columnar.column import host_to_device
+from spark_rapids_tpu.runtime import cancel as CN
+from spark_rapids_tpu.runtime import memory as M
+from spark_rapids_tpu.runtime import resilience as R
+from spark_rapids_tpu.runtime import scheduler as SCH
+from spark_rapids_tpu.runtime import semaphore as SEM
+from spark_rapids_tpu.sql import functions as F
+from spark_rapids_tpu.sql.column import col
+from spark_rapids_tpu.utils import harness as H
+from spark_rapids_tpu.utils.datagen import (
+    SkewedLongGen, StringGen, gen_table, skewed_null_table)
+from spark_rapids_tpu.utils.harness import tpu_session
+
+pytestmark = pytest.mark.chaos
+
+POLL_MS = 50.0
+
+
+@pytest.fixture(autouse=True)
+def _clean_service_state():
+    R.INJECTOR.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    SEM.reset_semaphore()
+    M.reset_manager()
+    yield
+    R.INJECTOR.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    SEM.reset_semaphore()
+    M.reset_manager()
+
+
+# ---------------------------------------------------------------------------
+# token state machine
+# ---------------------------------------------------------------------------
+
+def test_preempt_token_state_machine():
+    tok = CN.CancelToken(1, poll_ms=10.0)
+    assert not tok.preempt_pending() and not tok.suspended()
+    assert tok.request_suspend("test")          # RUN -> SUSPEND_REQUESTED
+    assert tok.preempt_pending()
+    assert not tok.request_suspend("again")     # first request wins
+    assert tok.resume()                         # -> RESUMED
+    assert not tok.preempt_pending()
+    assert not tok.resume()                     # nothing pending
+    assert tok.request_suspend("second cycle")  # RESUMED -> requested again
+
+
+def test_cancel_beats_suspend():
+    tok = CN.CancelToken(2, poll_ms=10.0)
+    tok.cancel("user")
+    assert not tok.request_suspend("too late"), \
+        "a cancelled token must refuse suspension"
+    tok2 = CN.CancelToken(3, poll_ms=10.0)
+    assert tok2.request_suspend("park it")
+    tok2.cancel("user")
+    with pytest.raises(CN.QueryCancelled):
+        tok2.check()
+
+
+def test_preempt_point_fast_path_is_noop():
+    tok = CN.CancelToken(4, poll_ms=10.0)
+    tok.preempt_point()  # no suspend pending: must return immediately
+    assert tok.preempt_count == 0
+
+
+# ---------------------------------------------------------------------------
+# bit-identity across the nasty-generator matrix
+# ---------------------------------------------------------------------------
+
+_SKEW_AGG = gen_table(
+    [SkewedLongGen(hot_keys=1, hot_mass=0.9, distinct=10_000,
+                   nullable=False),
+     SkewedLongGen(hot_keys=3, hot_mass=0.5, distinct=64,
+                   nullable=False)],
+    4_000, seed=21, names=["k", "v"])
+
+_NULL_SKEW = skewed_null_table(4_000, seed=22, hot_mass=0.9,
+                               null_ratio=0.4)
+
+_STRINGS = gen_table(
+    [StringGen(min_len=1, max_len=24, null_ratio=0.2),
+     SkewedLongGen(hot_mass=0.8, nullable=False)],
+    3_000, seed=23, names=["s", "v"])
+
+
+def q_skew_agg(s):
+    return (s.createDataFrame(_SKEW_AGG)
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.count("k").alias("c")))
+
+
+def q_null_shuffle(s):
+    return (s.createDataFrame(_NULL_SKEW).repartition(6, "k")
+            .filter(col("v") > -2.5)
+            .groupBy("k").agg(F.sum("v").alias("sv"),
+                              F.count("s").alias("cs")))
+
+
+def q_string_group(s):
+    return (s.createDataFrame(_STRINGS)
+            .groupBy("s").agg(F.sum("v").alias("sv")))
+
+
+@pytest.mark.parametrize("name,builder", [
+    ("skew_agg", q_skew_agg),
+    ("null_skew_shuffle", q_null_shuffle),
+    ("string_group", q_string_group),
+])
+def test_preempt_bit_identity_nasty(name, builder):
+    # finite transient budget: unlike cancel chaos (where the cancel
+    # ends the spin), a preempted query must COMPLETE after resume —
+    # ~24 transients keep it in-domain for ~2s of backoff, plenty to
+    # land the suspend, then the injection budget drains and the query
+    # finishes clean
+    rec = H.assert_preempt_invariant(
+        builder, {"execute": (1, 24)},
+        poll_ms=POLL_MS, seed=hash(name) % 1000)
+    assert rec["fired"] == "execute"
+    assert rec["preempt_count"] >= 1
+
+
+def test_preempt_mid_spill_write():
+    """Suspend while the query is inside the spill_write domain: the
+    suspend-spill path composes with pressure-driven spilling, and the
+    resumed query still reproduces the golden result bit-identically
+    with the spill dir empty afterwards."""
+    big = skewed_null_table(20_000, seed=24, null_ratio=0.3)
+    bb = host_to_device(big).nbytes()
+    conf = {
+        "spark.rapids.tpu.memory.poolSize": int(bb // 3),
+        "spark.rapids.memory.host.spillStorageSize": 1,
+        "spark.rapids.tpu.batchRows": 4000,
+    }
+
+    def builder(s):
+        return (s.createDataFrame(big).filter(col("v") > -3.0)
+                .groupBy("k").agg(F.sum("v").alias("sv")))
+
+    rec = H.assert_preempt_invariant(
+        builder, {"spill_write": (1, 24)}, conf=conf,
+        poll_ms=POLL_MS, seed=31)
+    assert rec["fired"] == "spill_write"
+
+
+# ---------------------------------------------------------------------------
+# semaphore: pending suspend refuses new admissions
+# ---------------------------------------------------------------------------
+
+def test_semaphore_refuses_admission_while_suspend_pending():
+    """A token with a suspend pending cannot acquire NEW device
+    permits — the wait predicate treats ``preempt_pending()`` like a
+    full semaphore, so a suspending query drains instead of re-arming
+    itself."""
+    import threading
+    sem = SEM.DeviceSemaphore(4)
+    tok = CN.CancelToken(11, poll_ms=5.0)
+    tok.request_suspend("hold the door")
+    admitted = threading.Event()
+
+    def try_acquire():
+        with CN.bind(tok):
+            sem.acquire()
+            admitted.set()
+            sem.release()
+
+    t = threading.Thread(target=try_acquire, daemon=True)
+    t.start()
+    assert not admitted.wait(0.15), \
+        "semaphore admitted a query whose suspend is pending"
+    tok.resume()
+    assert admitted.wait(2.0), "resume did not unblock the waiter"
+    t.join(timeout=2.0)
+    assert sem.holders == 0
+
+
+# ---------------------------------------------------------------------------
+# HBM-share enforcement: spill-first, then breach
+# ---------------------------------------------------------------------------
+
+def _mgr_with_share(tenant: str, share: float, pool: int = 1 << 20):
+    s = tpu_session({
+        "spark.rapids.tpu.memory.poolSize": pool,
+        f"spark.rapids.tpu.scheduler.tenant.{tenant}.hbmShare": share,
+    })
+    return M.get_manager(s.rapids_conf())
+
+
+def test_tenant_hbm_spill_first_no_breach():
+    """Over-share tenant with spillable device residency: the reserve
+    spills the tenant's OWN batches host-side and succeeds — no breach
+    counted, nobody else disturbed."""
+    mgr = _mgr_with_share("small", 0.25, pool=1 << 20)
+    budget = mgr._tenant_budget("small")
+    tok = CN.CancelToken(21, poll_ms=10.0)
+    tok.tenant = "small"
+    rng = np.random.default_rng(0)
+    n = max(budget // 16, 1024)
+    with CN.bind(tok):
+        b = host_to_device(pa.table({"v": rng.normal(size=n)}))
+        sp = M.SpillableBatch(b, mgr)
+    assert mgr.tenant_usage().get("small", 0) > 0
+    before = mgr.metrics["tenantBreaches"]
+    # second reservation pushes past the share: the registered batch
+    # must spill to host to make room, not breach
+    mgr.reserve(budget - (budget // 4), tenant="small")
+    assert sp.tier == "host", "tenant's own residency did not spill"
+    assert mgr.metrics["tenantBreaches"] == before
+    mgr.release(budget - (budget // 4), tenant="small")
+    sp.close()
+
+
+def test_tenant_hbm_breach_counts_and_raises():
+    """Nothing left to spill and still over the share: the reserve
+    breaches — ``tenantBreaches`` increments, ``RetryOOM`` names the
+    tenant and its byte budget, and the global pool is NOT charged."""
+    mgr = _mgr_with_share("small", 0.25, pool=1 << 20)
+    budget = mgr._tenant_budget("small")
+    before = mgr.metrics["tenantBreaches"]
+    reserved_before = mgr._reserved
+    with pytest.raises(M.RetryOOM, match="small"):
+        mgr.reserve(budget + 1, tenant="small")
+    assert mgr.metrics["tenantBreaches"] == before + 1
+    assert mgr._reserved == reserved_before
+    assert mgr.tenant_usage().get("small", 0) == 0
+
+
+def test_tenant_hbm_breach_requests_preemption():
+    """A breach escalates to the scheduler: the over-share tenant's
+    largest-runtime OTHER running query gets a suspend request so its
+    reservations unwind."""
+    sched = SCH.get_scheduler(tpu_session({
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 2,
+        "spark.rapids.tpu.scheduler.preempt.enabled": True,
+        "spark.rapids.tpu.scheduler.preempt.minRunMs": 0,
+    }).rapids_conf())
+    victim_tok = CN.CancelToken(31, poll_ms=10.0)
+    victim_tok.tenant = "small"
+    ticket = sched.submit(31, tenant="small", token=victim_tok)
+    assert ticket.state == SCH.RUNNING
+    mgr = _mgr_with_share("small", 0.25, pool=1 << 20)
+    budget = mgr._tenant_budget("small")
+    with pytest.raises(M.RetryOOM):
+        mgr.reserve(budget + 1, tenant="small")
+    assert victim_tok.preempt_pending(), \
+        "breach did not escalate to preemption of the tenant's query"
+    victim_tok.resume()
+    sched.release(ticket)

@@ -437,3 +437,129 @@ def test_scheduler_soak_randomized_chaos(seed):
     assert out["leaks"] == 0 and out["sem_holders"] == 0
     assert out["queued"] == 0 and out["running"] == 0
     H.assert_fairness_invariant(out["stats"])
+
+
+# ---------------------------------------------------------------------------
+# priority validation at both doors
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("priority", [-101, 101, "high", None, 2.5])
+def test_bad_priority_rejected_at_scheduler_door(priority):
+    """``QueryScheduler.submit`` rejects out-of-range / non-int
+    priorities with ``reason='bad_priority'`` BEFORE touching any
+    scheduler state — no ticket, no queue entry, no tenant lane."""
+    sched = QueryScheduler(sched_conf())
+    with pytest.raises(QueryRejected) as exc:
+        sched.submit(7001, tenant="t", priority=priority)
+    assert exc.value.reason == "bad_priority"
+    assert sched.queued_total == 0 and sched.running_total == 0
+    assert "t" not in sched.stats()
+
+
+@pytest.mark.parametrize("priority", [-101, 101])
+def test_bad_priority_rejected_at_server_door(priority):
+    """``QueryServer.submit`` rejects at ITS door too — before a
+    cancel token is minted or a query id enters the active registry."""
+    from spark_rapids_tpu.sql.server import QueryServer
+    s = H.tpu_session({})
+    server = QueryServer(s)
+    try:
+        with pytest.raises(QueryRejected) as exc:
+            server.submit(lambda: s.range(16), tenant="t",
+                          priority=priority)
+        assert exc.value.reason == "bad_priority"
+        assert CN.active_queries() == []
+        assert server.active_queries() == []
+    finally:
+        server.shutdown()
+
+
+def test_priority_bounds_inclusive():
+    """±100 are valid; the rejection is strictly outside the range."""
+    sched = QueryScheduler(sched_conf(**{
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 4}))
+    lo = sched.submit(7002, tenant="t", priority=-100)
+    hi = sched.submit(7003, tenant="t", priority=100)
+    assert lo.priority == -100 and hi.priority == 100
+    sched.release(lo)
+    sched.release(hi)
+
+
+# ---------------------------------------------------------------------------
+# the preemption arbiter
+# ---------------------------------------------------------------------------
+
+def _preempt_sched(**over):
+    raw = {
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.tpu.scheduler.preempt.enabled": True,
+        "spark.rapids.tpu.scheduler.preempt.graceMs": 20,
+        "spark.rapids.tpu.scheduler.preempt.minRunMs": 0,
+    }
+    raw.update(over)
+    return QueryScheduler(RapidsConf(raw))
+
+
+def test_arbiter_suspends_victim_and_grants_starved_waiter():
+    """A waiter starved past graceMs gets the arbiter: the running
+    victim's token hears the suspend in the same locked step its
+    ticket flips to SUSPENDED, and the waiter's acquire returns with
+    the transferred slot."""
+    sched = _preempt_sched()
+    vt = CN.CancelToken(7101, poll_ms=10.0)
+    victim = sched.submit(7101, tenant="bulk", token=vt)
+    assert victim.state == SCH.RUNNING
+    wt = CN.CancelToken(7102, poll_ms=10.0)
+    waiter = sched.submit(7102, tenant="urgent", priority=10, token=wt)
+    assert waiter.state == SCH.QUEUED
+    t0 = time.monotonic()
+    sched.acquire(waiter)
+    assert waiter.state == SCH.RUNNING
+    assert time.monotonic() - t0 < 2.0
+    assert victim.state == SCH.SUSPENDED
+    assert vt.preempt_pending(), \
+        "victim ticket flipped but its token never heard the suspend"
+    st = sched.stats()
+    assert st["bulk"]["preempted"] == 1
+    assert st["bulk"]["suspended"] == 1
+    # releasing the waiter's slot must resume the victim FIRST (it
+    # already won a slot once — preemption borrowed it)
+    sched.release(waiter)
+    assert victim.state == SCH.RUNNING
+    assert not vt.preempt_pending(), "resume never reached the token"
+    assert sched.stats()["bulk"]["suspended"] == 0
+    sched.release(victim)
+    assert sched.queued_total == 0 and sched.running_total == 0
+
+
+def test_arbiter_min_run_floor_prevents_thrash():
+    """A victim younger than minRunMs is not preemptable — the waiter
+    keeps waiting instead of thrashing a fresh grant."""
+    sched = _preempt_sched(**{
+        "spark.rapids.tpu.scheduler.preempt.minRunMs": 60_000})
+    vt = CN.CancelToken(7111, poll_ms=10.0)
+    victim = sched.submit(7111, tenant="bulk", token=vt)
+    wt = CN.CancelToken(7112, timeout_ms=300, poll_ms=10.0)  # bound it
+    waiter = sched.submit(7112, tenant="urgent", priority=10, token=wt)
+    with pytest.raises(CN.QueryCancelled):
+        sched.acquire(waiter)
+    assert victim.state == SCH.RUNNING
+    assert not vt.preempt_pending()
+    sched.release(victim)
+
+
+def test_release_of_suspended_ticket_cleans_up():
+    """A worker that bails (cancel/deadline) while its ticket is
+    SUSPENDED still releases cleanly: the ticket leaves the suspended
+    list and the tenant's gauges drop."""
+    sched = _preempt_sched()
+    vt = CN.CancelToken(7121, poll_ms=10.0)
+    victim = sched.submit(7121, tenant="bulk", token=vt)
+    wt = CN.CancelToken(7122, poll_ms=10.0)
+    waiter = sched.submit(7122, tenant="urgent", priority=10, token=wt)
+    sched.acquire(waiter)
+    assert victim.state == SCH.SUSPENDED
+    sched.release(victim)  # worker bailed while suspended
+    assert sched.stats()["bulk"]["suspended"] == 0
+    sched.release(waiter)
+    assert sched.queued_total == 0 and sched.running_total == 0
