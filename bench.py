@@ -2021,6 +2021,67 @@ def tenancy_soak_bench(mark, budget_s: float):
     return None
 
 
+def _cluster_tenancy_soak_main() -> None:
+    """Child-process entry: the CLUSTER tenancy soak — several
+    executors (each its own scheduler + tenancy agent) heartbeat a
+    rendezvous coordinator whose arbiter fans out suspend/resume/shed
+    directives, while the harness injects an executor loss mid-soak
+    and a coordinator restart (plus transient directive-path faults).
+
+    Prints one ``CLUSTER_TENANCY_SOAK=<json>`` line: per-tenant
+    latency percentiles and SLO verdicts, directive counts and the
+    breach→remote-suspend fan-out latency, degraded/resync counts,
+    force-resume count, and the zero-wedged-token / zero-leak /
+    zero-deadlock / ledgers-closed verdicts from
+    ``run_cluster_tenancy_soak``."""
+    from spark_rapids_tpu.utils.harness import run_cluster_tenancy_soak
+
+    duration = float(os.environ.get(
+        "TPUQ_BENCH_CLUSTER_TENANCY_DURATION_S", "20"))
+    executors = int(os.environ.get(
+        "TPUQ_BENCH_CLUSTER_TENANCY_EXECUTORS", "3"))
+    in_flight = int(os.environ.get(
+        "TPUQ_BENCH_CLUSTER_TENANCY_INFLIGHT", "12"))
+    rec = run_cluster_tenancy_soak(
+        duration_s=duration, executors=executors, in_flight=in_flight,
+        seed=7, timeout_s=max(60.0, duration), heartbeat_s=0.05)
+    rec["errors"] = [repr(e)[:200] for e in rec["errors"][:8]]
+    rec["sched_stats"] = {
+        str(i): {name: {k: t.get(k) for k in
+                        ("completed", "suspended", "preempted",
+                         "shed", "rejected", "observed_p99_ms",
+                         "slo_breaches")}
+                 for name, t in st.items() if isinstance(t, dict)}
+        for i, st in rec["sched_stats"].items()}
+    print("CLUSTER_TENANCY_SOAK=" + json.dumps(rec))
+
+
+def cluster_tenancy_soak_bench(mark, budget_s: float):
+    """Run the cluster tenancy soak in a subprocess; returns the
+    record dict or None.  The hour-class form is reached via
+    ``bench.py --cluster-tenancy-soak --soak-minutes N``."""
+    import subprocess
+    budget_s = min(float(os.environ.get(
+        "TPUQ_BENCH_CLUSTER_TENANCY_BUDGET_S", "900")), budget_s)
+    if budget_s < 60:
+        mark("cluster tenancy soak: skipped — outer budget exhausted")
+        return None
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--cluster-tenancy-soak"],
+            capture_output=True, text=True, timeout=budget_s)
+    except subprocess.TimeoutExpired:
+        mark(f"cluster tenancy soak: timed out after {budget_s:.0f}s")
+        return None
+    for line in (out.stdout or "").splitlines():
+        if line.startswith("CLUSTER_TENANCY_SOAK="):
+            return json.loads(line.split("=", 1)[1])
+    mark(f"cluster tenancy soak: child rc={out.returncode}; stderr "
+         "tail: " + (out.stderr or "")[-400:].replace("\n", " | "))
+    return None
+
+
 def main():
     from spark_rapids_tpu.sql.session import TpuSession
 
@@ -2115,6 +2176,7 @@ def main():
         "tpch_sf1_concurrency": None,
         "result_cache_soak": None,
         "tenancy_soak": None,
+        "cluster_tenancy_soak": None,
         "kernel_bench": None,
         "adaptive_bench": None,
         "fusion_bench": None,
@@ -2192,6 +2254,12 @@ def main():
     result["tenancy_soak"] = tenancy_soak_bench(
         mark, TOTAL_BUDGET_S - (time.monotonic() - t_start))
     emit()
+    # cluster tenancy soak: multi-executor fault-injected cross-process
+    # enforcement over the rendezvous (executor loss + coordinator
+    # restart injected mid-soak)
+    result["cluster_tenancy_soak"] = cluster_tenancy_soak_bench(
+        mark, TOTAL_BUDGET_S - (time.monotonic() - t_start))
+    emit()
     # cheapest-first, with a per-query carve-out: running the ladder in
     # declaration order let one heavy early query (q3's first-ever
     # compile) eat the whole remaining budget and starve q8-q22 into
@@ -2237,5 +2305,11 @@ if __name__ == "__main__":
         _result_cache_soak_main()
     elif len(_sys.argv) == 2 and _sys.argv[1] == "--tenancy-soak":
         _tenancy_soak_main()
+    elif _sys.argv[1:2] == ["--cluster-tenancy-soak"]:
+        # hour-class soak: --cluster-tenancy-soak --soak-minutes 60
+        if len(_sys.argv) == 4 and _sys.argv[2] == "--soak-minutes":
+            os.environ["TPUQ_BENCH_CLUSTER_TENANCY_DURATION_S"] = str(
+                float(_sys.argv[3]) * 60.0)
+        _cluster_tenancy_soak_main()
     else:
         main()

@@ -1014,7 +1014,8 @@ INJECT_TRANSIENT_COUNT = (
 # fault turns terminal / the domain disarms).
 FAILURE_DOMAINS = ("execute", "transfer", "alloc", "spill_write",
                    "spill_read", "shuffle_ser", "shuffle_exchange",
-                   "collective", "compile", "rendezvous", "peer_loss")
+                   "collective", "compile", "rendezvous", "peer_loss",
+                   "tenancy")
 
 INJECT_DOMAIN_AT: Dict[str, ConfEntry] = {}
 INJECT_DOMAIN_TRANSIENT: Dict[str, ConfEntry] = {}
@@ -1313,8 +1314,9 @@ FUSION_MODE = (
 #   spark.rapids.tpu.scheduler.tenant.<name>.maxInFlight   (int)
 #   spark.rapids.tpu.scheduler.tenant.<name>.maxQueued     (int)
 #   spark.rapids.tpu.scheduler.tenant.<name>.hbmShare      (double)
+#   spark.rapids.tpu.scheduler.tenant.<name>.sloP99Ms      (int)
 # Unlisted tenants get the tenantWeight/tenantMaxInFlight/tenantMaxQueued/
-# tenantHbmShare defaults below.
+# tenantHbmShare/tenantSloP99Ms defaults below.
 
 SCHED_MAX_CONCURRENT = (
     conf("spark.rapids.tpu.scheduler.maxConcurrentQueries")
@@ -1473,6 +1475,98 @@ SCHED_PREEMPT_MIN_RUN_MS = (
     .integer()
     .check(lambda v: v >= 0, "non-negative")
     .create_with_default(250)
+)
+
+SCHED_QUEUE_SHAPING = (
+    conf("spark.rapids.tpu.scheduler.queueShaping")
+    .doc("Derive each tenant's EFFECTIVE queued-query cap from its "
+         "fair-share weight (ceil(weight/totalWeight * "
+         "maxQueuedQueries), further capped by tenant.<name>.maxQueued) "
+         "instead of the static tenantMaxQueued alone. Stops one hot "
+         "tenant's standing queue from monopolising the global queue "
+         "budget and burying other tenants' latency behind it; "
+         "submissions beyond the shaped cap are rejected with "
+         "QueryRejected(reason='tenant_queue_full').")
+    .category("scheduler")
+    .boolean()
+    .create_with_default(True)
+)
+
+SCHED_TENANT_SLO_P99_MS = (
+    conf("spark.rapids.tpu.scheduler.tenantSloP99Ms")
+    .doc("Default per-tenant p99 submit-to-completion latency SLO in "
+         "milliseconds, tracked by a sliding-window estimator over the "
+         "tenant's recent completions. 0 disables SLO tracking. While "
+         "a tenant's observed p99 breaches its target the scheduler "
+         "halves that tenant's effective queue cap and sheds the "
+         "overflow with QueryRejected(reason='shed_slo') (counted in "
+         "tpuq_slo_breach_total, black-box dumped with the dominant "
+         "attribution bucket). Per-tenant override: "
+         "spark.rapids.tpu.scheduler.tenant.<name>.sloP99Ms.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(0)
+)
+
+SCHED_SLO_WINDOW = (
+    conf("spark.rapids.tpu.scheduler.sloWindow")
+    .doc("Sliding-window size (completions per tenant) for the SLO "
+         "p99 estimator. Breach detection needs at least 8 samples in "
+         "the window, so small windows react faster but gate on fewer "
+         "observations.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v >= 8, ">= 8")
+    .create_with_default(64)
+)
+
+
+# ---------------------------------------------------------------------------
+# Cluster-wide tenancy protocol (runtime/tenancy.py + parallel/rendezvous.py)
+# ---------------------------------------------------------------------------
+
+TENANCY_ENABLED = (
+    conf("spark.rapids.tpu.tenancy.enabled")
+    .doc("Cluster-wide tenancy enforcement: each executor's "
+         "TenancyAgent piggybacks per-tenant state (in-flight, queued "
+         "depth, HBM bytes, largest-runtime query) on its rendezvous "
+         "heartbeat, and the coordinator's arbiter fans epoch-tagged "
+         "suspend/resume/shed directives back on the heartbeat "
+         "response, so a tenant breaching its cluster share on one "
+         "executor is preempted even when the starved waiter sits on "
+         "another. Requires a rendezvous address and heartbeats "
+         "enabled; without them enforcement stays process-local.")
+    .category("scheduler")
+    .boolean()
+    .create_with_default(False)
+)
+
+TENANCY_SUSPEND_TTL_MS = (
+    conf("spark.rapids.tpu.tenancy.suspendTtlMs")
+    .doc("Lease on a remotely-directed suspension: a suspend directive "
+         "must be renewed (re-issued by the coordinator on a later "
+         "heartbeat) within this long or the token force-resumes "
+         "itself — the wedge guard for executor loss / coordinator "
+         "restart mid-suspend. 0 derives the TTL as 2x "
+         "scheduler.preempt.graceMs.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v >= 0, "non-negative")
+    .create_with_default(0)
+)
+
+TENANCY_DEGRADED_AFTER = (
+    conf("spark.rapids.tpu.tenancy.degradedAfterMisses")
+    .doc("After this many consecutive heartbeat failures the "
+         "TenancyAgent drops to local-only enforcement (counted in "
+         "tpuq_tenancy_degraded_total) until a heartbeat round-trips "
+         "again, at which point it re-syncs its suspended-query state "
+         "with the (possibly restarted) coordinator.")
+    .category("scheduler")
+    .integer()
+    .check(lambda v: v > 0, "positive")
+    .create_with_default(2)
 )
 
 

@@ -189,6 +189,197 @@ def coordinator_from_conf(conf, num_processes: int,
             conf.get(C.RENDEZVOUS_SOCKET_TIMEOUT_MS)) / 1000.0)
 
 
+class TenancyArbiter:
+    """The cluster half of tenancy enforcement, hosted by the
+    coordinator (docs/serving.md "Cluster-wide enforcement & SLOs").
+
+    Executors piggyback a per-tenant report (running/queued depth,
+    starvation age, largest-runtime query) on each heartbeat;
+    ``observe`` folds the report in, recomputes cluster-wide fair
+    shares (weight share of the summed run slots), and returns the
+    epoch-tagged directives pending for that executor — suspend the
+    most over-share tenant's largest-runtime query wherever it runs,
+    resume it once starvation clears, shed a tenant that is over share
+    with nothing left to preempt.  Directives ride the heartbeat
+    RESPONSE (the protocol stays request/response — no server push),
+    so fan-out latency is bounded by ~one heartbeat period.
+
+    Every suspend is a LEASE: it is re-issued (same directive id) on
+    each heartbeat while still warranted, and executors let the token
+    force-resume when renewals stop (coordinator restart, arbiter
+    decision lost) — a directive can delay work but never wedge it.
+    Reports from reaped executors are dropped, and their hosted
+    suspensions are forgotten (the dead executor's tokens TTL-resume
+    on their own)."""
+
+    def __init__(self, grace_s: float = 0.5, suspend_ttl_s: float = 1.0,
+                 report_ttl_s: float = 30.0):
+        self.grace_s = float(grace_s)
+        self.suspend_ttl_s = float(suspend_ttl_s)
+        self.report_ttl_s = float(report_ttl_s)
+        self._lock = threading.Lock()
+        self._reports: Dict[int, Tuple[float, dict]] = {}
+        self._pending: Dict[int, List[dict]] = {}
+        # query_id -> {"pid", "tenant", "id"} for live suspend leases
+        self._suspended: Dict[int, dict] = {}
+        self._shed: Dict[str, str] = {}      # tenant -> directive id
+        self._next_id = 0
+        self.issued: Dict[str, int] = {"suspend": 0, "resume": 0,
+                                       "shed": 0, "unshed": 0}
+
+    def _mk(self, epoch: int, kind: str, tenant: str,
+            query_id: Optional[int], detail: str,
+            directive_id: Optional[str] = None) -> dict:
+        if directive_id is None:
+            self._next_id += 1
+            directive_id = f"{epoch}-{self._next_id}"
+            self.issued[kind] = self.issued.get(kind, 0) + 1
+        return {"id": directive_id, "epoch": epoch, "kind": kind,
+                "tenant": tenant, "query_id": query_id,
+                "ttl_ms": self.suspend_ttl_s * 1000.0,
+                "detail": detail, "issued_wall": time.time()}
+
+    def observe(self, pid: int, report: dict, dead=(),
+                epoch: int = 0) -> List[dict]:
+        """Fold one executor's heartbeat report in, arbitrate, and
+        drain that executor's pending directives."""
+        with self._lock:
+            for d in dead:
+                self._reports.pop(d, None)
+                self._pending.pop(d, None)
+                for qid in [q for q, s in self._suspended.items()
+                            if s["pid"] == d]:
+                    del self._suspended[qid]
+            self._reports[pid] = (time.monotonic(), dict(report or {}))
+            self._arbitrate_locked(epoch)
+            return self._pending.pop(pid, [])
+
+    def _arbitrate_locked(self, epoch: int) -> None:
+        now = time.monotonic()
+        for pid in [p for p, (ts, _r) in self._reports.items()
+                    if now - ts > self.report_ttl_s]:
+            del self._reports[pid]
+        slots = 0
+        agg: Dict[str, dict] = {}
+        victims: Dict[str, List[tuple]] = {}   # tenant -> (run_s,qid,pid)
+        for pid, (_ts, rep) in self._reports.items():
+            slots += int(rep.get("slots", 0))
+            for name, n in (rep.get("breaches") or {}).items():
+                a = agg.setdefault(name, {"weight": 1.0, "running": 0,
+                                          "queued": 0, "suspended": 0,
+                                          "oldest_wait_s": 0.0,
+                                          "breaches": 0})
+                a["breaches"] = a.get("breaches", 0) + int(n)
+            for name, t in (rep.get("tenants") or {}).items():
+                a = agg.setdefault(name, {"weight": 1.0, "running": 0,
+                                          "queued": 0, "suspended": 0,
+                                          "oldest_wait_s": 0.0,
+                                          "breaches": 0})
+                a["weight"] = max(a["weight"],
+                                  float(t.get("weight", 1.0)))
+                a["running"] += int(t.get("running", 0))
+                a["queued"] += int(t.get("queued", 0))
+                a["suspended"] += int(t.get("suspended", 0))
+                wait = t.get("oldest_wait_s")
+                if wait is not None:
+                    a["oldest_wait_s"] = max(a["oldest_wait_s"],
+                                             float(wait))
+                qid = t.get("largest_qid")
+                if qid is not None and qid not in self._suspended:
+                    victims.setdefault(name, []).append(
+                        (float(t.get("largest_run_s", 0.0)), qid, pid))
+        if not agg or slots <= 0:
+            return
+        demanding = {n: a for n, a in agg.items()
+                     if a["running"] + a["queued"] + a["suspended"] > 0}
+        total_w = sum(a["weight"] for a in demanding.values()) or 1.0
+        share = {n: max(1, round(a["weight"] / total_w * slots))
+                 for n, a in demanding.items()}
+        starved = [n for n, a in demanding.items()
+                   if a["oldest_wait_s"] > self.grace_s
+                   and a["running"] < share[n]]
+        over = sorted(
+            (n for n, a in demanding.items()
+             if a["running"] > share[n] and n not in starved),
+            key=lambda n: demanding[n]["running"] / demanding[n]["weight"],
+            reverse=True)
+        # 1. renew or release existing suspend leases: a suspension
+        #    exists to relieve starvation, so it holds exactly while
+        #    some tenant still starves (the victim tenant's own
+        #    running count fell when it was suspended — judging the
+        #    lease by "still over share" would oscillate)
+        for qid, s in list(self._suspended.items()):
+            if bool(starved):
+                self._pending.setdefault(s["pid"], []).append(self._mk(
+                    epoch, "suspend", s["tenant"], qid,
+                    "lease renewal", directive_id=s["id"]))
+            else:
+                self._pending.setdefault(s["pid"], []).append(self._mk(
+                    epoch, "resume", s["tenant"], qid,
+                    "cluster starvation cleared"))
+                del self._suspended[qid]
+        # 2. new suspensions: most over-share tenant's largest-runtime
+        #    query, wherever in the cluster it runs
+        if starved:
+            for name in over:
+                cands = victims.get(name)
+                if not cands:
+                    continue
+                run_s, qid, vpid = max(cands)
+                d = self._mk(
+                    epoch, "suspend", name, qid,
+                    f"tenant {name} over cluster share "
+                    f"({agg[name]['running']}/{share[name]} slots), "
+                    f"starved waiter: {starved[0]}")
+                self._pending.setdefault(vpid, []).append(d)
+                self._suspended[qid] = {"pid": vpid, "tenant": name,
+                                        "id": d["id"]}
+                break
+        # 2b. HBM-breach relays: a tenant over its byte budget with no
+        #     LOCAL victim — suspend its largest-runtime query wherever
+        #     it runs so its residency spills and reservations unwind
+        for name, a in agg.items():
+            if a.get("breaches", 0) <= 0:
+                continue
+            cands = victims.get(name)
+            if not cands:
+                continue
+            run_s, qid, vpid = max(cands)
+            if qid in self._suspended:
+                continue
+            d = self._mk(epoch, "suspend", name, qid,
+                         f"tenant {name} HBM budget breach relayed "
+                         "from another executor")
+            self._pending.setdefault(vpid, []).append(d)
+            self._suspended[qid] = {"pid": vpid, "tenant": name,
+                                    "id": d["id"]}
+        # 3. shed: over share, starving others, nothing preemptible
+        for name in over:
+            if (starved and not victims.get(name)
+                    and agg[name]["suspended"] > 0
+                    and name not in self._shed):
+                d = self._mk(epoch, "shed", name, None,
+                             "over cluster share with nothing left to "
+                             "preempt — shaping admission")
+                self._shed[name] = d["id"]
+                for pid in self._reports:
+                    self._pending.setdefault(pid, []).append(dict(d))
+        for name in list(self._shed):
+            if name not in over or not starved:
+                d = self._mk(epoch, "unshed", name, None,
+                             "cluster pressure cleared")
+                del self._shed[name]
+                for pid in self._reports:
+                    self._pending.setdefault(pid, []).append(dict(d))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"issued": dict(self.issued),
+                    "live_suspends": len(self._suspended),
+                    "shed_tenants": sorted(self._shed),
+                    "reporting_executors": len(self._reports)}
+
+
 class RendezvousCoordinator:
     """Driver-side rendezvous service (the MapOutputTracker analog for
     collective entry).  Thread-per-connection TCP; message = one JSON
@@ -215,6 +406,9 @@ class RendezvousCoordinator:
         self._generation = 0
         self._lock = threading.Lock()
         self._halt = threading.Event()
+        # cluster tenancy arbiter — engaged only when heartbeats carry
+        # a tenancy report (tenancy.enabled on the executors)
+        self.tenancy = TenancyArbiter()
         coord = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -286,6 +480,7 @@ class RendezvousCoordinator:
 
     def _op_heartbeat(self, req) -> dict:
         pid = int(req["pid"])
+        report = req.get("tenancy")
         with self._lock:
             if pid in self._dead:
                 # too late: survivors may already be unwinding on this
@@ -293,8 +488,17 @@ class RendezvousCoordinator:
                 return {"ok": False, "kind": "dead",
                         "error": self._dead[pid]}
             self._peers[pid] = time.monotonic()
-            return {"ok": True, "generation": self._generation,
-                    "dead": sorted(self._dead)}
+            gen = self._generation
+            dead = sorted(self._dead)
+        resp = {"ok": True, "generation": gen, "dead": dead}
+        if report is not None:
+            # arbitrate OUTSIDE the coordinator lock (the arbiter has
+            # its own) and fan this executor's directives out on the
+            # response — bounded by one heartbeat period end to end
+            resp["tenancy_epoch"] = gen
+            resp["directives"] = self.tenancy.observe(
+                pid, report, dead=dead, epoch=gen)
+        return resp
 
     # -- stage fault plumbing -------------------------------------------
 
@@ -472,6 +676,10 @@ class RendezvousClient:
         self.dead = False
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_halt = threading.Event()
+        # tenancy piggyback hooks (set by start_heartbeat)
+        self._hb_payload_fn: Optional[Callable[[], dict]] = None
+        self._hb_on_response: Optional[Callable[[dict], None]] = None
+        self._hb_on_miss: Optional[Callable[[], None]] = None
 
     def _request(self, obj, io_timeout: float):
         with socket.create_connection((self.host, self.port),
@@ -495,12 +703,26 @@ class RendezvousClient:
                 resp.get("error", "register failed"))
         return int(resp.get("generation", 0))
 
-    def start_heartbeat(self, period_s: float) -> None:
+    def start_heartbeat(self, period_s: float,
+                        payload_fn: Optional[Callable[[], dict]] = None,
+                        on_response: Optional[
+                            Callable[[dict], None]] = None,
+                        on_miss: Optional[Callable[[], None]] = None
+                        ) -> None:
         """Register, then renew the lease every ``period_s`` (<= 0:
-        register only — no liveness tracking)."""
+        register only — no liveness tracking).
+
+        The tenancy piggyback: ``payload_fn()`` rides each heartbeat
+        as the executor's per-tenant report, ``on_response(resp)``
+        receives the coordinator's reply (tenancy epoch + pending
+        directives), ``on_miss()`` fires on each unreachable
+        coordinator (the degraded-mode trigger)."""
         self.register()
         if period_s <= 0 or self._hb_thread is not None:
             return
+        self._hb_payload_fn = payload_fn
+        self._hb_on_response = on_response
+        self._hb_on_miss = on_miss
         self._hb_halt.clear()
         t = threading.Thread(
             target=self._hb_loop, args=(float(period_s),), daemon=True,
@@ -510,10 +732,30 @@ class RendezvousClient:
 
     def _hb_loop(self, period_s: float) -> None:
         while not self._hb_halt.wait(period_s):
+            req = {"op": "heartbeat", "pid": self.pid}
+            fn = self._hb_payload_fn
+            if fn is not None:
+                try:
+                    req["tenancy"] = fn()
+                except Exception:
+                    pass  # a broken report must not stop the lease
             try:
-                self._request({"op": "heartbeat", "pid": self.pid}, 5.0)
+                resp = self._request(req, 5.0)
             except OSError:
                 _TM_HB_MISSES.inc()
+                cb = self._hb_on_miss
+                if cb is not None:
+                    try:
+                        cb()
+                    except Exception:
+                        pass
+                continue
+            cb = self._hb_on_response
+            if cb is not None:
+                try:
+                    cb(resp)
+                except Exception:
+                    pass
 
     def stop_heartbeat(self) -> None:
         self._hb_halt.set()

@@ -63,6 +63,11 @@ _TM_SUSPEND_LATENCY = TM.REGISTRY.histogram(
     "tpuq_preempt_suspend_latency_seconds",
     "suspend-request to SUSPENDED (first thread parked, permits "
     "released) latency")
+_TM_PREEMPT_FORCE_RESUMED = TM.REGISTRY.counter(
+    "tpuq_preempt_force_resumed_total",
+    "suspends whose lease (ttl) expired unrenewed — requester died or "
+    "coordinator lost — and the token force-resumed itself (the wedge "
+    "guard)")
 
 DEFAULT_POLL_S = 0.05
 
@@ -146,6 +151,10 @@ class CancelToken:
         self._preempt_state: str = PREEMPT_RUN
         self._preempt_detail: str = ""
         self._preempt_requested_at: Optional[float] = None
+        # lease on the suspension: monotonic deadline past which the
+        # token force-resumes itself (None = no lease, local requester
+        # owns the resume).  Remote/cluster suspends always carry one.
+        self._suspend_deadline: Optional[float] = None
         self._resume_event = threading.Event()
         self.suspend_latency_s: Optional[float] = None
         self.preempt_count = 0     # completed suspend->resume cycles
@@ -313,17 +322,27 @@ class CancelToken:
     def suspended(self) -> bool:
         return self._preempt_state == PREEMPT_SUSPENDED
 
-    def request_suspend(self, detail: str = "") -> bool:
+    def request_suspend(self, detail: str = "",
+                        ttl_s: Optional[float] = None) -> bool:
         """Ask the query to yield at its next preempt point (first
         request wins; returns True on the RUN/RESUMED ->
         SUSPEND_REQUESTED transition).  A cancelled token cannot be
-        suspended — the cancel already reclaims everything."""
+        suspended — the cancel already reclaims everything.
+
+        ``ttl_s`` leases the suspension: if the requester never resumes
+        (or renews via ``refresh_suspend``) within the TTL, the token
+        force-resumes itself — a dead requester (executor loss, lease
+        expiry, coordinator restart) must never wedge the query in
+        SUSPEND_REQUESTED/SUSPENDED."""
         with self._lock:
             if self._event.is_set() or self.preempt_pending():
                 return False
             self._preempt_state = PREEMPT_SUSPEND_REQUESTED
             self._preempt_detail = detail
             self._preempt_requested_at = time.monotonic()
+            self._suspend_deadline = (
+                time.monotonic() + max(float(ttl_s), 0.001)
+                if ttl_s is not None else None)
             self._resume_event.clear()
             waiters = list(self._waiters)
         # wake registered waiters (semaphore CVs) so a thread parked in
@@ -349,11 +368,64 @@ class CancelToken:
                 return False
             self._preempt_state = PREEMPT_RESUMED
             self.preempt_count += 1
+            self._suspend_deadline = None
             self._resume_event.set()
         _TM_PREEMPT_RESUMED.inc()
         from spark_rapids_tpu.runtime import attribution
         attribution.record_event("preempt", {
             "phase": "resumed", "query_id": self.query_id})
+        return True
+
+    def refresh_suspend(self, ttl_s: float) -> bool:
+        """Renew a leased suspension's TTL (the coordinator re-issues a
+        live suspend directive on every heartbeat; a renewal that stops
+        arriving lets the lease expire and the wedge guard fire)."""
+        with self._lock:
+            if not self.preempt_pending():
+                return False
+            self._suspend_deadline = (time.monotonic()
+                                      + max(float(ttl_s), 0.001))
+            return True
+
+    def _suspend_expired(self) -> bool:
+        dl = self._suspend_deadline
+        return dl is not None and time.monotonic() >= dl
+
+    def _force_resume(self) -> bool:
+        """Wedge guard: the suspension lease expired without a resume
+        or renewal — the requester is gone.  Self-resume so the query
+        makes progress again (liveness beats strict capacity: the
+        scheduler is told, and may transiently oversubscribe one run
+        slot until the next release drains it)."""
+        with self._lock:
+            if not self.preempt_pending():
+                return False
+            self._preempt_state = PREEMPT_RESUMED
+            self.preempt_count += 1
+            self._suspend_deadline = None
+            self._resume_event.set()
+        _TM_PREEMPT_RESUMED.inc()
+        _TM_PREEMPT_FORCE_RESUMED.inc()
+        from spark_rapids_tpu.runtime import attribution
+        attribution.record_event("preempt", {
+            "phase": "force_resumed", "query_id": self.query_id,
+            "detail": self._preempt_detail})
+        if self.query_id is not None:
+            # tell the scheduler that parked our ticket (set by
+            # remote_suspend; the global singleton otherwise) so its
+            # slot accounting follows the self-resume
+            owner = None
+            ref = getattr(self, "_suspend_owner", None)
+            if ref is not None:
+                owner = ref()
+            if owner is None:
+                from spark_rapids_tpu.runtime import scheduler as _sched
+                owner = _sched.peek_scheduler()
+            if owner is not None:
+                try:
+                    owner.notify_force_resumed(self.query_id)
+                except Exception:
+                    pass
         return True
 
     def preempt_point(self) -> None:
@@ -371,6 +443,8 @@ class CancelToken:
 
     def _park_suspended(self) -> None:
         self.check()
+        if self._suspend_expired() and self._force_resume():
+            return  # lease already dead on arrival — never park
         states = []
         for suspend_fn, _resume_fn in _SUSPEND_PROVIDERS:
             try:
@@ -410,6 +484,9 @@ class CancelToken:
         try:
             while self._preempt_state == PREEMPT_SUSPENDED:
                 self.check()
+                if self._suspend_expired():
+                    self._force_resume()
+                    break
                 self._resume_event.wait(self.wait_interval())
         finally:
             if span is not None:
@@ -587,16 +664,18 @@ def cancel_query(query_id: int, reason: str = "user",
     return tok.cancel(reason, detail)
 
 
-def suspend_query(query_id: int, detail: str = "") -> bool:
+def suspend_query(query_id: int, detail: str = "",
+                  ttl_s: Optional[float] = None) -> bool:
     """Request cooperative suspension of one in-flight query (the
     scheduler's preemption arbiter backend; also a chaos-harness hook).
     Returns False when no such query is active or it cannot be
-    suspended (already pending, or cancelled)."""
+    suspended (already pending, or cancelled).  ``ttl_s`` leases the
+    suspension (see ``CancelToken.request_suspend``)."""
     with _ACTIVE_LOCK:
         tok = _ACTIVE.get(query_id)
     if tok is None:
         return False
-    return tok.request_suspend(detail)
+    return tok.request_suspend(detail, ttl_s=ttl_s)
 
 
 def resume_query(query_id: int) -> bool:

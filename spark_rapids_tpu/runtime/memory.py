@@ -475,12 +475,24 @@ class DeviceMemoryManager:
             exclude = tok.query_id if tok is not None else None
             from spark_rapids_tpu.runtime import scheduler
             sched = scheduler.peek_scheduler()
+            preempted = False
             if sched is not None:
                 try:
-                    sched.request_tenant_preemption(
+                    preempted = sched.request_tenant_preemption(
                         tenant, exclude_query_id=exclude)
                 except Exception:
                     pass  # best-effort; the RetryOOM still rolls back
+            if not preempted:
+                # no local victim — relay to the cluster arbiter so it
+                # can suspend the tenant's largest query on ANOTHER
+                # executor (piggybacks on the next heartbeat)
+                from spark_rapids_tpu.runtime import tenancy
+                agent = tenancy.peek_agent()
+                if agent is not None:
+                    try:
+                        agent.notify_breach(tenant)
+                    except Exception:
+                        pass
             raise RetryOOM(
                 f"tenant {tenant} cannot reserve {nbytes} B: "
                 f"{self._tenant_used.get(tenant, 0)} of its "

@@ -5,7 +5,7 @@
  sql-plugin :: RmmRapidsRetryIterator.scala — the uniform
  rollback-and-retry contract every device step gets; SURVEY §3.5/§5.3]
 
-The engine's device/IO boundaries are eleven named **failure domains**:
+The engine's device/IO boundaries are twelve named **failure domains**:
 
 ======================  ====================================  ==========
 domain                  chokepoint                            degradable
@@ -22,14 +22,20 @@ domain                  chokepoint                            degradable
 ``rendezvous``          coordinator barrier (parallel.        no: epoch retry
                         rendezvous :: allgather)
 ``peer_loss``           simulated executor death              no: fails slice
+``tenancy``             cluster directive apply (runtime.     yes: local-only
+                        tenancy :: on_heartbeat)              enforcement
 ======================  ====================================  ==========
 
-The two distributed domains retry differently: ``rendezvous`` faults
+The distributed domains retry differently: ``rendezvous`` faults
 re-enter the stage at epoch+1 through ``run_stage_epochs`` (same
 policy, same budget), and ``peer_loss`` is always terminal — every
 survivor raises the same peer-tagged ``TerminalDeviceError`` within
 ~one heartbeat lease (see docs/resilience.md, "Distributed failure
-domains").
+domains").  ``tenancy`` degrades softest of all: an injected (or
+real) fault in the directive path drops that heartbeat's directives —
+suspends are coordinator-renewed leases, so the protocol re-converges
+on the next beat, and a sustained outage just means local-only
+enforcement (never an error surfaced to a query).
 
 Three cooperating pieces, all conf-driven:
 

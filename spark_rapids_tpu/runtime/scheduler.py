@@ -51,6 +51,7 @@ import contextlib
 import math
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -85,6 +86,16 @@ _TM_PREEMPTED = TM.REGISTRY.labeled_counter(
     "tpuq_scheduler_preempted_total",
     "running queries suspended by the preemption arbiter, per victim "
     "tenant", label="tenant")
+_TM_SLO_BREACH = TM.REGISTRY.labeled_counter(
+    "tpuq_slo_breach_total",
+    "sliding-window p99 SLO breach transitions per tenant (entering "
+    "the breached state; shedding while breached counts in "
+    "tpuq_admission_rejected_total{reason=shed_slo})", label="tenant")
+_TM_REMOTE_SUSPENDED = TM.REGISTRY.labeled_counter(
+    "tpuq_scheduler_remote_suspended_total",
+    "running queries suspended on a cluster arbiter directive (the "
+    "cross-executor half of preemption), per victim tenant",
+    label="tenant")
 
 # ticket lifecycle (SUSPENDED: granted once, slot reclaimed by the
 # preemption arbiter, waiting to resume — resumes before new grants)
@@ -104,7 +115,8 @@ PRIORITY_MAX = 100
 #: the shed counter + health WARN) as opposed to "this tenant hit its
 #: own quota"
 SHED_REASONS = frozenset({"shed_queue_depth", "shed_spill_pressure",
-                          "shed_semaphore_saturation"})
+                          "shed_semaphore_saturation", "shed_slo",
+                          "shed_cluster"})
 
 _TENANT_PREFIX = "spark.rapids.tpu.scheduler.tenant."
 
@@ -155,7 +167,8 @@ class Ticket:
     query, then ``release``s the slot."""
 
     __slots__ = ("query_id", "tenant", "priority", "token", "state",
-                 "submitted_at", "granted_at", "suspended_at")
+                 "submitted_at", "granted_at", "suspended_at",
+                 "remote_hold")
 
     def __init__(self, query_id: int, tenant: str, priority: int, token):
         self.query_id = query_id
@@ -166,6 +179,10 @@ class Ticket:
         self.submitted_at = time.monotonic()
         self.granted_at: Optional[float] = None
         self.suspended_at: Optional[float] = None
+        # suspended on a CLUSTER arbiter directive: local dispatch must
+        # not resume it — only remote_resume (or the suspend lease's
+        # expiry) lifts the hold
+        self.remote_hold = False
 
 
 class TenantState:
@@ -175,10 +192,13 @@ class TenantState:
     __slots__ = ("name", "weight", "max_in_flight", "max_queued",
                  "hbm_share", "run_cap", "lanes", "deficit", "running",
                  "queued", "submitted", "completed", "rejected", "shed",
-                 "cancelled_queued", "preempted", "suspended")
+                 "cancelled_queued", "preempted", "suspended",
+                 "slo_p99_ms", "slo_window", "slo_breached",
+                 "slo_breaches", "cluster_shed")
 
     def __init__(self, name: str, weight: float, max_in_flight: int,
-                 max_queued: int, hbm_share: float, max_concurrent: int):
+                 max_queued: int, hbm_share: float, max_concurrent: int,
+                 slo_p99_ms: int = 0, slo_window: int = 64):
         self.name = name
         self.weight = max(0.01, float(weight))
         self.max_in_flight = max(1, int(max_in_flight))
@@ -203,6 +223,16 @@ class TenantState:
         self.cancelled_queued = 0
         self.preempted = 0   # times one of this tenant's queries was
         self.suspended = 0   # suspended / currently-suspended count
+        # SLO guardrail: sliding window of (wall_s, dominant_bucket)
+        # completion samples; 0 target disables tracking
+        self.slo_p99_ms = max(0, int(slo_p99_ms))
+        self.slo_window: deque = deque(maxlen=max(8, int(slo_window)))
+        self.slo_breached = False
+        self.slo_breaches = 0
+        # cluster arbiter ordered this tenant's submissions shed (the
+        # tenant is over its cluster share and nothing preemptible is
+        # left) — lifted by an 'unshed' directive or agent re-sync
+        self.cluster_shed = False
 
     def backlogged(self) -> bool:
         return self.queued > 0 and self.running < self.run_cap
@@ -270,6 +300,10 @@ class QueryScheduler:
                 conf.get(C.SCHED_PREEMPT_GRACE_MS)) / 1000.0
             self.preempt_min_run_s = float(
                 conf.get(C.SCHED_PREEMPT_MIN_RUN_MS)) / 1000.0
+            self.queue_shaping = bool(conf.get(C.SCHED_QUEUE_SHAPING))
+            self._default_slo_ms = int(
+                conf.get(C.SCHED_TENANT_SLO_P99_MS))
+            self.slo_window = int(conf.get(C.SCHED_SLO_WINDOW))
         else:
             self.max_concurrent = C.SCHED_MAX_CONCURRENT.default
             self.max_queued = C.SCHED_MAX_QUEUED.default
@@ -284,6 +318,9 @@ class QueryScheduler:
             self.preempt_grace_s = C.SCHED_PREEMPT_GRACE_MS.default / 1000.0
             self.preempt_min_run_s = (
                 C.SCHED_PREEMPT_MIN_RUN_MS.default / 1000.0)
+            self.queue_shaping = C.SCHED_QUEUE_SHAPING.default
+            self._default_slo_ms = C.SCHED_TENANT_SLO_P99_MS.default
+            self.slo_window = C.SCHED_SLO_WINDOW.default
         self._tenants: Dict[str, TenantState] = {}
         self._rr_order: deque = deque()  # round-robin tie-break rotation
         self._tickets: Dict[int, Ticket] = {}
@@ -320,7 +357,10 @@ class QueryScheduler:
                     name, "maxQueued", self._default_queued),
                 hbm_share=self._tenant_override(
                     name, "hbmShare", self._default_hbm_share),
-                max_concurrent=self.max_concurrent)
+                max_concurrent=self.max_concurrent,
+                slo_p99_ms=self._tenant_override(
+                    name, "sloP99Ms", self._default_slo_ms),
+                slo_window=self.slo_window)
             self._tenants[name] = t
             self._rr_order.append(name)
         return t
@@ -354,6 +394,29 @@ class QueryScheduler:
                         f"{self.shed_sem_saturation}")
         return None
 
+    def _effective_max_queued_locked(self, t: TenantState) -> int:
+        """The tenant's EFFECTIVE queued cap: with queue shaping on,
+        its weight share of the global queue budget (so one hot
+        tenant's standing queue cannot monopolise admission and bury
+        every other tenant's latency behind it), never above its own
+        static ``maxQueued``."""
+        if not self.queue_shaping:
+            return t.max_queued
+        total_w = sum(x.weight for x in self._tenants.values())
+        share = math.ceil((t.weight / max(total_w, t.weight))
+                          * self.max_queued)
+        return min(t.max_queued, max(1, share))
+
+    @staticmethod
+    def _observed_p99_ms_locked(t: TenantState) -> Optional[float]:
+        """Nearest-rank p99 over the tenant's sliding completion
+        window (ms); None below the 8-sample confidence floor."""
+        if len(t.slo_window) < 8:
+            return None
+        walls = sorted(w for w, _b in t.slo_window)
+        idx = max(0, math.ceil(0.99 * len(walls)) - 1)
+        return walls[idx] * 1000.0
+
     def submit(self, query_id: int, tenant: str = "default",
                priority: int = 0, token=None) -> Ticket:
         """Admit or reject one submission.  Returns a QUEUED ``Ticket``
@@ -368,14 +431,38 @@ class QueryScheduler:
         with self._cv:
             t = self._tenant_locked(tenant)
             shed = self._shed_reason()
+            eff_cap = self._effective_max_queued_locked(t)
+            slo_cut = t.slo_breached and t.slo_p99_ms > 0
+            if slo_cut:
+                # queue-depth shaping while the tenant's p99 breaches
+                # its SLO: halve the effective cap so the backlog the
+                # breach feeds on drains instead of growing
+                eff_cap = max(1, eff_cap // 2)
             if shed is not None:
                 reason, detail = shed
                 t.shed += 1
                 t.rejected += 1
-            elif t.queued >= t.max_queued:
-                reason = "tenant_queue_full"
-                detail = (f"{t.queued} queued >= tenant maxQueued="
-                          f"{t.max_queued}")
+            elif t.cluster_shed:
+                reason = "shed_cluster"
+                detail = (f"tenant {tenant} shed by cluster arbiter "
+                          "directive (over cluster share, nothing left "
+                          "to preempt)")
+                t.shed += 1
+                t.rejected += 1
+            elif t.queued >= eff_cap:
+                if slo_cut:
+                    reason = "shed_slo"
+                    detail = (f"tenant p99 SLO breached "
+                              f"(target={t.slo_p99_ms}ms) — queue cap "
+                              f"shaped to {eff_cap}, {t.queued} queued")
+                    t.shed += 1
+                else:
+                    reason = "tenant_queue_full"
+                    detail = (f"{t.queued} queued >= effective cap "
+                              f"{eff_cap} (tenant maxQueued="
+                              f"{t.max_queued}"
+                              + (", weight-shaped" if self.queue_shaping
+                                 else "") + ")")
                 t.rejected += 1
             elif self.queued_total >= self.max_queued:
                 reason = "queue_full"
@@ -416,6 +503,11 @@ class QueryScheduler:
         for k in list(self._suspended):
             if self.running_total >= self.max_concurrent:
                 break
+            if k.remote_hold:
+                # a cluster directive parked it — a free LOCAL slot
+                # must not resume it (that would undo the cluster
+                # share enforcement one heartbeat after it landed)
+                continue
             vt = self._tenants[k.tenant]
             if vt.running >= vt.run_cap:
                 continue
@@ -584,6 +676,170 @@ class QueryScheduler:
             self._suspend_locked(victim, now)
         return True
 
+    # -- cluster tenancy (runtime/tenancy.py drives these) -----------------
+
+    def remote_suspend(self, query_id: int, detail: str = "",
+                       ttl_s: Optional[float] = None) -> bool:
+        """Suspend one RUNNING query on a cluster arbiter directive.
+        Unlike local arbitration this does not need preempt.enabled —
+        the operator armed the cluster protocol explicitly.  The token
+        suspend is leased (``ttl_s``): if the coordinator stops
+        renewing (executor loss, coordinator restart) the token
+        force-resumes itself and ``notify_force_resumed`` repairs the
+        slot accounting.  Cancel always wins: a cancelled or
+        already-pending token refuses the suspend."""
+        with self._cv:
+            k = self._tickets.get(query_id)
+            if k is None or k.state != RUNNING or k.token is None:
+                return False
+            if k.token.cancelled() or k.token.preempt_pending():
+                return False
+            if not k.token.request_suspend(detail, ttl_s=ttl_s):
+                return False
+            k.token._suspend_owner = weakref.ref(self)
+            self._suspend_locked(k, time.monotonic())
+            k.remote_hold = True
+            # hand the freed slot out NOW: unlike the HBM-breach path
+            # there may be no later submit/release event on this
+            # executor to run dispatch, and the starved waiter this
+            # directive exists for is sitting in acquire().  The
+            # victim itself cannot bounce back — dispatch skips
+            # remote_hold tickets.
+            self._dispatch_locked()
+            self._cv.notify_all()
+        _TM_REMOTE_SUSPENDED.inc(k.tenant)
+        return True
+
+    def remote_resume(self, query_id: int) -> bool:
+        """Lift a remote hold (cluster 'resume' directive) and let
+        normal dispatch resume the ticket when a slot frees."""
+        with self._cv:
+            k = self._tickets.get(query_id)
+            if k is None or not k.remote_hold:
+                return False
+            k.remote_hold = False
+            if k.state == SUSPENDED:
+                self._dispatch_locked()
+                self._cv.notify_all()
+            return True
+
+    def notify_force_resumed(self, query_id: int) -> None:
+        """The wedge guard fired: a suspended token's lease expired
+        unrenewed and it self-resumed.  Follow it in the ticket
+        accounting — the query is running again whether or not a slot
+        was free (liveness beats strict capacity; the one-slot
+        overshoot drains at the next release)."""
+        with self._cv:
+            k = self._tickets.get(query_id)
+            if k is None or k.state != SUSPENDED:
+                return
+            k.remote_hold = False
+            try:
+                self._suspended.remove(k)
+            except ValueError:
+                pass
+            k.state = RUNNING
+            k.granted_at = time.monotonic()
+            vt = self._tenants[k.tenant]
+            vt.running += 1
+            vt.suspended -= 1
+            self.running_total += 1
+            self._cv.notify_all()
+
+    def set_cluster_shed(self, tenant: str, shed: bool) -> None:
+        """Apply/lift a cluster 'shed'/'unshed' directive for a
+        tenant; shed submissions reject with reason='shed_cluster'."""
+        with self._cv:
+            self._tenant_locked(tenant).cluster_shed = bool(shed)
+
+    def record_latency(self, tenant: str, wall_s: float,
+                       buckets: Optional[dict] = None,
+                       query_id: Optional[int] = None
+                       ) -> Optional[dict]:
+        """Feed one completed query's submit-to-done wall time (and
+        its attribution bucket seconds) into the tenant's SLO
+        estimator.  Returns a breach record on the un-breached ->
+        breached transition (the caller black-box dumps it); None
+        otherwise."""
+        dominant = ""
+        if buckets:
+            dominant = max(buckets, key=lambda b: buckets[b])
+        breach = None
+        with self._cv:
+            t = self._tenant_locked(tenant)
+            t.slo_window.append((max(0.0, float(wall_s)), dominant))
+            if t.slo_p99_ms <= 0:
+                return None
+            p99 = self._observed_p99_ms_locked(t)
+            if p99 is None:
+                return None
+            if p99 > t.slo_p99_ms:
+                if not t.slo_breached:
+                    t.slo_breached = True
+                    t.slo_breaches += 1
+                    doms = [b for _w, b in t.slo_window if b]
+                    offending = (max(set(doms), key=doms.count)
+                                 if doms else "unattributed")
+                    breach = {"tenant": tenant,
+                              "observed_p99_ms": round(p99, 3),
+                              "slo_p99_ms": t.slo_p99_ms,
+                              "dominant_bucket": offending,
+                              "window": len(t.slo_window),
+                              "query_id": query_id}
+            else:
+                t.slo_breached = False
+        if breach is not None:
+            _TM_SLO_BREACH.inc(tenant)
+            TM.REGISTRY.record_health({
+                "severity": "WARN", "check": "slo_breach",
+                "value": breach["observed_p99_ms"],
+                "threshold": breach["slo_p99_ms"],
+                "query_id": query_id,
+                "detail": (f"tenant={tenant} p99 "
+                           f"{breach['observed_p99_ms']:.0f}ms > slo "
+                           f"{breach['slo_p99_ms']}ms, dominant bucket "
+                           f"{breach['dominant_bucket']}")})
+        return breach
+
+    def local_tenancy_report(self) -> dict:
+        """The per-tenant state an executor piggybacks on its
+        rendezvous heartbeat: in-flight/queued depth, starvation age,
+        and the largest-runtime running query (the cluster arbiter's
+        preferred victim on this executor)."""
+        with self._cv:
+            now = time.monotonic()
+            tenants = {}
+            for name, t in self._tenants.items():
+                oldest = None
+                for lane in t.lanes.values():
+                    for k in lane:
+                        if oldest is None or k.submitted_at < oldest:
+                            oldest = k.submitted_at
+                largest_qid = None
+                largest_run = 0.0
+                for k in self._tickets.values():
+                    if (k.tenant != name or k.state != RUNNING
+                            or k.token is None or k.token.cancelled()
+                            or k.token.preempt_pending()
+                            or k.granted_at is None):
+                        continue
+                    run_s = now - k.granted_at
+                    if run_s < self.preempt_min_run_s:
+                        continue  # anti-thrash floor holds remotely too
+                    if largest_qid is None or run_s > largest_run:
+                        largest_qid, largest_run = k.query_id, run_s
+                tenants[name] = {
+                    "weight": t.weight,
+                    "running": t.running,
+                    "queued": t.queued,
+                    "suspended": t.suspended,
+                    "oldest_wait_s": (round(now - oldest, 6)
+                                      if oldest is not None else None),
+                    "largest_qid": largest_qid,
+                    "largest_run_s": round(largest_run, 6),
+                }
+            return {"slots": self.max_concurrent, "tenants": tenants}
+
     # -- the worker side ---------------------------------------------------
 
     def acquire(self, ticket: Ticket) -> float:
@@ -707,7 +963,15 @@ class QueryScheduler:
                            "shed": t.shed,
                            "cancelled_queued": t.cancelled_queued,
                            "preempted": t.preempted,
-                           "suspended": t.suspended}
+                           "suspended": t.suspended,
+                           "effective_max_queued":
+                               self._effective_max_queued_locked(t),
+                           "slo_p99_ms": t.slo_p99_ms,
+                           "observed_p99_ms":
+                               self._observed_p99_ms_locked(t),
+                           "slo_breached": t.slo_breached,
+                           "slo_breaches": t.slo_breaches,
+                           "cluster_shed": t.cluster_shed}
                     for name, t in self._tenants.items()}
 
 
@@ -752,6 +1016,10 @@ def get_scheduler(conf=None) -> QueryScheduler:
                     conf.get(C.SCHED_PREEMPT_GRACE_MS)) / 1000.0
                 s.preempt_min_run_s = float(
                     conf.get(C.SCHED_PREEMPT_MIN_RUN_MS)) / 1000.0
+                s.queue_shaping = bool(conf.get(C.SCHED_QUEUE_SHAPING))
+                s._default_slo_ms = int(
+                    conf.get(C.SCHED_TENANT_SLO_P99_MS))
+                s.slo_window = int(conf.get(C.SCHED_SLO_WINDOW))
                 s._dispatch_locked()
                 s._cv.notify_all()
         return _scheduler

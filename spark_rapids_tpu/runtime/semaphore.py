@@ -134,6 +134,25 @@ class DeviceSemaphore:
                                     "DeviceSemaphore", "semaphoreWait")
                         if tok is not None:
                             tok.check()
+                            if (tok.preempt_pending()
+                                    and tok._suspend_expired()):
+                                # wedge guard: the suspension lease
+                                # expired while this thread was parked
+                                # here (not in _park_suspended, where
+                                # the guard otherwise lives) — a dead
+                                # requester must never wedge a
+                                # semaphore waiter.  Drop our CV around
+                                # the force-resume: it repairs slot
+                                # accounting under the scheduler lock,
+                                # and scheduler code notifies this CV
+                                # while holding that lock — keeping the
+                                # lock order one-directional.
+                                self._cv.release()
+                                try:
+                                    tok._force_resume()
+                                finally:
+                                    self._cv.acquire()
+                                continue
                             if not registered:
                                 tok.add_waiter(self._cv)
                                 registered = True

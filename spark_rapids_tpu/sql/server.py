@@ -84,9 +84,14 @@ class QueryServer:
     executables persist for the next server process.
     """
 
-    def __init__(self, session, warmup_plans=None):
+    def __init__(self, session, warmup_plans=None, scheduler=None):
         from spark_rapids_tpu import conf as C
         self.session = session
+        # an explicit scheduler pins this server to it (the cluster
+        # tenancy soak hosts several executors in one process, each
+        # with its own non-singleton scheduler); None = the process
+        # singleton, as before
+        self._scheduler = scheduler
         self._lock = threading.Lock()
         self._handles: Dict[int, QueryHandle] = {}
         self._threads: List[threading.Thread] = []
@@ -143,7 +148,8 @@ class QueryServer:
                                          priority, conf)
             if hit is not None:
                 return hit
-        sched = get_scheduler(conf)
+        sched = (self._scheduler if self._scheduler is not None
+                 else get_scheduler(conf))
         try:
             ticket = sched.submit(qid, tenant=tenant, priority=priority,
                                   token=token)
@@ -207,8 +213,10 @@ class QueryServer:
 
     def _run(self, handle: QueryHandle, query) -> None:
         from spark_rapids_tpu.runtime import cancel
-        sched = peek_scheduler()
+        sched = (self._scheduler if self._scheduler is not None
+                 else peek_scheduler())
         t0 = time.monotonic()
+        df = None
         try:
             handle.queue_wait_s = sched.acquire(handle.ticket)
             handle.state = RUNNING
@@ -235,7 +243,41 @@ class QueryServer:
             cancel.unregister(handle.token)
             with self._lock:
                 self._handles.pop(handle.query_id, None)
+            if handle.state == OK:
+                self._record_latency(sched, handle, df)
             handle.done.set()
+
+    def _record_latency(self, sched, handle: QueryHandle, df) -> None:
+        """Feed a completed query's submit-to-done wall into the
+        tenant's SLO estimator; on the un-breached -> breached
+        transition the scheduler returns a breach record and the
+        server leaves an ``slo``-triggered black box naming the
+        offending dominant bucket."""
+        entry = getattr(df, "_last_query_entry", None) or {}
+        att = entry.get("attribution") or {}
+        try:
+            breach = sched.record_latency(
+                handle.tenant, handle.wall_s,
+                buckets=att.get("buckets"),
+                query_id=handle.query_id)
+        except Exception:
+            return
+        if not breach:
+            return
+        from spark_rapids_tpu import conf as C
+        from spark_rapids_tpu.runtime import attribution
+        conf = self.session.rapids_conf()
+        if not conf.get(C.ATTRIBUTION_ENABLED):
+            return
+        bb_dir = str(conf.get(C.ATTRIBUTION_BLACKBOX_PATH))
+        if not bb_dir:
+            return
+        attribution.dump_blackbox(
+            bb_dir, handle.query_id, "slo",
+            attribution=att or None,
+            extra={"status": "ok", "tenant": handle.tenant,
+                   "slo_breach": breach},
+            max_dumps=int(conf.get(C.ATTRIBUTION_BLACKBOX_MAX)))
 
     def _dump_queued_blackbox(self, handle: QueryHandle, exc,
                               t0: float) -> None:
@@ -300,7 +342,8 @@ class QueryServer:
 
     def active_queries(self, tenant: Optional[str] = None) -> List[int]:
         """Queued + running query ids, optionally one tenant's."""
-        sched = peek_scheduler()
+        sched = (self._scheduler if self._scheduler is not None
+                 else peek_scheduler())
         if sched is None:
             return []
         return sched.active_queries(tenant)
@@ -308,7 +351,8 @@ class QueryServer:
     def stats(self) -> Dict[str, dict]:
         """Per-tenant scheduler accounting (see
         ``QueryScheduler.stats``)."""
-        sched = peek_scheduler()
+        sched = (self._scheduler if self._scheduler is not None
+                 else peek_scheduler())
         return sched.stats() if sched is not None else {}
 
     # -- lifecycle ---------------------------------------------------------

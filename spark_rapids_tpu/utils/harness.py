@@ -813,6 +813,8 @@ def run_preempt_chaos(df_builder: Callable[[TpuSession], "object"],
         "suspended": suspended,
         "latency_s": tok.suspend_latency_s if tok is not None else None,
         "preempt_count": tok.preempt_count if tok is not None else 0,
+        "final_preempt_state": (tok.preempt_state
+                                if tok is not None else None),
         "sem_holders_during": sem_holders_during,
         "sem_drain_s": sem_drain_s,
         "result": box.get("result"),
@@ -834,7 +836,11 @@ def assert_preempt_invariant(
     ``cancelPollMs`` with every semaphore permit released; after resume
     the query completes **bit-identical** to an unpreempted run of the
     same plan, and the engine is back at a clean steady state — zero
-    leaked spillables, zero semaphore holders, an empty spill dir."""
+    leaked spillables, zero semaphore holders, an empty spill dir.
+    The wedge guard rides along: whatever happened mid-flight, the
+    token must END in RUN or RESUMED — never stuck in
+    SUSPEND_REQUESTED/SUSPENDED after the query finished."""
+    from spark_rapids_tpu.runtime import cancel as CN
     from spark_rapids_tpu.utils.asserts import assert_tables_equal
 
     rec = run_preempt_chaos(df_builder, inject, conf=conf,
@@ -868,6 +874,11 @@ def assert_preempt_invariant(
     assert not rec["spill_files"], (
         f"spill files stranded after preempt cycle: "
         f"{rec['spill_files']}")
+    assert rec["final_preempt_state"] in (CN.PREEMPT_RUN,
+                                          CN.PREEMPT_RESUMED), (
+        f"token wedged in {rec['final_preempt_state']} after the query "
+        f"finished — a suspend requester must never leave a query "
+        f"parked (mid-{rec['fired']})")
     return rec
 
 
@@ -1054,6 +1065,333 @@ def run_tenancy_soak(duration_s: float = 3.0,
         "hbm_breaches": ((mgr.metrics["tenantBreaches"]
                           if mgr is not None else 0) - breaches0),
         "sched_stats": sched_stats,
+        "zero_deadlock": zero_deadlock,
+        "zero_leak": zero_leak,
+        "ledgers_closed": all(closed) if closed else True,
+    }
+
+
+# ---------------------------------------------------------------------------
+# cluster tenancy soak: multi-executor enforcement over the rendezvous
+# ---------------------------------------------------------------------------
+
+def run_cluster_tenancy_soak(duration_s: float = 3.0,
+                             executors: int = 2,
+                             in_flight: int = 8,
+                             tenants: Optional[Dict[str, dict]] = None,
+                             conf: Optional[Dict] = None,
+                             seed: int = 0,
+                             timeout_s: float = 120.0,
+                             heartbeat_s: float = 0.05,
+                             arbiter_grace_s: float = 0.05,
+                             inject_executor_loss: bool = True,
+                             inject_coordinator_restart: bool = True,
+                             inject: Optional[Dict[str, Tuple[int, int]]]
+                             = None,
+                             make_query: Optional[Callable] = None
+                             ) -> dict:
+    """Fault-injected soak for CLUSTER-WIDE tenancy enforcement: host
+    ``executors`` thread-backed executors in this process — each with
+    its OWN non-singleton ``QueryScheduler``, a ``QueryServer`` pinned
+    to it, and a ``TenancyAgent`` heartbeating per-tenant reports to a
+    real TCP ``RendezvousCoordinator`` — then drive mixed hot/cold
+    tenant load through all of them for ``duration_s`` while the
+    coordinator's ``TenancyArbiter`` fans suspend/resume/shed
+    directives back out on the heartbeat responses.
+
+    Three failure domains fire mid-soak (each individually gateable):
+
+    * ``inject_executor_loss`` — the last executor ``simulate_death``s
+      ~35% in: its lease expires, the arbiter forgets its report and
+      hosted suspends, and any suspend lease it held force-resumes
+      locally (``tpuq_preempt_force_resumed_total``) — never a wedged
+      token.
+    * ``inject_coordinator_restart`` — ~60% in the coordinator is shut
+      down, agents miss heartbeats into degraded local-only mode
+      (``tpuq_tenancy_degraded_total``), and a NEW coordinator binds
+      the SAME port; agents re-sync on the first round trip.
+    * ``inject`` — ``{"tenancy": (at, transient_count)}`` arms the
+      ``tenancy`` chaos domain: an injected fault in the directive
+      path drops one beat's directives; lease renewal self-heals.
+
+    Returns a record with the all-green verdicts the bench asserts:
+    per-tenant ``slo`` (p99 met or the breach was recorded+shed —
+    never silent), ``wedged_tokens`` (must be 0), ``zero_deadlock``,
+    ``zero_leak``, ``ledgers_closed``, and a ``cluster`` block
+    (directives applied per kind, stale drops, re-syncs, degraded
+    entries, force-resumes, max observed directive fan-out latency)."""
+    import os
+    import threading  # noqa: F401  (QueryServer workers)
+    import time
+
+    from spark_rapids_tpu.parallel import rendezvous as PR
+    from spark_rapids_tpu.runtime import cancel as CN
+    from spark_rapids_tpu.runtime import memory as M
+    from spark_rapids_tpu.runtime import resilience as R
+    from spark_rapids_tpu.runtime import scheduler as SCH
+    from spark_rapids_tpu.runtime import tenancy as TN
+    from spark_rapids_tpu.runtime.semaphore import peek_semaphore
+    from spark_rapids_tpu.sql.server import QueryRejected, QueryServer
+
+    tenants = tenants or {
+        # the hog floods every executor with longer queries — the hold
+        # must comfortably exceed the arbiter grace so the hog reliably
+        # occupies every slot past the starvation threshold (a 20 ms
+        # hold on a warm runtime drains queues too fast to ever starve
+        # anyone, and the soak then proves nothing about directives)
+        "hog": {"priority": 0, "mix": 4, "rows": 8192, "hold_s": 0.08},
+        # ...and the latency tenant's short queries starve behind them
+        # until the cluster arbiter preempts the hog's largest victim
+        "latency": {"priority": 0, "mix": 1, "rows": 2048,
+                    "hold_s": 0.0},
+    }
+    inject = {"tenancy": (6, 2)} if inject is None else inject
+    full: Dict = {
+        "spark.rapids.tpu.scheduler.maxConcurrentQueries": 1,
+        "spark.rapids.tpu.scheduler.maxQueuedQueries": 64,
+        # local arbitration OFF: every suspension in this soak is
+        # attributably a CLUSTER directive
+        "spark.rapids.tpu.scheduler.preempt.enabled": False,
+        "spark.rapids.tpu.scheduler.preempt.graceMs": 250,
+        "spark.rapids.tpu.scheduler.preempt.minRunMs": 10,
+        "spark.rapids.tpu.scheduler.tenantSloP99Ms": 60_000,
+        "spark.rapids.tpu.scheduler.sloWindow": 16,
+        "spark.rapids.tpu.tenancy.enabled": True,
+        "spark.rapids.tpu.query.cancelPollMs": 10,
+        "spark.rapids.tpu.retry.backoffBaseMs": 0,
+        "spark.rapids.tpu.cache.enabled": False,
+    }
+    full.update(conf or {})
+    for d, (at, budget) in (inject or {}).items():
+        full[f"spark.rapids.tpu.test.inject.{d}.at"] = at
+        full[f"spark.rapids.tpu.test.inject.{d}.transientCount"] = budget
+    R.INJECTOR.reset()
+    CN.reset()
+    SCH.reset_scheduler()
+    TN.reset_agent()
+    s = tpu_session(full)
+    conf_obj = s.rapids_conf()
+    rnd = random.Random(seed)
+    lease_s = max(0.4, 8.0 * heartbeat_s)
+
+    def _mk_coord():
+        c = PR.RendezvousCoordinator(executors, lease_s=lease_s)
+        c.tenancy.grace_s = arbiter_grace_s
+        c.tenancy.suspend_ttl_s = max(4.0 * heartbeat_s, 0.2)
+        return c
+
+    coord = _mk_coord()
+    port = int(coord.address.rsplit(":", 1)[1])
+    scheds, servers, agents, clients = [], [], [], []
+    for pid in range(executors):
+        sched = SCH.QueryScheduler(conf_obj)
+        scheds.append(sched)
+        servers.append(QueryServer(s, scheduler=sched))
+        agent = TN.TenancyAgent(sched, conf=conf_obj)
+        agents.append(agent)
+        client = PR.RendezvousClient(coord.address, pid)
+        client.start_heartbeat(heartbeat_s, payload_fn=agent.payload,
+                               on_response=agent.on_heartbeat,
+                               on_miss=agent.on_miss)
+        clients.append(client)
+    TN.set_agent(agents[0])   # the HBM arbiter's breach-relay target
+
+    names = sorted(tenants)
+    mix = [n for n in names for _ in range(
+        max(1, int(tenants[n].get("mix", 1))))]
+    per = {n: {"submitted": 0, "completed": 0, "errors": 0,
+               "rejected": 0, "lat": []} for n in names}
+    outcomes = {"ok": 0, "cancelled": 0, "error": 0}
+    errors: list = []
+    pending: list = []
+    live = list(range(executors))
+    counter = [0]
+    fr0 = CN._TM_PREEMPT_FORCE_RESUMED.value
+    inj_base = dict(R._TM_INJECTED.child_values())
+
+    def submit_one() -> None:
+        i = counter[0]
+        counter[0] += 1
+        name = mix[i % len(mix)]
+        spec = tenants[name]
+        epid = live[i % len(live)]
+        if make_query is not None:
+            build = make_query(s, name, spec, rnd, i)
+        else:
+            rows = int(spec.get("rows", 2048)) + 64 * rnd.randint(0, 15)
+            hold = float(spec.get("hold_s", 0.0))
+
+            def build(rows=rows, hold=hold):
+                # the hold keeps the ticket RUNNING long enough to be
+                # an eligible remote victim (past preempt.minRunMs);
+                # the suspend itself parks at toArrow's preempt points
+                if hold:
+                    time.sleep(hold)
+                return s.range(rows, numPartitions=2)
+
+        try:
+            h = servers[epid].submit(
+                build, tenant=name,
+                priority=int(spec.get("priority", 0)))
+            per[name]["submitted"] += 1
+            pending.append((h, name, epid))
+        except QueryRejected:
+            per[name]["rejected"] += 1
+
+    def reap(h, name) -> None:
+        if h.state == "OK":
+            outcomes["ok"] += 1
+        elif h.state == "CANCELLED":
+            outcomes["cancelled"] += 1
+        else:
+            outcomes["error"] += 1
+            per[name]["errors"] += 1
+            errors.append(h.error)
+        per[name]["completed"] += 1
+        if h.wall_s is not None:
+            per[name]["lat"].append(h.wall_s)
+
+    faults = {"executor_lost": None, "coordinator_restarted": False,
+              "degraded_window_s": 0.0}
+    arbiter_pre: Optional[dict] = None
+    t_start = time.monotonic()
+    loss_at = t_start + 0.35 * duration_s
+    restart_at = t_start + 0.60 * duration_s
+    deadline = t_start + duration_s
+    for _ in range(in_flight):
+        submit_one()
+    while time.monotonic() < deadline:
+        now = time.monotonic()
+        if (inject_executor_loss and faults["executor_lost"] is None
+                and now >= loss_at and len(live) > 1):
+            lost = live.pop()   # the last executor goes dark
+            clients[lost].simulate_death()
+            faults["executor_lost"] = lost
+        if (inject_coordinator_restart
+                and not faults["coordinator_restarted"]
+                and now >= restart_at):
+            arbiter_pre = coord.tenancy.stats()
+            coord.shutdown()
+            # let agents miss into degraded local-only mode
+            gap = max(0.25,
+                      (agents[0].degraded_after + 1) * heartbeat_s)
+            time.sleep(gap)
+            faults["degraded_window_s"] = gap
+            for attempt in range(20):
+                try:
+                    coord = PR.RendezvousCoordinator(
+                        executors, port=port, lease_s=lease_s)
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            coord.tenancy.grace_s = arbiter_grace_s
+            coord.tenancy.suspend_ttl_s = max(4.0 * heartbeat_s, 0.2)
+            faults["coordinator_restarted"] = True
+        done_now = [(h, n, p) for h, n, p in pending
+                    if h.done.is_set()]
+        for h, n, p in done_now:
+            pending.remove((h, n, p))
+            reap(h, n)
+            if time.monotonic() < deadline:
+                submit_one()
+        if not done_now:
+            time.sleep(0.002)
+    # drain
+    zero_deadlock = True
+    drain_deadline = time.monotonic() + timeout_s
+    wedged = 0
+    for h, n, p in pending:
+        if not h.done.wait(timeout=max(
+                0.0, drain_deadline - time.monotonic())):
+            zero_deadlock = False
+            tok = CN.get_token(h.query_id)
+            if tok is not None and tok.preempt_pending():
+                wedged += 1
+            continue
+        reap(h, n)
+    for client in clients:
+        client.stop_heartbeat()
+    sched_stats = {i: sch.stats() for i, sch in enumerate(scheds)}
+    agent_stats = [a.stats() for a in agents]
+    arbiter_stats = coord.tenancy.stats()
+    for server in servers:
+        server.shutdown(timeout_s=10.0)
+    coord.shutdown()
+    for sch in scheds:
+        with sch._cv:
+            wedged += len(sch._suspended)
+        if sch.queued_total or sch.running_total:
+            zero_deadlock = False
+    for qid in CN.active_queries():
+        tok = CN.get_token(qid)
+        if tok is not None and tok.preempt_pending():
+            wedged += 1
+    R.INJECTOR.reset()
+    TN.reset_agent()
+    mgr = M.peek_manager()
+    sem = peek_semaphore()
+    spill_files = []
+    if mgr is not None and os.path.isdir(mgr.spill_path):
+        spill_files = sorted(os.listdir(mgr.spill_path))
+    zero_leak = ((mgr.report_leaks() if mgr is not None else 0) == 0
+                 and (sem.holders if sem is not None else 0) == 0
+                 and not spill_files)
+    entries = s.query_history()
+    closed = [bool((e.get("attribution") or {}).get("closed", True))
+              for e in entries]
+    # per-tenant SLO verdict ACROSS executors: p99 within target on
+    # every executor, or the breach was RECORDED and shed — a breach
+    # the guardrail never saw is the only failing shape
+    slo = {}
+    for name in names:
+        target, breaches, obs = 0, 0, []
+        for st in sched_stats.values():
+            t = st.get(name)
+            if not t:
+                continue
+            target = max(target, int(t["slo_p99_ms"]))
+            breaches += int(t["slo_breaches"])
+            if t["observed_p99_ms"] is not None:
+                obs.append(float(t["observed_p99_ms"]))
+        met = target <= 0 or all(o <= target for o in obs)
+        slo[name] = {"target_ms": target,
+                     "observed_p99_ms": max(obs) if obs else None,
+                     "breaches": breaches,
+                     "met_or_shed": bool(met or breaches > 0)}
+    inj_now = R._TM_INJECTED.child_values()
+    cluster = {
+        "applied": {k: sum(a["applied"].get(k, 0) for a in agent_stats)
+                    for k in ("suspend", "resume", "shed", "unshed")},
+        "stale": sum(a["stale"] for a in agent_stats),
+        "resyncs": sum(a["resyncs"] for a in agent_stats),
+        "degraded_entries": sum(a["degraded_entries"]
+                                for a in agent_stats),
+        "force_resumed": CN._TM_PREEMPT_FORCE_RESUMED.value - fr0,
+        "max_fanout_s": max([a["max_fanout_s"] for a in agent_stats]
+                            or [0.0]),
+        "injected_faults": (inj_now.get("tenancy", 0)
+                            - inj_base.get("tenancy", 0)),
+        "arbiter": arbiter_stats,
+        "arbiter_pre_restart": arbiter_pre,
+    }
+    for n in names:
+        lat = sorted(per[n].pop("lat"))
+        per[n]["p50_ms"] = round(_pctile(lat, 0.50) * 1000.0, 3)
+        per[n]["p99_ms"] = round(_pctile(lat, 0.99) * 1000.0, 3)
+    return {
+        "duration_s": duration_s,
+        "executors": executors,
+        "heartbeat_s": heartbeat_s,
+        "in_flight": in_flight,
+        "tenants": per,
+        "outcomes": outcomes,
+        "errors": errors,
+        "faults": faults,
+        "slo": slo,
+        "cluster": cluster,
+        "sched_stats": sched_stats,
+        "agent_stats": agent_stats,
+        "wedged_tokens": wedged,
         "zero_deadlock": zero_deadlock,
         "zero_leak": zero_leak,
         "ledgers_closed": all(closed) if closed else True,

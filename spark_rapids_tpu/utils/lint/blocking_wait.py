@@ -39,6 +39,13 @@ PREEMPT_SCOPES = ("runtime",)
 POLL_CALLS = frozenset(
     {"check", "preempt_point", "preempt_pending", "wait_interval"})
 
+#: cluster-tenancy directive handlers get the token-polling requirement
+#: in EVERY lint scope (not just runtime/): a suspend/resume/shed
+#: applier that parks in a bounded wait without consulting the token
+#: can wedge the cross-process protocol — the lease expiry that is
+#: supposed to unwedge it is itself observed via the token
+DIRECTIVE_MARKER = "directive"
+
 
 def _in_scope(rel: str) -> bool:
     parts = rel.replace("\\", "/").split("/")
@@ -129,26 +136,42 @@ class BlockingWaitRule(Rule):
                     f"token-bounded wait (`{mod.snippet(node.lineno)}`)"))
         if _in_preempt_scope(mod.rel):
             out.extend(self._check_preempt_aware(mod))
+        else:
+            # outside runtime/ only DIRECTIVE handlers carry the
+            # token-polling contract (parallel/ waits are otherwise
+            # bounded-is-fine — see PREEMPT_SCOPES)
+            out.extend(self._check_preempt_aware(
+                mod, only_directive=True))
         return out
 
-    def _check_preempt_aware(self, mod: SourceModule
+    def _check_preempt_aware(self, mod: SourceModule,
+                             only_directive: bool = False
                              ) -> Iterable[Finding]:
         """runtime/ bounded waits must sit in a token-polling function
         (module-level waits have no query scope and are skipped — the
-        unbounded/plain-sleep checks above still cover them)."""
+        unbounded/plain-sleep checks above still cover them).  With
+        ``only_directive`` the check narrows to functions whose name
+        contains ``directive`` — the cluster-tenancy fan-out path,
+        which must stay cancel/preempt-aware in every scope."""
         out: List[Finding] = []
         for fn in ast.walk(mod.tree):
             if not isinstance(fn, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
+                continue
+            if (only_directive
+                    and DIRECTIVE_MARKER not in fn.name.lower()):
                 continue
             calls = _own_calls(fn)
             if any(_call_name(c) in POLL_CALLS for c in calls):
                 continue
             for call in calls:
                 if _is_bounded_wait(call):
+                    what = ("directive handler with preempt-unaware "
+                            "bounded wait" if only_directive else
+                            "preempt-unaware bounded wait")
                     out.append(Finding(
                         self.name, mod.rel, call.lineno,
-                        "preempt-unaware bounded wait — poll the query "
+                        f"{what} — poll the query "
                         "token (check/preempt_point/wait_interval) "
                         "around the wait so a suspend request lands "
                         f"(`{mod.snippet(call.lineno)}`)"))

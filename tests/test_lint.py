@@ -177,6 +177,40 @@ def test_preempt_safety_parallel_scope_not_checked():
     assert _run([BlockingWaitRule()], m) == []
 
 
+def test_directive_handler_pollless_wait_flagged_in_parallel():
+    # ...EXCEPT directive handlers: the cluster-tenancy fan-out path
+    # must consult the token even in parallel/ — a bounded wait that
+    # never polls can wedge a suspend whose lease expiry is observed
+    # via the token
+    m = _mod("spark_rapids_tpu/parallel/x.py", """
+        def apply_directive(cv, d):
+            cv.wait(timeout=0.1)
+        """)
+    out = _run([BlockingWaitRule()], m)
+    assert [f.line for f in out] == [3]
+    assert "directive handler" in out[0].message
+
+
+def test_directive_handler_token_polling_is_clean():
+    m = _mod("spark_rapids_tpu/parallel/x.py", """
+        def on_directive(cv, tok):
+            tok.check()
+            cv.wait(timeout=tok.wait_interval())
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
+def test_directive_handler_checked_outside_parallel_too():
+    # the marker is name-based and scope-wide: a directive applier in
+    # sql/ (out of the classic blocking-wait scope) is still NOT
+    # checked — the rule only ever looks at runtime/ and parallel/
+    m = _mod("spark_rapids_tpu/sql/x.py", """
+        def apply_directive(cv, d):
+            cv.wait(timeout=0.1)
+        """)
+    assert _run([BlockingWaitRule()], m) == []
+
+
 # ---------------------------------------------------------------------------
 # failure-domain
 # ---------------------------------------------------------------------------
